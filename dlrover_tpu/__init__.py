@@ -1,0 +1,3 @@
+"""dlrover_tpu: TPU-native elastic distributed training framework."""
+
+__version__ = "0.1.0"

@@ -1,0 +1,315 @@
+"""Wire messages between agents and the job master.
+
+The reference defines these in protobuf (dlrover/proto/elastic_training.proto:
+243-299) and generates gRPC stubs. We keep gRPC as the transport (it is
+device-agnostic control plane) but use plain dataclasses serialized with
+pickle over a single generic "Request/Response" envelope — no protoc step,
+same RPC surface. Every master RPC from the reference servicer
+(dlrover/python/master/servicer.py:62) has a message here.
+"""
+
+import pickle
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+def serialize(msg) -> bytes:
+    return pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize(data: bytes):
+    if not data:
+        return None
+    return pickle.loads(data)
+
+
+class BaseMessage:
+    def serialize(self) -> bytes:
+        return serialize(self)
+
+
+@dataclass
+class BaseRequest(BaseMessage):
+    node_id: int = -1
+    node_type: str = ""
+
+
+@dataclass
+class Response(BaseMessage):
+    success: bool = True
+    reason: str = ""
+
+
+# ---------------------------------------------------------------- data shards
+
+
+@dataclass
+class Shard(BaseMessage):
+    name: str = ""
+    start: int = 0
+    end: int = 0
+    record_indices: Optional[List[int]] = None
+
+
+@dataclass
+class Task(BaseMessage):
+    task_id: int = -1
+    task_type: str = ""
+    shard: Shard = field(default_factory=Shard)
+
+    @property
+    def exists(self) -> bool:
+        return self.task_id >= 0
+
+
+@dataclass
+class TaskRequest(BaseRequest):
+    dataset_name: str = ""
+
+
+@dataclass
+class TaskResult(BaseRequest):
+    dataset_name: str = ""
+    task_id: int = -1
+    err_message: str = ""
+
+
+@dataclass
+class DatasetShardParams(BaseRequest):
+    batch_size: int = 0
+    num_epochs: int = 1
+    dataset_size: int = 0
+    shuffle: bool = False
+    num_minibatches_per_shard: int = 2
+    dataset_name: str = ""
+    task_type: str = ""
+    storage_type: str = "table"
+
+
+@dataclass
+class ShardCheckpointRequest(BaseRequest):
+    dataset_name: str = ""
+
+
+@dataclass
+class ShardCheckpoint(BaseMessage):
+    content: str = ""  # JSON
+
+
+@dataclass
+class DatasetEpochRequest(BaseRequest):
+    dataset_name: str = ""
+
+
+@dataclass
+class DatasetEpoch(BaseMessage):
+    epoch: int = 0
+
+
+# ---------------------------------------------------------------- rendezvous
+
+
+@dataclass
+class RendezvousParams(BaseRequest):
+    min_nodes: int = 1
+    max_nodes: int = 1
+    waiting_timeout: float = 30.0
+    node_unit: int = 1
+    joint_timeout: float = 600.0
+
+
+@dataclass
+class JoinRendezvousRequest(BaseRequest):
+    local_world_size: int = 1
+    rdzv_name: str = ""
+
+
+@dataclass
+class RendezvousRound(BaseMessage):
+    round: int = 0
+
+
+@dataclass
+class CommWorldRequest(BaseRequest):
+    rdzv_name: str = ""
+
+
+@dataclass
+class CommWorld(BaseMessage):
+    rdzv_round: int = 0
+    group: int = 0
+    world: Dict[int, int] = field(default_factory=dict)  # node_rank -> slots
+
+
+@dataclass
+class WaitingNodeNumRequest(BaseRequest):
+    rdzv_name: str = ""
+
+
+@dataclass
+class WaitingNodeNum(BaseMessage):
+    waiting_num: int = 0
+
+
+@dataclass
+class NetworkReadyRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class NetworkCheckResult(BaseMessage):
+    success: bool = False
+    reason: str = ""
+
+
+@dataclass
+class NodeCheckStatus(BaseRequest):
+    rdzv_round: int = 0
+    normal: bool = True
+    elapsed_time: float = 0.0
+
+
+# ---------------------------------------------------------------- kv store
+
+
+@dataclass
+class KVStoreSetRequest(BaseMessage):
+    key: str = ""
+    value: bytes = b""
+
+
+@dataclass
+class KVStoreGetRequest(BaseMessage):
+    key: str = ""
+
+
+@dataclass
+class KVStoreAddRequest(BaseMessage):
+    key: str = ""
+    amount: int = 0
+
+
+@dataclass
+class KVStoreValue(BaseMessage):
+    value: bytes = b""
+
+
+@dataclass
+class KVStoreAddResult(BaseMessage):
+    value: int = 0
+
+
+# ---------------------------------------------------------------- node status
+
+
+@dataclass
+class NodeStatusRequest(BaseRequest):
+    status: str = ""
+    exit_reason: str = ""
+    restart_count: int = 0
+
+
+@dataclass
+class NodeFailure(BaseRequest):
+    error_data: str = ""
+    level: str = ""
+    restart_count: int = 0
+
+
+@dataclass
+class NodeAddressRequest(BaseRequest):
+    address: str = ""
+
+
+@dataclass
+class HeartBeat(BaseRequest):
+    timestamp: float = 0.0
+
+
+@dataclass
+class HeartbeatResponse(BaseMessage):
+    action: str = ""  # "", "restart", "stop"
+
+
+@dataclass
+class ResourceStats(BaseRequest):
+    cpu_percent: float = 0.0
+    memory_mb: int = 0
+    tpu_stats: List[Dict] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------- metrics
+
+
+@dataclass
+class GlobalStep(BaseRequest):
+    timestamp: float = 0.0
+    step: int = 0
+
+
+@dataclass
+class ModelInfo(BaseRequest):
+    param_count: int = 0
+    flops_per_step: float = 0.0
+    batch_size: int = 0
+    seq_len: int = 0
+    extra: Dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------- sync
+
+
+@dataclass
+class SyncJoin(BaseRequest):
+    sync_name: str = ""
+
+
+@dataclass
+class SyncFinish(BaseRequest):
+    sync_name: str = ""
+
+
+@dataclass
+class SyncBarrier(BaseRequest):
+    barrier_name: str = ""
+    notify: bool = False
+
+
+# ---------------------------------------------------------------- cluster
+
+
+@dataclass
+class ClusterVersionRequest(BaseRequest):
+    version_type: str = ""  # "local" | "global" | "restored"
+
+
+@dataclass
+class ClusterVersion(BaseMessage):
+    version: int = 0
+
+
+@dataclass
+class RunningNodesRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class RunningNodes(BaseMessage):
+    nodes: List[Dict] = field(default_factory=dict)
+
+
+@dataclass
+class ScaleRequest(BaseRequest):
+    """Manual scale trigger (parity: ScalePlan CRD manualScaling)."""
+
+    node_num: int = 0
+
+
+@dataclass
+class ElasticRunConfigRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class ElasticRunConfig(BaseMessage):
+    configs: Dict[str, str] = field(default_factory=dict)

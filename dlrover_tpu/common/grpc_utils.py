@@ -1,0 +1,120 @@
+"""Proto-less gRPC transport.
+
+The reference generates protobuf stubs from dlrover/proto/elastic_training.proto.
+Here the master service is a single generic unary RPC ``/dlrover_tpu.Master/call``
+carrying a pickled ``(method_name, request_message)`` pair; the servicer
+dispatches on ``method_name``. Identical RPC semantics, no protoc toolchain.
+"""
+
+import pickle
+import socket
+import threading
+from concurrent import futures
+from typing import Callable, Optional
+
+import grpc
+
+from dlrover_tpu.common.constants import GRPC
+from dlrover_tpu.common.log import default_logger as logger
+
+SERVICE_NAME = "dlrover_tpu.Master"
+METHOD_NAME = "call"
+
+_GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+]
+
+
+def find_free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        return s.getsockname()[1]
+
+
+def addr_connected(addr: str, timeout: float = 3.0) -> bool:
+    host, _, port = addr.rpartition(":")
+    try:
+        with socket.create_connection((host, int(port)), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+class GenericRpcServer:
+    """gRPC server exposing one generic dispatch method."""
+
+    def __init__(self, handler: Callable[[str, object], object], port: int = 0,
+                 max_workers: int = 64):
+        self._handler = handler
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=_GRPC_OPTIONS,
+        )
+        rpc_handler = grpc.unary_unary_rpc_method_handler(
+            self._dispatch,
+            request_deserializer=None,  # raw bytes
+            response_serializer=None,
+        )
+        service = grpc.method_handlers_generic_handler(
+            SERVICE_NAME, {METHOD_NAME: rpc_handler}
+        )
+        self._server.add_generic_rpc_handlers((service,))
+        self.port = self._server.add_insecure_port(f"[::]:{port}")
+
+    def _dispatch(self, request_bytes: bytes, context) -> bytes:
+        try:
+            method, message = pickle.loads(request_bytes)
+            result = self._handler(method, message)
+            return pickle.dumps(result, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:
+            logger.exception("RPC dispatch failed: %s", e)
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def start(self):
+        self._server.start()
+
+    def stop(self, grace: Optional[float] = None):
+        self._server.stop(grace)
+
+    def wait_for_termination(self, timeout=None):
+        self._server.wait_for_termination(timeout)
+
+
+class GenericRpcClient:
+    """Client for GenericRpcServer; thread-safe, lazy channel."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self.addr = addr
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._channel: Optional[grpc.Channel] = None
+        self._callable = None
+
+    def _ensure_channel(self):
+        with self._lock:
+            if self._channel is None:
+                self._channel = grpc.insecure_channel(
+                    self.addr, options=_GRPC_OPTIONS
+                )
+                self._callable = self._channel.unary_unary(
+                    f"/{SERVICE_NAME}/{METHOD_NAME}",
+                    request_serializer=None,
+                    response_deserializer=None,
+                )
+
+    def call(self, method: str, message, timeout: Optional[float] = None):
+        self._ensure_channel()
+        payload = pickle.dumps(
+            (method, message), protocol=pickle.HIGHEST_PROTOCOL
+        )
+        response = self._callable(payload, timeout=timeout or self.timeout)
+        return pickle.loads(response)
+
+    def close(self):
+        with self._lock:
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+                self._callable = None

@@ -1,0 +1,23 @@
+"""Single configured logger (parity: dlrover/python/common/log.py:33)."""
+
+import logging
+import os
+import sys
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(filename)s:%(lineno)d] %(message)s"
+
+
+def _build_logger() -> logging.Logger:
+    logger = logging.getLogger("dlrover_tpu")
+    if logger.handlers:
+        return logger
+    level = os.getenv("DLROVER_TPU_LOG_LEVEL", "INFO").upper()
+    logger.setLevel(level)
+    handler = logging.StreamHandler(stream=sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    logger.propagate = False
+    return logger
+
+
+default_logger = _build_logger()

@@ -1,0 +1,101 @@
+"""Dataset manager base types: Task, DoingTask, shard checkpoint.
+
+Parity reference: dlrover/python/master/shard/base_dataset_manager.py:22,43,60.
+"""
+
+import json
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Dict, List
+
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter, Shard
+
+
+class Task:
+    """A data-shard task assigned to one worker."""
+
+    def __init__(self, task_id: int, task_type: str, shard: Shard):
+        self.task_id = task_id
+        self.task_type = task_type
+        self.shard = shard
+        self.retry_count = 0
+
+    @classmethod
+    def create_invalid_task(cls) -> "Task":
+        return cls(-1, TaskType.NONE, Shard("", -1, -1))
+
+
+@dataclass
+class DoingTask:
+    """An in-flight task: which worker holds it and since when."""
+
+    task: Task
+    node_id: int
+    start_time: float
+
+
+class DatasetShardCheckpoint:
+    """JSON-serializable shard progress of one dataset
+    (parity: base_dataset_manager.py:60)."""
+
+    def __init__(self, dataset_name: str, todo: List[List[int]],
+                 doing: List[List[int]], epoch: int,
+                 splitter_epoch: int = 0):
+        self.dataset_name = dataset_name
+        self.todo = todo  # [[start, end], ...]
+        self.doing = doing
+        self.epoch = epoch
+        self.splitter_epoch = splitter_epoch
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+    @classmethod
+    def from_json(cls, content: str) -> "DatasetShardCheckpoint":
+        d = json.loads(content)
+        return cls(
+            dataset_name=d["dataset_name"],
+            todo=d.get("todo", []),
+            doing=d.get("doing", []),
+            epoch=d.get("epoch", 0),
+            splitter_epoch=d.get("splitter_epoch", 0),
+        )
+
+
+class DatasetManger(ABC):
+    """Manages todo/doing task queues of one dataset."""
+
+    def __init__(self, task_type: str, batch_size: int,
+                 dataset_splitter: DatasetSplitter):
+        self.todo: List[Task] = []
+        self.doing: Dict[int, DoingTask] = {}
+        self._task_type = task_type
+        self._batch_size = batch_size
+        self._dataset_splitter = dataset_splitter
+        self._start_time = time.time()
+
+    @abstractmethod
+    def get_task(self, node_type: str, node_id: int) -> Task:
+        ...
+
+    @abstractmethod
+    def report_task_status(self, task_id: int, success: bool):
+        ...
+
+    @abstractmethod
+    def completed(self) -> bool:
+        ...
+
+    @abstractmethod
+    def recover_task(self, task: Task):
+        ...
+
+    def get_epoch(self) -> int:
+        return self._dataset_splitter.get_epoch()
+
+    def reset(self):
+        self.todo = []
+        self.doing = {}
+        self._dataset_splitter.set_epoch(0)

@@ -1,0 +1,187 @@
+"""TaskManager: datasets -> shards -> tasks, with straggler recovery.
+
+Parity reference: dlrover/python/master/shard/task_manager.py:36
+(get_dataset_task:91, report_dataset_task:119, recover_tasks:158,
+_check_and_reassign_timeout_tasks:205).
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import NodeType, TaskType
+from dlrover_tpu.common.global_context import Context
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.shard.base_dataset_manager import (
+    DatasetManger,
+    DatasetShardCheckpoint,
+    Task,
+)
+from dlrover_tpu.master.shard.batch_dataset_manager import BatchDatasetManager
+from dlrover_tpu.master.shard.dataset_splitter import (
+    DatasetSplitter,
+    StreamingDatasetSplitter,
+    new_dataset_splitter,
+)
+from dlrover_tpu.master.shard.streaming_dataset_manager import (
+    StreamingDatasetManager,
+)
+
+_context = Context.singleton_instance()
+
+
+class TaskManager:
+    """Dispatches and recovers data-shard tasks across datasets."""
+
+    def __init__(self, worker_restart_timeout: float = 0.0,
+                 speed_monitor=None):
+        self._lock = threading.Lock()
+        self._worker_restart_timeout = worker_restart_timeout
+        self._should_stop = False
+        self._datasets: Dict[str, DatasetManger] = {}
+        self._worker_client_version: Dict[int, float] = {}
+        self._speed_monitor = speed_monitor
+        self._task_timeout = _context.task_process_timeout
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- datasets
+
+    def new_dataset(
+        self,
+        batch_size: int,
+        dataset_size: int,
+        dataset_name: str,
+        dataset_splitter: DatasetSplitter,
+        task_type: str = TaskType.TRAINING,
+    ):
+        with self._lock:
+            if dataset_name in self._datasets:
+                logger.info("Dataset %s already registered", dataset_name)
+                return
+            if isinstance(dataset_splitter, StreamingDatasetSplitter):
+                dataset = StreamingDatasetManager(
+                    task_type, batch_size, dataset_splitter
+                )
+            else:
+                dataset = BatchDatasetManager(
+                    task_type, batch_size, dataset_splitter
+                )
+            self._datasets[dataset_name] = dataset
+            logger.info(
+                "New dataset %s: size=%d batch=%d type=%s",
+                dataset_name, dataset_size, batch_size, task_type,
+            )
+
+    def get_dataset(self, name: str) -> Optional[DatasetManger]:
+        return self._datasets.get(name)
+
+    def reset_dataset(self, name: str):
+        with self._lock:
+            ds = self._datasets.get(name)
+            if ds:
+                ds.reset()
+
+    # ---------------------------------------------------------------- tasks
+
+    def get_dataset_task(self, node_type: str, node_id: int,
+                         dataset_name: str) -> Task:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return Task.create_invalid_task()
+            return ds.get_task(node_type, node_id)
+
+    def report_dataset_task(self, dataset_name: str, task_id: int,
+                            success: bool, err: str = ""):
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                raise ValueError(f"unknown dataset {dataset_name}")
+            success, doing_task = ds.report_task_status(task_id, success)
+            if success and self._speed_monitor and doing_task:
+                self._speed_monitor.add_task_completed(
+                    doing_task.node_id, time.time() - doing_task.start_time
+                )
+            return success
+
+    def recover_tasks(self, node_type: str, node_id: int):
+        """Requeue all doing tasks of a failed node
+        (parity: task_manager.py:158)."""
+        with self._lock:
+            for name, ds in self._datasets.items():
+                recover = getattr(ds, "recover_tasks_of_node", None)
+                if recover:
+                    ids = recover(node_id)
+                    if ids:
+                        logger.info(
+                            "Recovered tasks %s of node %s in dataset %s",
+                            ids, node_id, name,
+                        )
+
+    def finished(self) -> bool:
+        """All registered datasets have dispatched and completed all tasks."""
+        if not self._datasets:
+            return False
+        return all(ds.completed() for ds in self._datasets.values())
+
+    def training_started(self) -> bool:
+        return any(ds.doing or ds.todo for ds in self._datasets.values()) or (
+            self.finished()
+        )
+
+    # ------------------------------------------------------------ watchdog
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._check_and_reassign_timeout_tasks,
+            name="task-timeout-watchdog", daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._should_stop = True
+
+    def _check_and_reassign_timeout_tasks(self):
+        """1s loop requeueing tasks stuck past the timeout
+        (parity: task_manager.py:205)."""
+        while not self._should_stop:
+            for ds in list(self._datasets.values()):
+                doing = getattr(ds, "get_doing_tasks", lambda: {})()
+                now = time.time()
+                for task_id, dt in list(doing.items()):
+                    if now - dt.start_time > self._task_timeout:
+                        logger.warning(
+                            "Task %s timed out on node %s; requeue",
+                            task_id, dt.node_id,
+                        )
+                        ds.report_task_status(task_id, success=False)
+            time.sleep(1)
+
+    # ----------------------------------------------------------- checkpoint
+
+    def get_dataset_checkpoint(
+        self, dataset_name: str
+    ) -> Optional[DatasetShardCheckpoint]:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return None
+            ckpt = getattr(ds, "checkpoint", None)
+            return ckpt() if ckpt else None
+
+    def restore_dataset_from_checkpoint(self, content: str) -> bool:
+        try:
+            checkpoint = DatasetShardCheckpoint.from_json(content)
+            with self._lock:
+                ds = self._datasets.get(checkpoint.dataset_name)
+                if ds is None:
+                    return False
+                ds.restore_checkpoint(checkpoint)
+            return True
+        except Exception as e:
+            logger.error("Failed to restore shard checkpoint: %s", e)
+            return False
+
+    def get_dataset_epoch(self, dataset_name: str) -> int:
+        ds = self._datasets.get(dataset_name)
+        return ds.get_epoch() if ds else 0

@@ -1,0 +1,16 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's multi-node-without-a-cluster approach
+(dlrover/python/tests/test_utils.py) — sharding/mesh tests run on a virtual
+8-device CPU topology; no real TPU needed.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")

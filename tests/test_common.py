@@ -1,0 +1,82 @@
+"""Tests for common layer: node model, status flow, context."""
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus, NodeType
+from dlrover_tpu.common.global_context import Context
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.node.status_flow import get_node_state_flow
+
+
+def test_node_resource_parse():
+    res = NodeResource.resource_str_to_node_resource(
+        "cpu=4,memory=8192,tpu_chips=4,tpu_type=v5p"
+    )
+    assert res.cpu == 4
+    assert res.memory == 8192
+    assert res.tpu_chips == 4
+    assert res.tpu_type == "v5p"
+
+
+def test_node_relaunch_clone():
+    node = Node(NodeType.WORKER, 0, rank_index=2, critical=True)
+    clone = node.get_relaunch_node_info(new_id=7)
+    assert clone.id == 7
+    assert clone.rank_index == 2
+    assert clone.relaunch_count == 1
+    assert clone.critical
+
+
+def test_unrecoverable_failure():
+    node = Node(NodeType.WORKER, 0, max_relaunch_count=2)
+    node.set_exit_reason(NodeExitReason.FATAL_ERROR)
+    assert node.is_unrecoverable_failure()
+    node2 = Node(NodeType.WORKER, 1, max_relaunch_count=2)
+    node2.set_exit_reason(NodeExitReason.KILLED)
+    assert not node2.is_unrecoverable_failure()
+    node2.relaunch_count = 2
+    assert node2.is_unrecoverable_failure()
+
+
+def test_status_flow():
+    flow = get_node_state_flow(
+        NodeStatus.RUNNING, "modified", NodeStatus.FAILED
+    )
+    assert flow is not None and flow.should_relaunch
+    flow = get_node_state_flow(
+        NodeStatus.RUNNING, "modified", NodeStatus.SUCCEEDED
+    )
+    assert flow is not None and not flow.should_relaunch
+    # disallowed transition
+    assert (
+        get_node_state_flow(NodeStatus.SUCCEEDED, "modified",
+                            NodeStatus.RUNNING)
+        is None
+    )
+    # no-op transition
+    assert (
+        get_node_state_flow(NodeStatus.RUNNING, "modified",
+                            NodeStatus.RUNNING)
+        is None
+    )
+
+
+def test_context_singleton_and_override():
+    ctx = Context.singleton_instance()
+    assert ctx is Context.singleton_instance()
+    ctx.set_params_from_optimizer(
+        {"hang_detection_interval": 42, "custom_knob": "x"}
+    )
+    assert ctx.hang_detection_interval == 42
+    assert ctx.user_defined["custom_knob"] == "x"
+
+
+def test_priority_half_rule():
+    group = 4
+    nodes = []
+    for i in range(group):
+        n = Node(NodeType.WORKER, i, rank_index=i)
+        n.config_resource.priority = "half"
+        n.update_priority(group)
+        nodes.append(n)
+    assert [n.config_resource.priority for n in nodes] == [
+        "high", "high", "low", "low",
+    ]

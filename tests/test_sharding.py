@@ -1,0 +1,160 @@
+"""Tests for splitters, dataset managers, and the task manager.
+
+Mirrors reference tests dlrover/python/tests/test_dataset_splitter.py /
+test_task_manager.py patterns: pure in-memory, no cluster.
+"""
+
+import time
+
+from dlrover_tpu.common.constants import NodeType, TaskType
+from dlrover_tpu.master.shard.base_dataset_manager import (
+    DatasetShardCheckpoint,
+)
+from dlrover_tpu.master.shard.batch_dataset_manager import BatchDatasetManager
+from dlrover_tpu.master.shard.dataset_splitter import (
+    PartitionOffsets,
+    StreamingDatasetSplitter,
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+    new_dataset_splitter,
+)
+from dlrover_tpu.master.shard.task_manager import TaskManager
+
+
+def test_table_splitter_basic():
+    splitter = TableDatasetSplitter("ds", dataset_size=100, shard_size=30,
+                                    num_epochs=2)
+    assert splitter.create_shards()
+    shards = splitter.get_shards()
+    assert [s.start for s in shards] == [0, 30, 60, 90]
+    assert shards[-1].end == 100
+    assert splitter.epoch == 1
+    assert splitter.create_shards()  # epoch 2
+    assert not splitter.create_shards()  # exhausted
+    assert splitter.epoch_finished()
+
+
+def test_table_splitter_huge_dataset_lazy():
+    splitter = TableDatasetSplitter("big", dataset_size=100, shard_size=10,
+                                    num_epochs=1, max_shard_count=4)
+    assert splitter.create_shards()
+    assert len(splitter.get_shards()) == 4
+    assert splitter.create_shards()
+    assert len(splitter.get_shards()) == 4
+    assert splitter.create_shards()
+    assert len(splitter.get_shards()) == 2
+    assert not splitter.create_shards()
+
+
+def test_text_splitter_shuffle():
+    splitter = TextDatasetSplitter("txt", dataset_size=10, shard_size=4,
+                                   num_epochs=1, shuffle=True)
+    splitter.create_shards()
+    shards = splitter.get_shards()
+    all_indices = sorted(
+        i for s in shards for i in s.record_indices
+    )
+    assert all_indices == list(range(10))
+    assert len(shards) == 3
+
+
+def test_streaming_splitter_offsets():
+    po = PartitionOffsets({0: 100, 1: 200})
+    splitter = StreamingDatasetSplitter(
+        "stream", shard_size=50, partition_offsets=po,
+        dataset_size=-1, fetch_data_size=100,
+    )
+    assert splitter.create_shards()
+    shards = splitter.get_shards()
+    assert len(shards) == 4  # 2 partitions x 100/50
+    assert splitter.get_checkpoint_offsets() == {0: 200, 1: 300}
+
+
+def test_batch_manager_dispatch_and_report():
+    splitter = new_dataset_splitter(
+        shuffle=False, shard_size=10, dataset_size=30, num_epochs=1,
+        dataset_name="d",
+    )
+    mgr = BatchDatasetManager(TaskType.TRAINING, batch_size=5,
+                              dataset_splitter=splitter)
+    t0 = mgr.get_task(NodeType.WORKER, 0)
+    t1 = mgr.get_task(NodeType.WORKER, 1)
+    assert t0.exists if hasattr(t0, "exists") else t0.task_id >= 0
+    assert t0.task_id == 0 and t1.task_id == 1
+    ok, _ = mgr.report_task_status(t0.task_id, success=True)
+    assert ok
+    # failure requeues at the front
+    ok, _ = mgr.report_task_status(t1.task_id, success=False)
+    assert not ok
+    t1_again = mgr.get_task(NodeType.WORKER, 2)
+    assert t1_again.task_id == t1.task_id
+    assert mgr.get_completed_step() == 2  # 10 records / batch 5
+
+
+def test_batch_manager_node_failure_recovery():
+    splitter = new_dataset_splitter(
+        shuffle=False, shard_size=10, dataset_size=40, num_epochs=1,
+        dataset_name="d",
+    )
+    mgr = BatchDatasetManager(TaskType.TRAINING, 5, splitter)
+    mgr.get_task(NodeType.WORKER, 0)
+    mgr.get_task(NodeType.WORKER, 1)
+    recovered = mgr.recover_tasks_of_node(0)
+    assert len(recovered) == 1
+    assert len(mgr.doing) == 1
+
+
+def test_batch_manager_checkpoint_roundtrip():
+    splitter = new_dataset_splitter(
+        shuffle=False, shard_size=10, dataset_size=40, num_epochs=1,
+        dataset_name="d",
+    )
+    mgr = BatchDatasetManager(TaskType.TRAINING, 5, splitter)
+    mgr.get_task(NodeType.WORKER, 0)  # 1 doing
+    ckpt = mgr.checkpoint()
+    assert len(ckpt.doing) == 1
+    assert len(ckpt.todo) == 3
+    content = ckpt.to_json()
+
+    # restore into a fresh manager
+    splitter2 = new_dataset_splitter(
+        shuffle=False, shard_size=10, dataset_size=40, num_epochs=1,
+        dataset_name="d",
+    )
+    mgr2 = BatchDatasetManager(TaskType.TRAINING, 5, splitter2)
+    mgr2.restore_checkpoint(DatasetShardCheckpoint.from_json(content))
+    assert len(mgr2.todo) == 4  # doing shards restored to todo
+    assert not mgr2.doing
+
+
+def test_task_manager_end_to_end():
+    tm = TaskManager()
+    splitter = new_dataset_splitter(
+        shuffle=False, shard_size=10, dataset_size=20, num_epochs=1,
+        dataset_name="ds",
+    )
+    tm.new_dataset(batch_size=5, dataset_size=20, dataset_name="ds",
+                   dataset_splitter=splitter)
+    t = tm.get_dataset_task(NodeType.WORKER, 0, "ds")
+    assert t.task_id == 0
+    assert tm.report_dataset_task("ds", t.task_id, success=True)
+    t2 = tm.get_dataset_task(NodeType.WORKER, 0, "ds")
+    tm.recover_tasks(NodeType.WORKER, 0)
+    # recovered task can be fetched again
+    t3 = tm.get_dataset_task(NodeType.WORKER, 1, "ds")
+    assert t3.task_id == t2.task_id
+    assert tm.report_dataset_task("ds", t3.task_id, success=True)
+    assert tm.finished()
+
+
+def test_task_manager_shard_checkpoint():
+    tm = TaskManager()
+    splitter = new_dataset_splitter(
+        shuffle=False, shard_size=10, dataset_size=30, num_epochs=1,
+        dataset_name="ds",
+    )
+    tm.new_dataset(5, 30, "ds", splitter)
+    tm.get_dataset_task(NodeType.WORKER, 0, "ds")
+    ckpt = tm.get_dataset_checkpoint("ds")
+    assert ckpt is not None
+    assert tm.restore_dataset_from_checkpoint(ckpt.to_json())
