@@ -1,0 +1,376 @@
+"""Agent-side client for every master RPC.
+
+Parity reference: dlrover/python/elastic_agent/master_client.py:51
+(MasterClient, retry_grpc_request:28, build_master_client:466,
+GlobalMasterClient:479). Adds a LocalMasterClient fallback that serves the
+sharding protocol in-process when no master address is configured
+(reference LocalDataset behavior).
+"""
+
+import functools
+import os
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName, TaskType
+from dlrover_tpu.common.grpc_utils import GenericRpcClient
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def retry_rpc_request(func):
+    """Retry an RPC 10x with 6s backoff (parity: master_client.py:28)."""
+
+    @functools.wraps(func)
+    def wrapped(self, *args, **kwargs):
+        retry = 10
+        exception = None
+        for i in range(retry):
+            try:
+                return func(self, *args, **kwargs)
+            except Exception as e:
+                exception = e
+                time.sleep(6)
+                logger.warning(
+                    "Retry %d/%d for RPC %s: %s", i + 1, retry,
+                    func.__name__, e,
+                )
+        raise exception
+
+    return wrapped
+
+
+class MasterClient:
+    """One client instance per agent/worker process."""
+
+    def __init__(self, master_addr: str, node_id: int, node_type: str,
+                 timeout: float = 30.0):
+        self._client = GenericRpcClient(master_addr, timeout=timeout)
+        self._node_id = node_id
+        self._node_type = node_type
+        self.master_addr = master_addr
+
+    def _call(self, method: str, message):
+        return self._client.call(method, message)
+
+    def _fill(self, req: comm.BaseRequest):
+        req.node_id = self._node_id
+        req.node_type = self._node_type
+        return req
+
+    # ------------------------------------------------------------ sharding
+
+    @retry_rpc_request
+    def report_dataset_shard_params(
+        self, batch_size: int, num_epochs: int, dataset_size: int,
+        shuffle: bool, num_minibatches_per_shard: int, dataset_name: str,
+        task_type: str = TaskType.TRAINING, storage_type: str = "table",
+    ):
+        req = self._fill(comm.DatasetShardParams(
+            batch_size=batch_size, num_epochs=num_epochs,
+            dataset_size=dataset_size, shuffle=shuffle,
+            num_minibatches_per_shard=num_minibatches_per_shard,
+            dataset_name=dataset_name, task_type=task_type,
+            storage_type=storage_type,
+        ))
+        return self._call("report_dataset_shard_params", req)
+
+    def get_task(self, dataset_name: str) -> comm.Task:
+        req = self._fill(comm.TaskRequest(dataset_name=dataset_name))
+        return self._call("get_task", req)
+
+    @retry_rpc_request
+    def report_task_result(self, dataset_name: str, task_id: int,
+                           err_message: str = ""):
+        req = self._fill(comm.TaskResult(
+            dataset_name=dataset_name, task_id=task_id,
+            err_message=err_message,
+        ))
+        return self._call("report_task_result", req)
+
+    @retry_rpc_request
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        req = self._fill(
+            comm.ShardCheckpointRequest(dataset_name=dataset_name)
+        )
+        res = self._call("get_shard_checkpoint", req)
+        return res.content
+
+    @retry_rpc_request
+    def report_shard_checkpoint(self, content: str):
+        return self._call(
+            "report_shard_checkpoint", comm.ShardCheckpoint(content=content)
+        )
+
+    @retry_rpc_request
+    def get_dataset_epoch(self, dataset_name: str) -> int:
+        req = self._fill(comm.DatasetEpochRequest(dataset_name=dataset_name))
+        return self._call("get_dataset_epoch", req).epoch
+
+    # ---------------------------------------------------------- rendezvous
+
+    @retry_rpc_request
+    def report_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           waiting_timeout: float, node_unit: int,
+                           join_timeout: float = 600.0):
+        req = self._fill(comm.RendezvousParams(
+            min_nodes=min_nodes, max_nodes=max_nodes,
+            waiting_timeout=waiting_timeout, node_unit=node_unit,
+            joint_timeout=join_timeout,
+        ))
+        return self._call("report_rdzv_params", req)
+
+    def join_rendezvous(self, node_rank: int, local_world_size: int,
+                        rdzv_name: str = RendezvousName.TRAINING) -> int:
+        req = comm.JoinRendezvousRequest(
+            node_id=node_rank, node_type=self._node_type,
+            local_world_size=local_world_size, rdzv_name=rdzv_name,
+        )
+        return self._call("join_rendezvous", req).round
+
+    def get_comm_world(
+        self, rdzv_name: str, node_rank: int
+    ):
+        req = comm.CommWorldRequest(
+            node_id=node_rank, rdzv_name=rdzv_name
+        )
+        res = self._call("get_comm_world", req)
+        return res.rdzv_round, res.group, res.world
+
+    def num_nodes_waiting(
+        self, rdzv_name: str = RendezvousName.TRAINING
+    ) -> int:
+        req = self._fill(comm.WaitingNodeNumRequest(rdzv_name=rdzv_name))
+        try:
+            return self._call("num_nodes_waiting", req).waiting_num
+        except Exception as e:
+            logger.warning("num_nodes_waiting failed: %s", e)
+            return 0
+
+    def report_node_check_status(self, rdzv_round: int, normal: bool,
+                                 elapsed_time: float):
+        req = self._fill(comm.NodeCheckStatus(
+            rdzv_round=rdzv_round, normal=normal, elapsed_time=elapsed_time,
+        ))
+        return self._call("report_node_check_status", req)
+
+    def network_check_success(self):
+        req = self._fill(comm.NetworkReadyRequest())
+        res = self._call("network_check_success", req)
+        return res.success, res.reason
+
+    def get_fault_nodes(self) -> List[int]:
+        return self._call("get_fault_nodes", self._fill(comm.BaseRequest()))
+
+    def get_straggler_nodes(self) -> List[int]:
+        return self._call(
+            "get_straggler_nodes", self._fill(comm.BaseRequest())
+        )
+
+    # ------------------------------------------------------------- kv store
+
+    def kv_store_set(self, key: str, value: bytes):
+        return self._call(
+            "kv_store_set", comm.KVStoreSetRequest(key=key, value=value)
+        )
+
+    def kv_store_get(self, key: str) -> bytes:
+        return self._call(
+            "kv_store_get", comm.KVStoreGetRequest(key=key)
+        ).value
+
+    def kv_store_add(self, key: str, amount: int) -> int:
+        return self._call(
+            "kv_store_add", comm.KVStoreAddRequest(key=key, amount=amount)
+        ).value
+
+    # ---------------------------------------------------------- node status
+
+    @retry_rpc_request
+    def update_node_status(self, status: str, exit_reason: str = "",
+                           restart_count: int = 0):
+        req = self._fill(comm.NodeStatusRequest(
+            status=status, exit_reason=exit_reason,
+            restart_count=restart_count,
+        ))
+        return self._call("update_node_status", req)
+
+    @retry_rpc_request
+    def update_node_address(self, address: str):
+        req = self._fill(comm.NodeAddressRequest(address=address))
+        return self._call("update_node_address", req)
+
+    def report_heartbeat(self) -> str:
+        req = self._fill(comm.HeartBeat(timestamp=time.time()))
+        return self._call("report_heartbeat", req).action
+
+    def report_failure(self, error_data: str, level: str,
+                       restart_count: int = 0):
+        req = self._fill(comm.NodeFailure(
+            error_data=error_data, level=level, restart_count=restart_count,
+        ))
+        try:
+            return self._call("report_failure", req)
+        except Exception as e:
+            logger.warning("report_failure failed: %s", e)
+
+    def report_used_resource(self, cpu_percent: float, memory_mb: int,
+                             tpu_stats: Optional[List[Dict]] = None):
+        req = self._fill(comm.ResourceStats(
+            cpu_percent=cpu_percent, memory_mb=memory_mb,
+            tpu_stats=tpu_stats or [],
+        ))
+        return self._call("report_used_resource", req)
+
+    def query_running_nodes(self) -> List[Dict]:
+        req = self._fill(comm.RunningNodesRequest())
+        return self._call("query_running_nodes", req).nodes
+
+    # -------------------------------------------------------------- metrics
+
+    def report_global_step(self, step: int,
+                           timestamp: Optional[float] = None):
+        req = self._fill(comm.GlobalStep(
+            timestamp=timestamp or time.time(), step=step,
+        ))
+        return self._call("report_global_step", req)
+
+    def report_model_info(self, param_count: int, flops_per_step: float,
+                          batch_size: int, seq_len: int = 0,
+                          extra: Optional[Dict] = None):
+        req = self._fill(comm.ModelInfo(
+            param_count=param_count, flops_per_step=flops_per_step,
+            batch_size=batch_size, seq_len=seq_len, extra=extra or {},
+        ))
+        return self._call("report_model_info", req)
+
+    # ----------------------------------------------------------------- sync
+
+    def join_sync(self, sync_name: str) -> bool:
+        req = self._fill(comm.SyncJoin(sync_name=sync_name))
+        return self._call("join_sync", req).success
+
+    def sync_finished(self, sync_name: str) -> bool:
+        req = self._fill(comm.SyncFinish(sync_name=sync_name))
+        return self._call("sync_finished", req).success
+
+    def barrier(self, barrier_name: str, notify: bool = False) -> bool:
+        req = self._fill(comm.SyncBarrier(
+            barrier_name=barrier_name, notify=notify,
+        ))
+        return self._call("barrier", req).success
+
+    def get_elastic_run_config(self) -> Dict[str, str]:
+        req = self._fill(comm.ElasticRunConfigRequest())
+        return self._call("get_elastic_run_config", req).configs
+
+    def ping(self) -> bool:
+        try:
+            return self._call("ping", comm.BaseRequest()).success
+        except Exception:
+            return False
+
+    def close(self):
+        self._client.close()
+
+
+class LocalMasterClient:
+    """Masterless fallback serving the sharding protocol in-process
+    (parity: master_client.py LocalDataset path)."""
+
+    def __init__(self, node_id: int = 0,
+                 node_type: str = "worker"):
+        from dlrover_tpu.master.shard.task_manager import TaskManager
+
+        self._node_id = node_id
+        self._node_type = node_type
+        self._task_manager = TaskManager()
+        self._kv: Dict[str, bytes] = {}
+
+    def report_dataset_shard_params(self, batch_size, num_epochs,
+                                    dataset_size, shuffle,
+                                    num_minibatches_per_shard, dataset_name,
+                                    task_type=TaskType.TRAINING,
+                                    storage_type="table"):
+        splitter = __import__(
+            "dlrover_tpu.master.shard.dataset_splitter",
+            fromlist=["new_dataset_splitter"],
+        ).new_dataset_splitter(
+            shuffle=shuffle,
+            shard_size=batch_size * num_minibatches_per_shard,
+            dataset_size=dataset_size, num_epochs=num_epochs,
+            dataset_name=dataset_name, storage_type=storage_type,
+        )
+        self._task_manager.new_dataset(
+            batch_size, dataset_size, dataset_name, splitter, task_type
+        )
+
+    def get_task(self, dataset_name: str) -> comm.Task:
+        task = self._task_manager.get_dataset_task(
+            self._node_type, self._node_id, dataset_name
+        )
+        return comm.Task(
+            task_id=task.task_id, task_type=task.task_type,
+            shard=comm.Shard(
+                name=task.shard.name, start=task.shard.start,
+                end=task.shard.end, record_indices=task.shard.record_indices,
+            ),
+        )
+
+    def report_task_result(self, dataset_name, task_id, err_message=""):
+        self._task_manager.report_dataset_task(
+            dataset_name, task_id, not err_message
+        )
+
+    def get_dataset_epoch(self, dataset_name: str) -> int:
+        return self._task_manager.get_dataset_epoch(dataset_name)
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        ckpt = self._task_manager.get_dataset_checkpoint(dataset_name)
+        return ckpt.to_json() if ckpt else ""
+
+    def report_shard_checkpoint(self, content: str):
+        self._task_manager.restore_dataset_from_checkpoint(content)
+
+    def kv_store_set(self, key, value):
+        self._kv[key] = value
+
+    def kv_store_get(self, key):
+        return self._kv.get(key, b"")
+
+    def report_global_step(self, step, timestamp=None):
+        pass
+
+    def report_heartbeat(self):
+        return ""
+
+
+_master_client = None
+
+
+def build_master_client(master_addr: Optional[str] = None,
+                        node_id: Optional[int] = None,
+                        node_type: Optional[str] = None,
+                        timeout: float = 30.0):
+    """Build a (cached) master client from args or env
+    (parity: master_client.py:466)."""
+    global _master_client
+    master_addr = master_addr or os.getenv(NodeEnv.MASTER_ADDR, "")
+    if node_id is None:
+        node_id = int(os.getenv(NodeEnv.NODE_ID, "0"))
+    if node_type is None:
+        node_type = os.getenv(NodeEnv.NODE_TYPE, "worker")
+    if master_addr:
+        _master_client = MasterClient(
+            master_addr, node_id, node_type, timeout
+        )
+    else:
+        _master_client = LocalMasterClient(node_id, node_type)
+    return _master_client
+
+
+def get_master_client():
+    global _master_client
+    if _master_client is None:
+        _master_client = build_master_client()
+    return _master_client

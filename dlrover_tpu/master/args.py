@@ -1,0 +1,26 @@
+"""Master CLI args (parity: dlrover/python/master/args.py:19-95)."""
+
+import argparse
+
+
+def str2bool(v):
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "yes", "t", "y")
+
+
+def parse_master_args(argv=None):
+    parser = argparse.ArgumentParser(description="dlrover-tpu job master")
+    parser.add_argument("--port", type=int, default=0,
+                        help="gRPC port; 0 picks a free port")
+    parser.add_argument("--job_name", type=str, default="local-job")
+    parser.add_argument("--platform", type=str, default="local",
+                        choices=["local", "kubernetes", "tpu_vm"])
+    parser.add_argument("--distribution_strategy", type=str,
+                        default="allreduce")
+    parser.add_argument("--node_num", type=int, default=1,
+                        help="expected number of worker nodes (TPU hosts)")
+    parser.add_argument("--namespace", type=str, default="default")
+    parser.add_argument("--pending_timeout", type=int, default=900)
+    parser.add_argument("--relaunch_always", type=str2bool, default=False)
+    return parser.parse_args(argv)

@@ -1,0 +1,40 @@
+"""In-master KV store backing distributed bootstrap.
+
+Parity reference: dlrover/python/master/elastic_training/kv_store_service.py:18.
+In the TPU stack this KV store carries the jax.distributed coordinator
+address election (rank-0 agent writes, others read) instead of a torch
+TCPStore replacement.
+"""
+
+import threading
+from typing import Dict
+
+
+class KVStoreService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: Dict[str, bytes] = {}
+
+    def set(self, key: str, value: bytes):
+        with self._lock:
+            self._store[key] = value
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            return self._store.get(key, b"")
+
+    def add(self, key: str, amount: int) -> int:
+        """Atomic integer add (torch-Store-style counter semantics)."""
+        with self._lock:
+            cur = int(self._store.get(key, b"0") or b"0")
+            cur += amount
+            self._store[key] = str(cur).encode()
+            return cur
+
+    def delete(self, key: str):
+        with self._lock:
+            self._store.pop(key, None)
+
+    def clear(self):
+        with self._lock:
+            self._store.clear()

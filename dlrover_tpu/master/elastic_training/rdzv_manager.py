@@ -1,0 +1,288 @@
+"""Master-side rendezvous for elastic TPU training.
+
+Parity reference: dlrover/python/master/elastic_training/rdzv_manager.py:52
+(RendezvousManager, _check_rdzv_completed:106, ElasticTrainingRendezvousManager
+:205, NetworkCheckRendezvousManager:249, _group_nodes:294).
+
+TPU shape: a "node" is one TPU host (TPU-VM worker). The comm world the
+manager hands back maps node_rank -> local accelerator-process count; agents
+turn it into ``jax.distributed.initialize(coordinator_addr, num_processes,
+process_id)``. ``node_unit`` maps to the slice granularity — an ICI-connected
+slice only functions with all its hosts present, so worlds are truncated to
+multiples of node_unit exactly like the reference truncates allreduce worlds.
+"""
+
+import math
+import time
+from abc import ABC, abstractmethod
+from threading import Lock
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import NetworkFailureReason
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class RendezvousParameters:
+    def __init__(self, min_nodes: int = 1, max_nodes: int = 1,
+                 waiting_timeout: float = 30.0, node_unit: int = 1,
+                 join_timeout: float = 600.0):
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.waiting_timeout = waiting_timeout
+        self.node_unit = max(1, node_unit)
+        self.join_timeout = join_timeout
+
+
+class RendezvousManager(ABC):
+    """Tracks waiting nodes and decides when a round completes."""
+
+    def __init__(self):
+        self._lock = Lock()
+        self._alive_nodes = set()
+        self._waiting_nodes: Dict[int, int] = {}  # node_rank -> local procs
+        self._rdzv_nodes: Dict[int, int] = {}  # the latest completed world
+        self._lastcall_time = 0.0
+        self._rdzv_params = RendezvousParameters()
+        self._rdzv_round = 0
+        self._node_unit = 1
+        self._start_rdzv_ts = 0.0
+        self._latest_rdzv_nodes: List[int] = []
+        self._start_waiting_ts = 0.0
+
+    def update_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           waiting_timeout: float, node_unit: int,
+                           join_timeout: float = 600.0):
+        with self._lock:
+            self._rdzv_params = RendezvousParameters(
+                min_nodes, max_nodes, waiting_timeout, node_unit,
+                join_timeout,
+            )
+            self._node_unit = max(1, node_unit)
+            logger.info(
+                "Rendezvous params: min=%d max=%d timeout=%s node_unit=%d",
+                min_nodes, max_nodes, waiting_timeout, node_unit,
+            )
+
+    def get_rdzv_round(self) -> int:
+        return self._rdzv_round
+
+    def add_alive_node(self, node_id: int):
+        self._alive_nodes.add(node_id)
+
+    def remove_alive_node(self, node_id: int):
+        self._alive_nodes.discard(node_id)
+        with self._lock:
+            if node_id in self._waiting_nodes:
+                del self._waiting_nodes[node_id]
+
+    def join_rendezvous(self, node_rank: int, local_world_size: int) -> int:
+        """A node (TPU host agent) joins the next round; returns round."""
+        with self._lock:
+            if not self._waiting_nodes:
+                self._start_rdzv_ts = time.time()
+            if node_rank not in self._waiting_nodes:
+                self._waiting_nodes[node_rank] = local_world_size
+                self._lastcall_time = time.time()
+        return self._rdzv_round
+
+    def num_nodes_waiting(self) -> int:
+        """Number of nodes waiting for a NEW round. Nonzero signals running
+        agents to re-rendezvous (membership change)."""
+        with self._lock:
+            # only report waiting nodes once a completed world exists and the
+            # waiting set differs from it (new node or node loss)
+            if self._rdzv_nodes and set(self._waiting_nodes) != set(
+                self._rdzv_nodes
+            ):
+                return len(self._waiting_nodes)
+            if not self._rdzv_nodes:
+                return len(self._waiting_nodes)
+            return 0
+
+    def _check_rdzv_completed(self) -> bool:
+        """Completion rule (parity: rdzv_manager.py:106): complete when
+        max_nodes joined, or min_nodes joined and waiting_timeout elapsed
+        since last join; truncate world to a node_unit multiple."""
+        p = self._rdzv_params
+        n = len(self._waiting_nodes)
+        if n >= p.max_nodes:
+            return True
+        if n >= p.min_nodes:
+            if time.time() - self._lastcall_time >= p.waiting_timeout:
+                # keep only a node_unit multiple
+                keep = (n // self._node_unit) * self._node_unit
+                if keep < p.min_nodes or keep == 0:
+                    return False
+                ranks = sorted(self._waiting_nodes)[:keep]
+                self._waiting_nodes = {
+                    r: self._waiting_nodes[r] for r in ranks
+                }
+                return True
+        return False
+
+    @abstractmethod
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int]]:
+        """Return (round, group, world) — world empty if round incomplete."""
+
+    def report_network_check_result(self, node_rank: int, normal: bool,
+                                    elapsed: float):
+        pass
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    """The training rendezvous (parity: rdzv_manager.py:205)."""
+
+    def get_comm_world(self, node_rank):
+        with self._lock:
+            if not self._rdzv_nodes or set(self._waiting_nodes) != set(
+                self._rdzv_nodes
+            ):
+                if self._check_rdzv_completed():
+                    self._rdzv_round += 1
+                    self._rdzv_nodes = dict(sorted(
+                        self._waiting_nodes.items()
+                    ))
+                    self._latest_rdzv_nodes = list(self._rdzv_nodes)
+                    self._waiting_nodes = {}
+                    logger.info(
+                        "Rendezvous round %d complete: nodes %s",
+                        self._rdzv_round, list(self._rdzv_nodes),
+                    )
+                    return self._rdzv_round, 0, self._rdzv_nodes
+            if node_rank in self._rdzv_nodes:
+                return self._rdzv_round, 0, self._rdzv_nodes
+            return self._rdzv_round, 0, {}
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Pre-flight network check rendezvous (parity: rdzv_manager.py:249).
+
+    Round 0 pairs nodes {0,1},{2,3},... so each pair runs an allgather probe
+    over ICI/DCN; round 1 pairs each abnormal node with a known-good one to
+    localize whether the fault is the node itself.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._node_status: Dict[int, bool] = {}
+        self._node_times: Dict[int, float] = {}
+        self._reported_nodes = set()
+        self._node_groups: List[Dict[int, int]] = []
+        self._check_round = 2
+
+    def get_comm_world(self, node_rank):
+        with self._lock:
+            if not self._node_groups or set(self._waiting_nodes) == set(
+                self._rdzv_nodes
+            ):
+                pass
+            if self._check_rdzv_completed_nolock():
+                self._rdzv_round += 1
+                self._rdzv_nodes = dict(sorted(self._waiting_nodes.items()))
+                self._node_groups = self._group_nodes(self._rdzv_round)
+                logger.info(
+                    "Network-check round %d groups: %s",
+                    self._rdzv_round, self._node_groups,
+                )
+                self._waiting_nodes = {}
+                self._reported_nodes = set()
+            for group_idx, group in enumerate(self._node_groups):
+                if node_rank in group:
+                    return self._rdzv_round, group_idx, group
+            return self._rdzv_round, 0, {}
+
+    def _check_rdzv_completed_nolock(self) -> bool:
+        if not self._waiting_nodes:
+            return False
+        p = self._rdzv_params
+        n = len(self._waiting_nodes)
+        if n >= p.max_nodes:
+            return True
+        return (
+            n >= p.min_nodes
+            and time.time() - self._lastcall_time >= p.waiting_timeout
+        )
+
+    def _group_nodes(self, round_num: int) -> List[Dict[int, int]]:
+        """Pairwise grouping (parity: rdzv_manager.py:294)."""
+        round_idx = (round_num - 1) % self._check_round
+        node_groups: List[Dict[int, int]] = []
+        ranks = sorted(self._waiting_nodes)
+        if round_idx == 0:
+            cur: Dict[int, int] = {}
+            for r in ranks:
+                cur[r] = self._waiting_nodes[r]
+                if len(cur) == 2:
+                    node_groups.append(cur)
+                    cur = {}
+            if cur:
+                if node_groups:
+                    node_groups[-1].update(cur)
+                else:
+                    node_groups.append(cur)
+        else:
+            abnormal = [
+                r for r in ranks if not self._node_status.get(r, True)
+            ]
+            normal = [r for r in ranks if self._node_status.get(r, True)]
+            used_normal = []
+            for a in abnormal:
+                if normal:
+                    n0 = normal.pop(0)
+                    used_normal.append(n0)
+                    node_groups.append({
+                        a: self._waiting_nodes[a],
+                        n0: self._waiting_nodes[n0],
+                    })
+            leftover = {
+                r: self._waiting_nodes[r]
+                for r in normal
+            }
+            if leftover:
+                node_groups.append(leftover)
+        return node_groups
+
+    def report_network_check_result(self, node_rank: int, normal: bool,
+                                    elapsed: float):
+        with self._lock:
+            self._reported_nodes.add(node_rank)
+            # latest round wins: a node that failed round 0 but passes the
+            # round-1 re-pair with a known-good partner is healthy (its round-0
+            # partner was the broken one)
+            self._node_status[node_rank] = normal
+            self._node_times[node_rank] = elapsed
+
+    def network_check_success(self) -> Tuple[bool, str]:
+        """Decide overall health and localize broken nodes
+        (parity: rdzv_manager.py:368)."""
+        with self._lock:
+            if len(self._reported_nodes) < len(self._rdzv_nodes):
+                return False, NetworkFailureReason.WAITING_NODE
+            if not self._node_status:
+                return False, NetworkFailureReason.NO_INIT
+            if all(self._node_status.get(r, False)
+                   for r in self._rdzv_nodes):
+                return True, ""
+            return False, NetworkFailureReason.NODE_FAILURE
+
+    def get_fault_nodes(self) -> List[int]:
+        with self._lock:
+            return [
+                r for r in self._rdzv_nodes
+                if not self._node_status.get(r, True)
+            ]
+
+    def get_straggler_nodes(self, ratio: float = 2.0) -> List[int]:
+        """Nodes whose probe time exceeds ratio x median."""
+        with self._lock:
+            if not self._node_times:
+                return []
+            times = sorted(self._node_times.values())
+            median = times[len(times) // 2]
+            if median <= 0:
+                return []
+            return [
+                r for r, t in self._node_times.items() if t > ratio * median
+            ]

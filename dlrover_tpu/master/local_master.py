@@ -1,0 +1,85 @@
+"""Standalone job master: servicer + task manager + rendezvous in one process.
+
+Parity reference: dlrover/python/master/local_master.py:37 (LocalJobMaster).
+Used both by ``--standalone`` launches (subprocess) and by tests as an
+in-process fixture with real loopback gRPC (the reference's
+start_local_master pattern, dlrover/python/tests/test_utils.py:256).
+"""
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.constants import JobExitReason, RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.elastic_training.sync_service import SyncService
+from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.node.local_job_manager import LocalJobManager
+from dlrover_tpu.master.servicer import create_master_service
+from dlrover_tpu.master.shard.task_manager import TaskManager
+
+
+class LocalJobMaster:
+    def __init__(self, port: int = 0, job_args=None):
+        self.speed_monitor = SpeedMonitor()
+        self.job_manager = LocalJobManager(
+            job_args=job_args, speed_monitor=self.speed_monitor
+        )
+        self.task_manager = TaskManager(speed_monitor=self.speed_monitor)
+        self.rdzv_managers = {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        self.sync_service = SyncService(self.job_manager)
+        self.error_monitor = ErrorMonitor()
+        self._server, self.servicer = create_master_service(
+            port,
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            speed_monitor=self.speed_monitor,
+            rdzv_managers=self.rdzv_managers,
+            sync_service=self.sync_service,
+            error_monitor=self.error_monitor,
+        )
+        self.port = self._server.port
+        self._exit_code = 0
+        self._exit_reason = ""
+
+    @property
+    def addr(self) -> str:
+        return f"localhost:{self.port}"
+
+    def prepare(self):
+        self.job_manager.start()
+        self.task_manager.start()
+        self._server.start()
+        logger.info("Local master serving on port %d", self.port)
+
+    def run(self, check_interval: float = 3.0) -> int:
+        """Block until all workers exit or all tasks complete."""
+        try:
+            while True:
+                if self.job_manager.all_workers_exited():
+                    if self.job_manager.all_workers_failed():
+                        self._exit_code = 1
+                        self._exit_reason = JobExitReason.UNKNOWN_ERROR
+                    break
+                if self.task_manager.finished():
+                    logger.info("All data tasks finished; stopping master")
+                    break
+                time.sleep(check_interval)
+        except KeyboardInterrupt:
+            logger.info("Master interrupted")
+        finally:
+            self.stop()
+        return self._exit_code
+
+    def stop(self):
+        self.task_manager.stop()
+        self.job_manager.stop()
+        self._server.stop(grace=1.0)

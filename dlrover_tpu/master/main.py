@@ -1,0 +1,41 @@
+"""Master entry (parity: dlrover/python/master/main.py:36).
+
+Local platform -> LocalJobMaster; kubernetes/tpu_vm -> DistributedJobMaster.
+"""
+
+import sys
+import types
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.args import parse_master_args
+
+
+def run(args) -> int:
+    job_args = types.SimpleNamespace(
+        job_name=args.job_name,
+        node_num=args.node_num,
+        platform=args.platform,
+        distribution_strategy=args.distribution_strategy,
+    )
+    if args.platform == "local":
+        from dlrover_tpu.master.local_master import LocalJobMaster
+
+        master = LocalJobMaster(port=args.port, job_args=job_args)
+    else:
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+
+        master = DistributedJobMaster(port=args.port, job_args=job_args)
+    master.prepare()
+    # print the bound port so a parent launcher can discover it
+    print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)
+    return master.run()
+
+
+def main(argv=None) -> int:
+    args = parse_master_args(argv)
+    logger.info("Starting master: %s", vars(args))
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

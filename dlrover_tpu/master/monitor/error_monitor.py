@@ -1,0 +1,41 @@
+"""Error-log monitor: classify worker-reported failures.
+
+Parity reference: dlrover/python/master/monitor/error_monitor.py:31.
+"""
+
+from dlrover_tpu.common.constants import TrainingExceptionLevel
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class ErrorMonitor:
+    def __init__(self):
+        self._restart_errors = {}
+
+    def process_error(self, node, restart_count: int, error_data: str,
+                      level: str) -> bool:
+        """Returns True if the error is critical (node should not relaunch)."""
+        if level == TrainingExceptionLevel.PROCESS_ERROR:
+            return self._handle_process_error(node, restart_count, error_data)
+        if level == TrainingExceptionLevel.NODE_ERROR:
+            logger.error("Node error on %s: %s", node, error_data)
+            return True
+        if level == TrainingExceptionLevel.RDZV_ERROR:
+            logger.error("Rendezvous error: %s", error_data)
+        elif level == TrainingExceptionLevel.WARNING:
+            logger.warning("Worker warning: %s", error_data)
+        else:
+            logger.info("Worker report: %s", error_data)
+        return False
+
+    def _handle_process_error(self, node, restart_count: int,
+                              error_data: str) -> bool:
+        node_key = getattr(node, "id", node)
+        prev = self._restart_errors.get(node_key)
+        self._restart_errors[node_key] = (restart_count, error_data)
+        if prev and prev[0] == restart_count:
+            return False  # duplicate report of the same restart
+        logger.error(
+            "Process error on node %s (restart %d): %s",
+            node_key, restart_count, error_data,
+        )
+        return False

@@ -1,0 +1,112 @@
+"""Global-step throughput monitor.
+
+Parity reference: dlrover/python/master/monitor/speed_monitor.py:43
+(GlobalStepRecord, collect_global_step:81, running_speed:113).
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from dlrover_tpu.common.global_context import Context
+
+_context = Context.singleton_instance()
+
+
+@dataclass
+class GlobalStepRecord:
+    global_step: int
+    timestamp: float
+    worker_num: int
+
+
+class SpeedMonitor:
+    """Sliding window of global-step records -> running speed (steps/s)."""
+
+    def __init__(self):
+        self._global_step_records: List[GlobalStepRecord] = []
+        self._workers: Set[Tuple[str, int]] = set()
+        self._max_record_count = _context.train_speed_record_num
+        self._global_step = 0
+        self._target_worker_num = 0
+        self._init_time = time.time()
+        self._start_training_time: Optional[float] = None
+        self._sample_count = 0
+        self._task_completed_times: Dict[int, float] = {}
+
+    def set_target_worker_num(self, worker_num: int):
+        self._target_worker_num = worker_num
+
+    def reduce_target_worker_num(self, workers):
+        num = len([w for w in workers if w in self._workers])
+        self._target_worker_num -= num
+
+    def add_running_worker(self, node_type: str, node_id: int):
+        self._workers.add((node_type, node_id))
+
+    def remove_running_worker(self, node_type: str, node_id: int):
+        self._workers.discard((node_type, node_id))
+
+    @property
+    def running_workers(self):
+        return self._workers
+
+    def set_start_timestamp(self):
+        if self._global_step == 0 and not self._start_training_time:
+            self._start_training_time = time.time()
+
+    @property
+    def start_training_time(self):
+        return self._start_training_time or 0
+
+    @property
+    def completed_global_step(self):
+        return self._global_step
+
+    def collect_global_step(self, global_step: int, timestamp: float):
+        self._global_step = max(self._global_step, global_step)
+        if not self._start_training_time:
+            self._start_training_time = time.time()
+        self._global_step_records.append(
+            GlobalStepRecord(global_step, timestamp, len(self._workers))
+        )
+        self._sample_count += 1
+        if len(self._global_step_records) > self._max_record_count:
+            self._global_step_records.pop(0)
+
+    def running_speed(self) -> float:
+        """Steps/sec over the last two records (0 if insufficient data)."""
+        if len(self._global_step_records) < 2:
+            return 0.0
+        last, prev = (
+            self._global_step_records[-1],
+            self._global_step_records[-2],
+        )
+        dt = last.timestamp - prev.timestamp
+        if dt <= 0:
+            return 0.0
+        return (last.global_step - prev.global_step) / dt
+
+    def worker_adjustment_finished(self) -> bool:
+        """All target workers present and speed samples collected since."""
+        if not self._global_step_records:
+            return False
+        worker_num = self._global_step_records[-1].worker_num
+        if worker_num != self._target_worker_num:
+            return False
+        sample_count = _context.train_speed_record_num
+        records = self._global_step_records
+        if len(records) < sample_count:
+            return False
+        return all(
+            r.worker_num == worker_num for r in records[-sample_count:]
+        )
+
+    def add_task_completed(self, node_id: int, elapsed: float):
+        self._task_completed_times[node_id] = elapsed
+
+    def all_worker_joined(self) -> bool:
+        return (
+            self._target_worker_num > 0
+            and len(self._workers) >= self._target_worker_num
+        )

@@ -1,0 +1,343 @@
+"""Master RPC servicer — the only wire interface to workers.
+
+Parity reference: dlrover/python/master/servicer.py:62 (MasterServicer, ~35
+RPCs; create_master_service:478). Transport is the proto-less generic gRPC
+envelope (common/grpc_utils.py); each public ``rpc_*`` method here is one
+RPC from the reference service (elastic_training.proto:243-299).
+"""
+
+import time
+from typing import Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import (
+    NodeType,
+    RendezvousName,
+    TaskType,
+)
+from dlrover_tpu.common.grpc_utils import GenericRpcServer
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.elastic_training.kv_store_service import (
+    KVStoreService,
+)
+from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
+
+
+class MasterServicer:
+    """Dispatches RPCs to master components."""
+
+    def __init__(
+        self,
+        task_manager=None,
+        job_manager=None,
+        speed_monitor=None,
+        rdzv_managers=None,
+        sync_service=None,
+        error_monitor=None,
+        job_metric_collector=None,
+    ):
+        self._task_manager = task_manager
+        self._job_manager = job_manager
+        self._speed_monitor = speed_monitor
+        self._rdzv_managers = rdzv_managers or {}
+        self._sync_service = sync_service
+        self._error_monitor = error_monitor
+        self._job_metric_collector = job_metric_collector
+        self._kv_store = KVStoreService()
+        self._start_training_time = 0.0
+        self.run_configs = {}
+
+    # ------------------------------------------------------------- dispatch
+
+    def handle(self, method: str, message):
+        fn = getattr(self, f"rpc_{method}", None)
+        if fn is None:
+            raise ValueError(f"unknown RPC method {method}")
+        return fn(message)
+
+    # ------------------------------------------------------------ sharding
+
+    def rpc_report_dataset_shard_params(
+        self, req: comm.DatasetShardParams
+    ) -> comm.Response:
+        splitter = new_dataset_splitter(
+            shuffle=req.shuffle,
+            shard_size=req.batch_size * req.num_minibatches_per_shard,
+            dataset_size=req.dataset_size,
+            num_epochs=req.num_epochs,
+            dataset_name=req.dataset_name,
+            storage_type=req.storage_type,
+        )
+        self._task_manager.new_dataset(
+            batch_size=req.batch_size,
+            dataset_size=req.dataset_size,
+            dataset_name=req.dataset_name,
+            dataset_splitter=splitter,
+            task_type=req.task_type or TaskType.TRAINING,
+        )
+        if self._job_metric_collector and req.task_type == TaskType.TRAINING:
+            self._job_metric_collector.collect_dataset_metric(
+                req.dataset_name, req.dataset_size
+            )
+        return comm.Response(success=True)
+
+    def rpc_get_task(self, req: comm.TaskRequest) -> comm.Task:
+        if not self._start_training_time:
+            self._start_training_time = time.time()
+            if self._speed_monitor:
+                self._speed_monitor.set_start_timestamp()
+        task = self._task_manager.get_dataset_task(
+            req.node_type, req.node_id, req.dataset_name
+        )
+        shard = comm.Shard(
+            name=task.shard.name,
+            start=task.shard.start,
+            end=task.shard.end,
+            record_indices=task.shard.record_indices,
+        )
+        return comm.Task(
+            task_id=task.task_id, task_type=task.task_type, shard=shard
+        )
+
+    def rpc_report_task_result(self, req: comm.TaskResult) -> comm.Response:
+        success = not req.err_message
+        try:
+            self._task_manager.report_dataset_task(
+                req.dataset_name, req.task_id, success, req.err_message
+            )
+        except ValueError as e:
+            return comm.Response(success=False, reason=str(e))
+        return comm.Response(success=True)
+
+    def rpc_get_shard_checkpoint(
+        self, req: comm.ShardCheckpointRequest
+    ) -> comm.ShardCheckpoint:
+        ckpt = self._task_manager.get_dataset_checkpoint(req.dataset_name)
+        return comm.ShardCheckpoint(content=ckpt.to_json() if ckpt else "")
+
+    def rpc_report_shard_checkpoint(
+        self, req: comm.ShardCheckpoint
+    ) -> comm.Response:
+        ok = self._task_manager.restore_dataset_from_checkpoint(req.content)
+        return comm.Response(success=ok)
+
+    def rpc_get_dataset_epoch(
+        self, req: comm.DatasetEpochRequest
+    ) -> comm.DatasetEpoch:
+        return comm.DatasetEpoch(
+            epoch=self._task_manager.get_dataset_epoch(req.dataset_name)
+        )
+
+    # ----------------------------------------------------------- rendezvous
+
+    def rpc_report_rdzv_params(
+        self, req: comm.RendezvousParams
+    ) -> comm.Response:
+        for mgr in self._rdzv_managers.values():
+            mgr.update_rdzv_params(
+                req.min_nodes, req.max_nodes, req.waiting_timeout,
+                req.node_unit, req.joint_timeout,
+            )
+        return comm.Response(success=True)
+
+    def rpc_join_rendezvous(
+        self, req: comm.JoinRendezvousRequest
+    ) -> comm.RendezvousRound:
+        mgr = self._rdzv_managers.get(
+            req.rdzv_name or RendezvousName.TRAINING
+        )
+        round_ = mgr.join_rendezvous(req.node_id, req.local_world_size)
+        return comm.RendezvousRound(round=round_)
+
+    def rpc_get_comm_world(self, req: comm.CommWorldRequest) -> comm.CommWorld:
+        mgr = self._rdzv_managers.get(
+            req.rdzv_name or RendezvousName.TRAINING
+        )
+        rdzv_round, group, world = mgr.get_comm_world(req.node_id)
+        return comm.CommWorld(
+            rdzv_round=rdzv_round, group=group, world=world
+        )
+
+    def rpc_num_nodes_waiting(
+        self, req: comm.WaitingNodeNumRequest
+    ) -> comm.WaitingNodeNum:
+        mgr = self._rdzv_managers.get(
+            req.rdzv_name or RendezvousName.TRAINING
+        )
+        return comm.WaitingNodeNum(waiting_num=mgr.num_nodes_waiting())
+
+    def rpc_report_node_check_status(
+        self, req: comm.NodeCheckStatus
+    ) -> comm.Response:
+        mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if mgr:
+            mgr.report_network_check_result(
+                req.node_id, req.normal, req.elapsed_time
+            )
+        return comm.Response(success=True)
+
+    def rpc_network_check_success(
+        self, req: comm.NetworkReadyRequest
+    ) -> comm.NetworkCheckResult:
+        mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if not mgr:
+            return comm.NetworkCheckResult(success=True)
+        success, reason = mgr.network_check_success()
+        return comm.NetworkCheckResult(success=success, reason=reason)
+
+    def rpc_get_fault_nodes(self, req: comm.BaseRequest):
+        mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        return mgr.get_fault_nodes() if mgr else []
+
+    def rpc_get_straggler_nodes(self, req: comm.BaseRequest):
+        mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        return mgr.get_straggler_nodes() if mgr else []
+
+    # ------------------------------------------------------------- kv store
+
+    def rpc_kv_store_set(self, req: comm.KVStoreSetRequest) -> comm.Response:
+        self._kv_store.set(req.key, req.value)
+        return comm.Response(success=True)
+
+    def rpc_kv_store_get(self, req: comm.KVStoreGetRequest) -> comm.KVStoreValue:
+        return comm.KVStoreValue(value=self._kv_store.get(req.key))
+
+    def rpc_kv_store_add(self, req: comm.KVStoreAddRequest) -> comm.KVStoreAddResult:
+        return comm.KVStoreAddResult(
+            value=self._kv_store.add(req.key, req.amount)
+        )
+
+    # ---------------------------------------------------------- node status
+
+    def rpc_update_node_status(
+        self, req: comm.NodeStatusRequest
+    ) -> comm.Response:
+        if self._job_manager:
+            self._job_manager.update_node_status(
+                req.node_type, req.node_id, req.status, req.exit_reason,
+                req.restart_count,
+            )
+        for mgr in self._rdzv_managers.values():
+            if req.status in ("succeeded", "failed", "deleted"):
+                mgr.remove_alive_node(req.node_id)
+        return comm.Response(success=True)
+
+    def rpc_update_node_address(
+        self, req: comm.NodeAddressRequest
+    ) -> comm.Response:
+        if self._job_manager:
+            self._job_manager.update_node_service_addr(
+                req.node_type, req.node_id, req.address
+            )
+        return comm.Response(success=True)
+
+    def rpc_report_heartbeat(self, req: comm.HeartBeat) -> comm.HeartbeatResponse:
+        action = ""
+        if self._job_manager:
+            action = self._job_manager.collect_node_heartbeat(
+                req.node_type, req.node_id, req.timestamp
+            ) or ""
+        return comm.HeartbeatResponse(action=action)
+
+    def rpc_report_failure(self, req: comm.NodeFailure) -> comm.Response:
+        node = None
+        if self._job_manager:
+            node = self._job_manager.get_node(req.node_type, req.node_id)
+        if self._error_monitor:
+            self._error_monitor.process_error(
+                node or req.node_id, req.restart_count, req.error_data,
+                req.level,
+            )
+        return comm.Response(success=True)
+
+    def rpc_report_used_resource(self, req: comm.ResourceStats) -> comm.Response:
+        if self._job_manager:
+            self._job_manager.update_node_resource_usage(
+                req.node_type, req.node_id, req.cpu_percent, req.memory_mb,
+                req.tpu_stats,
+            )
+        return comm.Response(success=True)
+
+    def rpc_query_running_nodes(
+        self, req: comm.RunningNodesRequest
+    ) -> comm.RunningNodes:
+        nodes = []
+        if self._job_manager:
+            for node in self._job_manager.get_all_nodes():
+                nodes.append(node.to_dict())
+        return comm.RunningNodes(nodes=nodes)
+
+    # -------------------------------------------------------------- metrics
+
+    def rpc_report_global_step(self, req: comm.GlobalStep) -> comm.Response:
+        if self._speed_monitor:
+            self._speed_monitor.collect_global_step(req.step, req.timestamp)
+        if self._job_metric_collector:
+            self._job_metric_collector.collect_runtime_stats(
+                self._speed_monitor,
+                self._job_manager.get_running_nodes()
+                if self._job_manager else [],
+            )
+        return comm.Response(success=True)
+
+    def rpc_report_model_info(self, req: comm.ModelInfo) -> comm.Response:
+        if self._job_metric_collector:
+            self._job_metric_collector.collect_model_metric(req)
+        return comm.Response(success=True)
+
+    # ----------------------------------------------------------------- sync
+
+    def rpc_join_sync(self, req: comm.SyncJoin) -> comm.Response:
+        ok = self._sync_service.join_sync(
+            req.sync_name, req.node_type, req.node_id
+        )
+        return comm.Response(success=ok)
+
+    def rpc_sync_finished(self, req: comm.SyncFinish) -> comm.Response:
+        return comm.Response(
+            success=self._sync_service.sync_finished(req.sync_name)
+        )
+
+    def rpc_barrier(self, req: comm.SyncBarrier) -> comm.Response:
+        if req.notify:
+            return comm.Response(
+                success=self._sync_service.notify_barrier(req.barrier_name)
+            )
+        return comm.Response(
+            success=self._sync_service.barrier(req.barrier_name)
+        )
+
+    # ---------------------------------------------------------------- misc
+
+    def rpc_get_elastic_run_config(
+        self, req: comm.ElasticRunConfigRequest
+    ) -> comm.ElasticRunConfig:
+        return comm.ElasticRunConfig(configs=dict(self.run_configs))
+
+    def rpc_ping(self, req) -> comm.Response:
+        return comm.Response(success=True)
+
+
+def create_master_service(
+    port: int,
+    task_manager=None,
+    job_manager=None,
+    speed_monitor=None,
+    rdzv_managers=None,
+    sync_service=None,
+    error_monitor=None,
+    job_metric_collector=None,
+):
+    """Build the gRPC server around a MasterServicer
+    (parity: servicer.py:478)."""
+    servicer = MasterServicer(
+        task_manager=task_manager,
+        job_manager=job_manager,
+        speed_monitor=speed_monitor,
+        rdzv_managers=rdzv_managers,
+        sync_service=sync_service,
+        error_monitor=error_monitor,
+        job_metric_collector=job_metric_collector,
+    )
+    server = GenericRpcServer(servicer.handle, port=port)
+    return server, servicer

@@ -1,0 +1,180 @@
+"""In-process master + real loopback gRPC tests.
+
+Mirrors reference fixture start_local_master
+(dlrover/python/tests/test_utils.py:256) — the standard pattern for
+client/agent tests.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import (
+    LocalMasterClient,
+    MasterClient,
+    build_master_client,
+)
+from dlrover_tpu.common.constants import (
+    NodeStatus,
+    NodeType,
+    RendezvousName,
+)
+from dlrover_tpu.master.local_master import LocalJobMaster
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(port=0)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def client(master):
+    c = MasterClient(master.addr, node_id=0, node_type=NodeType.WORKER)
+    yield c
+    c.close()
+
+
+def test_ping(client):
+    assert client.ping()
+
+
+def test_sharding_protocol_over_grpc(master, client):
+    client.report_dataset_shard_params(
+        batch_size=5, num_epochs=1, dataset_size=30, shuffle=False,
+        num_minibatches_per_shard=2, dataset_name="ds",
+    )
+    task = client.get_task("ds")
+    assert task.task_id == 0
+    assert task.shard.end - task.shard.start == 10
+    client.report_task_result("ds", task.task_id)
+    # checkpoint roundtrip over the wire
+    content = client.get_shard_checkpoint("ds")
+    assert content
+    assert client.report_shard_checkpoint(content).success
+    assert client.get_dataset_epoch("ds") == 1
+
+
+def test_rendezvous_over_grpc(master, client):
+    client.report_rdzv_params(
+        min_nodes=2, max_nodes=2, waiting_timeout=1.0, node_unit=1
+    )
+    c1 = MasterClient(master.addr, node_id=1, node_type=NodeType.WORKER)
+    client.join_rendezvous(0, 4)
+    c1.join_rendezvous(1, 4)
+    rdzv_round, group, world = client.get_comm_world(
+        RendezvousName.TRAINING, 0
+    )
+    assert world == {0: 4, 1: 4}
+    # the second node sees the same world
+    _, _, world1 = c1.get_comm_world(RendezvousName.TRAINING, 1)
+    assert world1 == world
+    assert client.num_nodes_waiting(RendezvousName.TRAINING) == 0
+    # a third node joins -> waiting num becomes visible (membership change)
+    c2 = MasterClient(master.addr, node_id=2, node_type=NodeType.WORKER)
+    c2.join_rendezvous(2, 4)
+    assert client.num_nodes_waiting(RendezvousName.TRAINING) == 1
+    c1.close()
+    c2.close()
+
+
+def test_node_unit_truncation(master):
+    """Worlds truncate to node_unit multiples (slice granularity)."""
+    clients = [
+        MasterClient(master.addr, node_id=i, node_type=NodeType.WORKER)
+        for i in range(3)
+    ]
+    clients[0].report_rdzv_params(
+        min_nodes=2, max_nodes=4, waiting_timeout=0.5, node_unit=2
+    )
+    for i, c in enumerate(clients):
+        c.join_rendezvous(i, 1)
+    time.sleep(0.6)
+    _, _, world = clients[0].get_comm_world(RendezvousName.TRAINING, 0)
+    assert len(world) == 2  # 3 joined, truncated to 2 (node_unit multiple)
+    for c in clients:
+        c.close()
+
+
+def test_kv_store_over_grpc(client):
+    client.kv_store_set("coord", b"10.0.0.1:8476")
+    assert client.kv_store_get("coord") == b"10.0.0.1:8476"
+    assert client.kv_store_add("counter", 3) == 3
+    assert client.kv_store_add("counter", 2) == 5
+
+
+def test_node_status_and_heartbeat(master, client):
+    client.update_node_status(NodeStatus.RUNNING)
+    node = master.job_manager.get_node(NodeType.WORKER, 0)
+    assert node.status == NodeStatus.RUNNING
+    assert client.report_heartbeat() == ""
+    client.update_node_address("10.0.0.5:1234")
+    assert node.service_addr == "10.0.0.5:1234"
+    client.report_used_resource(55.0, 2048)
+    assert node.used_resource.cpu == 55.0
+    nodes = client.query_running_nodes()
+    assert len(nodes) >= 1
+
+
+def test_global_step_and_speed(master, client):
+    now = time.time()
+    client.report_global_step(10, now)
+    client.report_global_step(30, now + 2)
+    assert master.speed_monitor.running_speed() == pytest.approx(10.0)
+    assert master.speed_monitor.completed_global_step == 30
+
+
+def test_sync_and_barrier(master, client):
+    master.job_manager.update_node_status(
+        NodeType.WORKER, 0, NodeStatus.RUNNING
+    )
+    assert client.join_sync("epoch-end")
+    assert client.sync_finished("epoch-end")
+    assert not client.barrier("b1")
+    assert client.barrier("b1", notify=True)
+    assert client.barrier("b1")
+
+
+def test_network_check_flow(master):
+    """Pairwise grouping + fault localization
+    (parity: test_rdzv_manager.py network-check tests)."""
+    clients = [
+        MasterClient(master.addr, node_id=i, node_type=NodeType.WORKER)
+        for i in range(4)
+    ]
+    clients[0].report_rdzv_params(
+        min_nodes=4, max_nodes=4, waiting_timeout=1.0, node_unit=1
+    )
+    for i, c in enumerate(clients):
+        c.join_rendezvous(i, 1, rdzv_name=RendezvousName.NETWORK_CHECK)
+    _, group, world = clients[0].get_comm_world(
+        RendezvousName.NETWORK_CHECK, 0
+    )
+    assert world == {0: 1, 1: 1}  # paired {0,1}
+    _, _, world23 = clients[0].get_comm_world(
+        RendezvousName.NETWORK_CHECK, 2
+    )
+    assert world23 == {2: 1, 3: 1}
+    # node 1 reports failure
+    for i, c in enumerate(clients):
+        c.report_node_check_status(1, normal=(i != 1), elapsed_time=1.0)
+    success, reason = clients[0].network_check_success()
+    assert not success
+    assert clients[0].get_fault_nodes() == [1]
+    for c in clients:
+        c.close()
+
+
+def test_local_master_client_fallback():
+    """No master addr -> in-process LocalMasterClient."""
+    c = build_master_client(master_addr="")
+    assert isinstance(c, LocalMasterClient)
+    c.report_dataset_shard_params(
+        batch_size=5, num_epochs=1, dataset_size=10, shuffle=False,
+        num_minibatches_per_shard=1, dataset_name="d",
+    )
+    t = c.get_task("d")
+    assert t.task_id == 0
+    c.report_task_result("d", t.task_id)
