@@ -1,0 +1,295 @@
+"""Elastic training agent for TPU hosts.
+
+Parity reference: dlrover/python/elastic_agent/torch/training.py:215
+(ElasticTrainingAgent, _rendezvous:251, _invoke_run:365,
+_membership_changed:446, launch_agent:465).
+
+TPU-native redesign: instead of a torchelastic agent rebuilding an NCCL
+world, this agent
+  1. joins the master rendezvous (one node == one TPU host),
+  2. derives the ``jax.distributed.initialize`` triple
+     (coordinator_address, num_processes, process_id) from the sorted comm
+     world — rank-0 elects itself coordinator and publishes its address via
+     the master KV store,
+  3. spawns the training process with the bootstrap in env vars,
+  4. monitors it, and on membership change (a waiting node appears) or
+     process failure restarts the process so JAX re-forms the mesh with the
+     surviving topology — the TPU equivalent of "restart process, not pod".
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    NodeStatus,
+    RendezvousConstant,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.grpc_utils import find_free_port
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class ElasticLaunchConfig:
+    """Launch config (parity: torchelastic LaunchConfig + dlrover extras)."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    nproc_per_node: int = 1
+    node_rank: int = 0
+    rdzv_timeout: float = 30.0
+    node_unit: int = 1
+    max_restarts: int = 3
+    monitor_interval: float = 3.0
+    network_check: bool = False
+    entrypoint: str = ""
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+class WorkerState:
+    HEALTHY = "healthy"
+    FAILED = "failed"
+    SUCCEEDED = "succeeded"
+    RESTARTING = "restarting"
+
+
+@dataclass
+class RunResult:
+    state: str
+    return_code: int = 0
+
+
+def _local_ip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+class MasterRendezvousHandler:
+    """Join/poll the master rendezvous and derive the JAX bootstrap
+    (parity: training.py:75 MasterRendezvousHandler)."""
+
+    def __init__(self, master_client: MasterClient, node_rank: int,
+                 local_world_size: int,
+                 rdzv_name: str = RendezvousName.TRAINING,
+                 join_timeout: float = RendezvousConstant.JOIN_TIMEOUT):
+        self._client = master_client
+        self._node_rank = node_rank
+        self._local_world_size = local_world_size
+        self._rdzv_name = rdzv_name
+        self._join_timeout = join_timeout
+
+    def next_rendezvous(self):
+        """Block until a world forms. Returns
+        (round, world, process_id, num_processes, coordinator_addr)."""
+        start = time.time()
+        rdzv_round = self._client.join_rendezvous(
+            self._node_rank, self._local_world_size, self._rdzv_name
+        )
+        while True:
+            rdzv_round, group, world = self._client.get_comm_world(
+                self._rdzv_name, self._node_rank
+            )
+            if world and self._node_rank in world:
+                break
+            if time.time() - start > self._join_timeout:
+                raise TimeoutError(
+                    f"Rendezvous {self._rdzv_name} timed out after "
+                    f"{self._join_timeout}s; world={world}"
+                )
+            time.sleep(RendezvousConstant.POLL_INTERVAL)
+
+        sorted_ranks = sorted(world)
+        # processes are laid out host-major in join order of node rank
+        process_id = 0
+        for r in sorted_ranks:
+            if r == self._node_rank:
+                break
+            process_id += world[r]
+        num_processes = sum(world.values())
+        coordinator = self._elect_coordinator(
+            rdzv_round, sorted_ranks[0] == self._node_rank
+        )
+        return rdzv_round, world, process_id, num_processes, coordinator
+
+    def _elect_coordinator(self, rdzv_round: int, is_rank0: bool) -> str:
+        """Rank-0 node publishes coordinator host:port via master KV store;
+        everyone else polls it. The jax.distributed coordinator must live on
+        the rank-0 process of the new world."""
+        key = f"{self._rdzv_name}/coordinator/{rdzv_round}"
+        if is_rank0:
+            addr = f"{_local_ip()}:{find_free_port()}"
+            self._client.kv_store_set(key, addr.encode())
+            return addr
+        start = time.time()
+        while True:
+            value = self._client.kv_store_get(key)
+            if value:
+                return value.decode()
+            if time.time() - start > self._join_timeout:
+                raise TimeoutError("Waiting for coordinator address timeout")
+            time.sleep(0.5)
+
+
+class ElasticTrainingAgent:
+    """Supervises one TPU host's training process through elastic restarts."""
+
+    def __init__(self, config: ElasticLaunchConfig,
+                 master_client: MasterClient,
+                 start_method: str = "subprocess"):
+        self._config = config
+        self._client = master_client
+        self._rdzv_handler = MasterRendezvousHandler(
+            master_client, config.node_rank, config.nproc_per_node
+        )
+        self._restart_count = 0
+        self._proc: Optional[subprocess.Popen] = None
+        self._stopped = False
+        self._remaining_restarts = config.max_restarts
+
+    # ------------------------------------------------------------ lifecycle
+
+    def run(self) -> RunResult:
+        """The agent main loop (parity: _invoke_run training.py:365)."""
+        self._client.update_node_status(NodeStatus.RUNNING)
+        try:
+            result = self._invoke_run()
+        except Exception as e:
+            logger.exception("Agent error: %s", e)
+            self._client.report_failure(
+                str(e), TrainingExceptionLevel.NODE_ERROR,
+                self._restart_count,
+            )
+            self._client.update_node_status(NodeStatus.FAILED, str(e))
+            return RunResult(WorkerState.FAILED, 1)
+        status = (
+            NodeStatus.SUCCEEDED
+            if result.state == WorkerState.SUCCEEDED
+            else NodeStatus.FAILED
+        )
+        self._client.update_node_status(status)
+        return result
+
+    def _invoke_run(self) -> RunResult:
+        self._initialize_workers()
+        while not self._stopped:
+            time.sleep(self._config.monitor_interval)
+            result = self._monitor_workers()
+            if result.state == WorkerState.SUCCEEDED:
+                logger.info("Training process succeeded")
+                return result
+            if result.state == WorkerState.FAILED:
+                self._report_failure(result)
+                if self._remaining_restarts > 0:
+                    self._remaining_restarts -= 1
+                    logger.info(
+                        "Restarting workers (%d restarts left)",
+                        self._remaining_restarts,
+                    )
+                    self._restart_workers()
+                else:
+                    return result
+            elif self._membership_changed():
+                logger.info(
+                    "Membership changed; re-rendezvous without job restart"
+                )
+                self._restart_workers()
+        return RunResult(WorkerState.SUCCEEDED)
+
+    def _initialize_workers(self):
+        rdzv_round, world, process_id, num_processes, coordinator = (
+            self._rdzv_handler.next_rendezvous()
+        )
+        logger.info(
+            "Round %d world=%s -> process_id=%d/%d coordinator=%s",
+            rdzv_round, world, process_id, num_processes, coordinator,
+        )
+        env = dict(os.environ)
+        env.update(self._config.env)
+        env[NodeEnv.COORDINATOR_ADDR] = coordinator
+        env[NodeEnv.PROCESS_ID] = str(process_id)
+        env[NodeEnv.NUM_PROCESSES] = str(num_processes)
+        env[NodeEnv.NODE_RANK] = str(self._config.node_rank)
+        env[NodeEnv.NODE_ID] = str(self._config.node_rank)
+        env[NodeEnv.NODE_NUM] = str(len(world))
+        env[NodeEnv.RESTART_COUNT] = str(self._restart_count)
+        env[NodeEnv.MASTER_ADDR] = self._client.master_addr
+        cmd = [self._config.entrypoint] + list(self._config.args)
+        if cmd[0].endswith(".py"):
+            cmd = [sys.executable] + cmd
+        self._proc = subprocess.Popen(cmd, env=env)
+        self._restart_count += 1
+
+    def _monitor_workers(self) -> RunResult:
+        if self._proc is None:
+            return RunResult(WorkerState.FAILED, 1)
+        rc = self._proc.poll()
+        if rc is None:
+            return RunResult(WorkerState.HEALTHY)
+        if rc == 0:
+            return RunResult(WorkerState.SUCCEEDED, 0)
+        return RunResult(WorkerState.FAILED, rc)
+
+    def _membership_changed(self) -> bool:
+        """A node is waiting for a new round -> re-rendezvous
+        (parity: training.py:446)."""
+        return self._client.num_nodes_waiting() > 0
+
+    def _restart_workers(self):
+        self._kill_workers()
+        self._initialize_workers()
+
+    def _kill_workers(self, grace: float = 10.0):
+        if self._proc is None or self._proc.poll() is not None:
+            return
+        self._proc.terminate()
+        try:
+            self._proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait()
+
+    def _report_failure(self, result: RunResult):
+        self._client.report_failure(
+            f"training process exited rc={result.return_code}",
+            TrainingExceptionLevel.PROCESS_ERROR,
+            self._restart_count,
+        )
+
+    def stop(self):
+        self._stopped = True
+        self._kill_workers()
+
+
+def launch_agent(config: ElasticLaunchConfig,
+                 master_client: MasterClient) -> RunResult:
+    """Run network check (optional) then the elastic agent
+    (parity: launch_agent training.py:465)."""
+    if config.network_check:
+        from dlrover_tpu.agent.elastic.network_check import (
+            NetworkCheckElasticAgent,
+        )
+
+        checker = NetworkCheckElasticAgent(config, master_client)
+        ok = checker.run()
+        if not ok:
+            logger.error("Network check failed; node unhealthy")
+            master_client.update_node_status(
+                NodeStatus.BREAKDOWN, "network check failed"
+            )
+            return RunResult(WorkerState.FAILED, 1)
+    agent = ElasticTrainingAgent(config, master_client)
+    return agent.run()

@@ -1,0 +1,100 @@
+"""Per-host resource monitor thread.
+
+Parity reference: dlrover/python/elastic_agent/monitor/resource.py:88 — psutil
+CPU/mem plus TPU memory stats (via jax device memory_stats when a process owns
+the chips) reported to the master every interval.
+"""
+
+import os
+import threading
+import time
+from typing import Dict, List
+
+from dlrover_tpu.common.log import default_logger as logger
+
+try:
+    import psutil
+except ImportError:  # pragma: no cover
+    psutil = None
+
+
+def get_process_cpu_percent() -> float:
+    if psutil is None:
+        return 0.0
+    try:
+        return psutil.cpu_percent(interval=None)
+    except Exception:
+        return 0.0
+
+
+def get_used_memory_mb() -> int:
+    if psutil is None:
+        return 0
+    try:
+        return int(psutil.virtual_memory().used / 1024 / 1024)
+    except Exception:
+        return 0
+
+
+def get_tpu_stats() -> List[Dict]:
+    """Best-effort TPU HBM usage from the local jax runtime."""
+    stats = []
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            if d.platform == "cpu":
+                continue
+            try:
+                m = d.memory_stats() or {}
+            except Exception:
+                m = {}
+            stats.append({
+                "device": str(d),
+                "bytes_in_use": m.get("bytes_in_use", 0),
+                "bytes_limit": m.get("bytes_limit", 0),
+            })
+    except Exception:
+        pass
+    return stats
+
+
+class ResourceMonitor:
+    """Background thread reporting host usage to the master."""
+
+    def __init__(self, master_client, interval: float = 15.0,
+                 collect_tpu: bool = False):
+        self._master_client = master_client
+        self._interval = interval
+        self._collect_tpu = collect_tpu
+        self._stopped = threading.Event()
+        self._thread = None
+        self.total_cpu_percent = 0.0
+        self.total_memory_mb = 0
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._report_loop, daemon=True, name="resource-monitor"
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _report_loop(self):
+        while not self._stopped.is_set():
+            try:
+                self.report_resource()
+            except Exception as e:
+                logger.warning("Resource report failed: %s", e)
+            self._stopped.wait(self._interval)
+
+    def report_resource(self):
+        self.total_cpu_percent = get_process_cpu_percent()
+        self.total_memory_mb = get_used_memory_mb()
+        tpu = get_tpu_stats() if self._collect_tpu else []
+        self._master_client.report_used_resource(
+            self.total_cpu_percent, self.total_memory_mb, tpu
+        )

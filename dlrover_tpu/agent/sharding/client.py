@@ -1,0 +1,168 @@
+"""Worker-side data shard clients.
+
+Parity reference: dlrover/python/elastic_agent/sharding/client.py:31,249
+(ShardingClient, IndexShardingClient with prefetch thread).
+"""
+
+import threading
+import time
+from collections import deque
+from queue import Queue
+from typing import Callable, Optional
+
+from dlrover_tpu.agent.master_client import get_master_client
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class ShardingClient:
+    """Fetch shard tasks and report completion by accumulated minibatches."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        batch_size: int,
+        num_epochs: int = 1,
+        dataset_size: int = 0,
+        shuffle: bool = False,
+        task_type: str = TaskType.TRAINING,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "table",
+        master_client=None,
+    ):
+        self._master_client = master_client or get_master_client()
+        self._batch_size = batch_size
+        self._dataset_name = dataset_name
+        self._count_minibatches_per_shard = num_minibatches_per_shard
+        self._pending_tasks = deque()
+        self._batch_count = 0
+        self._lock = threading.Lock()
+        self._current_task = None
+        self._master_client.report_dataset_shard_params(
+            batch_size=batch_size,
+            num_epochs=num_epochs,
+            dataset_size=dataset_size,
+            shuffle=shuffle,
+            num_minibatches_per_shard=num_minibatches_per_shard,
+            dataset_name=dataset_name,
+            task_type=task_type,
+            storage_type=storage_type,
+        )
+
+    @property
+    def dataset_name(self):
+        return self._dataset_name
+
+    def fetch_shard(self):
+        """Fetch the next shard, or None when the dataset is exhausted."""
+        task = self._master_client.get_task(self._dataset_name)
+        if task is None or task.task_id < 0:
+            return None
+        with self._lock:
+            self._pending_tasks.append(task)
+            self._current_task = task
+        return task.shard
+
+    def report_batch_done(self, batch_size: Optional[int] = None) -> bool:
+        """Accumulate minibatch completions; report the oldest pending task
+        done once its shard's records are consumed
+        (parity: sharding/client.py:146)."""
+        with self._lock:
+            if not self._pending_tasks:
+                return False
+            self._batch_count += 1
+            task = self._pending_tasks[0]
+            records = task.shard.end - task.shard.start
+            minibatches = max(
+                1, (records + self._batch_size - 1) // self._batch_size
+            )
+            if self._batch_count >= minibatches:
+                self._pending_tasks.popleft()
+                self._batch_count = 0
+                self._master_client.report_task_result(
+                    self._dataset_name, task.task_id
+                )
+                return True
+        return False
+
+    def report_task_done(self, task_id: int, err: str = ""):
+        self._master_client.report_task_result(
+            self._dataset_name, task_id, err
+        )
+        with self._lock:
+            self._pending_tasks = deque(
+                t for t in self._pending_tasks if t.task_id != task_id
+            )
+
+    def get_shard_checkpoint(self) -> str:
+        return self._master_client.get_shard_checkpoint(self._dataset_name)
+
+    def restore_shard_from_checkpoint(self, content: str):
+        return self._master_client.report_shard_checkpoint(content)
+
+    def get_current_epoch(self) -> int:
+        return self._master_client.get_dataset_epoch(self._dataset_name)
+
+
+class IndexShardingClient(ShardingClient):
+    """Per-sample index stream over shards with a prefetch thread
+    (parity: sharding/client.py:249)."""
+
+    def __init__(self, dataset_name: str, batch_size: int,
+                 num_epochs: int = 1, dataset_size: int = 0,
+                 shuffle: bool = False,
+                 task_type: str = TaskType.TRAINING,
+                 num_minibatches_per_shard: int = 2,
+                 storage_type: str = "table",
+                 num_workers: int = 1,
+                 master_client=None):
+        super().__init__(
+            dataset_name, batch_size, num_epochs, dataset_size, shuffle,
+            task_type, num_minibatches_per_shard, storage_type,
+            master_client=master_client,
+        )
+        self._sample_queue: "Queue[int]" = Queue(maxsize=batch_size * 8)
+        self._stopped = False
+        self._exhausted = False
+        self._prefetch_thread = threading.Thread(
+            target=self._prefetch_loop, daemon=True,
+            name="shard-index-prefetch",
+        )
+        self._prefetch_thread.start()
+
+    def _prefetch_loop(self):
+        while not self._stopped:
+            shard = self.fetch_shard()
+            if shard is None:
+                self._exhausted = True
+                # unblock consumers
+                self._sample_queue.put(-1)
+                return
+            if shard.record_indices:
+                for idx in shard.record_indices:
+                    self._sample_queue.put(idx)
+            else:
+                for idx in range(shard.start, shard.end):
+                    self._sample_queue.put(idx)
+
+    def fetch_sample_index(self) -> Optional[int]:
+        """Next sample index, or None when the dataset is exhausted."""
+        idx = self._sample_queue.get()
+        if idx < 0:
+            self._sample_queue.put(-1)  # keep signalling other consumers
+            return None
+        return idx
+
+    def fetch_batch_indices(self, batch_size: Optional[int] = None):
+        """A batch of indices (possibly short on epoch end), or None."""
+        n = batch_size or self._batch_size
+        indices = []
+        for _ in range(n):
+            idx = self.fetch_sample_index()
+            if idx is None:
+                break
+            indices.append(idx)
+        return indices or None
+
+    def stop(self):
+        self._stopped = True

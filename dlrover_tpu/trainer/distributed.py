@@ -1,0 +1,62 @@
+"""Training-process bootstrap: env contract -> jax.distributed.
+
+The agent (agent/elastic/training.py) fills the NodeEnv vars after each
+rendezvous; the training process calls ``init_from_env()`` first thing and
+JAX forms the mesh over the surviving topology. This replaces the reference's
+``dist.init_process_group(NCCL)`` bootstrap (its MasterKVStore/TCPStore role
+is played by the coordinator election in the agent).
+"""
+
+import os
+from dataclasses import dataclass
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class DistributedEnv:
+    coordinator_addr: str
+    process_id: int
+    num_processes: int
+    node_rank: int
+    node_num: int
+    restart_count: int
+    master_addr: str
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def read_dist_env() -> DistributedEnv:
+    return DistributedEnv(
+        coordinator_addr=os.getenv(NodeEnv.COORDINATOR_ADDR, ""),
+        process_id=int(os.getenv(NodeEnv.PROCESS_ID, "0")),
+        num_processes=int(os.getenv(NodeEnv.NUM_PROCESSES, "1")),
+        node_rank=int(os.getenv(NodeEnv.NODE_RANK, "0")),
+        node_num=int(os.getenv(NodeEnv.NODE_NUM, "1")),
+        restart_count=int(os.getenv(NodeEnv.RESTART_COUNT, "0")),
+        master_addr=os.getenv(NodeEnv.MASTER_ADDR, ""),
+    )
+
+
+def init_from_env(timeout_s: int = 300) -> DistributedEnv:
+    """Initialize jax.distributed from the agent-provided env (no-op for a
+    single process)."""
+    env = read_dist_env()
+    if env.is_distributed and env.coordinator_addr:
+        import jax
+
+        logger.info(
+            "jax.distributed.initialize(%s, num_processes=%d, "
+            "process_id=%d)",
+            env.coordinator_addr, env.num_processes, env.process_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator_addr,
+            num_processes=env.num_processes,
+            process_id=env.process_id,
+            initialization_timeout=timeout_s,
+        )
+    return env
