@@ -1,0 +1,151 @@
+"""ElasticTrainer: fixed global batch size under a changing host count.
+
+Parity reference: dlrover/trainer/torch/elastic.py:170 (ElasticTrainer,
+GradientState:42, _ElasticOptimizer:78).
+
+TPU-native redesign: the reference wraps the optimizer/scheduler so DDP only
+steps on gradient-sync boundaries. Under JAX there is no optimizer object to
+hack — gradient accumulation is a ``lax.scan`` *inside* the jitted train
+step, so the whole accumulate-then-update loop compiles to one XLA program
+per world size (no per-microbatch dispatch overhead, and XLA fuses the
+accumulation adds into the backward).
+"""
+
+import time
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def compute_accum_steps(max_nodes: int, cur_nodes: int) -> int:
+    """gradient_accumulation_steps = ceil(max/cur) keeps the global batch
+    fixed when nodes drop out (parity: elastic.py:208)."""
+    if cur_nodes <= 0:
+        return 1
+    return max(1, -(-max_nodes // cur_nodes))
+
+
+def make_elastic_train_step(
+    loss_fn: Callable,
+    optimizer,
+    accum_steps: int,
+    donate_state: bool = True,
+):
+    """Build a jitted train step running ``accum_steps`` microbatches.
+
+    ``loss_fn(params, batch) -> scalar loss``. ``optimizer`` is an optax
+    GradientTransformation. The returned step takes
+    ``(params, opt_state, batches)`` where ``batches`` has a leading
+    microbatch axis of length ``accum_steps``; it returns
+    ``(params, opt_state, mean_loss)``.
+
+    Re-jit per accum_steps (i.e. per world size); callers should cache
+    compiled versions keyed by world size (see ElasticTrainer).
+    """
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def step(params, opt_state, batches):
+        def micro(carry, batch):
+            loss_sum, grads_sum = carry
+            loss, grads = grad_fn(params, batch)
+            grads_sum = jax.tree.map(jnp.add, grads_sum, grads)
+            return (loss_sum + loss, grads_sum), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss_sum, grads_sum), _ = jax.lax.scan(
+            micro, (jnp.zeros(()), zeros), batches
+        )
+        grads = jax.tree.map(lambda g: g / accum_steps, grads_sum)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(jnp.add, params, updates)
+        return params, opt_state, loss_sum / accum_steps
+
+    donate = (0, 1) if donate_state else ()
+    return jax.jit(step, donate_argnums=donate)
+
+
+class ElasticTrainer:
+    """Keeps the global batch fixed across elastic world changes.
+
+    Usage::
+
+        trainer = ElasticTrainer(loss_fn, optimizer, max_nodes=4,
+                                 cur_nodes=env.node_num)
+        step_fn = trainer.train_step  # jitted, cached per accum_steps
+        params, opt_state, loss = step_fn(params, opt_state, microbatches)
+        trainer.report_step()  # master throughput reporting
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        optimizer,
+        max_nodes: int,
+        cur_nodes: int,
+        master_client=None,
+        report_interval: int = 10,
+    ):
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._max_nodes = max_nodes
+        self._master_client = master_client
+        self._report_interval = report_interval
+        self._step_cache = {}
+        self._global_step = 0
+        self.set_world(cur_nodes)
+
+    def set_world(self, cur_nodes: int):
+        self._cur_nodes = cur_nodes
+        self._accum_steps = compute_accum_steps(self._max_nodes, cur_nodes)
+        logger.info(
+            "Elastic world: %d/%d nodes -> accum_steps=%d",
+            cur_nodes, self._max_nodes, self._accum_steps,
+        )
+
+    @property
+    def accum_steps(self) -> int:
+        return self._accum_steps
+
+    @property
+    def train_step(self):
+        key = self._accum_steps
+        if key not in self._step_cache:
+            self._step_cache[key] = make_elastic_train_step(
+                self._loss_fn, self._optimizer, key
+            )
+        return self._step_cache[key]
+
+    def microbatch(self, batch):
+        """Split a per-host batch into the accum microbatch layout
+        [accum_steps, batch/accum, ...]."""
+        return jax.tree.map(
+            lambda x: x.reshape(
+                (self._accum_steps, x.shape[0] // self._accum_steps)
+                + x.shape[1:]
+            ),
+            batch,
+        )
+
+    def report_step(self, step: Optional[int] = None):
+        self._global_step = step if step is not None else (
+            self._global_step + 1
+        )
+        if (
+            self._master_client is not None
+            and self._global_step % self._report_interval == 0
+        ):
+            try:
+                self._master_client.report_global_step(
+                    self._global_step, time.time()
+                )
+            except Exception as e:
+                logger.warning("report_global_step failed: %s", e)
+
+    @property
+    def global_step(self) -> int:
+        return self._global_step

@@ -1,0 +1,97 @@
+"""ElasticDistributedSampler: resumable sharded sampling.
+
+Parity reference: dlrover/trainer/torch/elastic_sampler.py:25 (state_dict at
+:101 stores the completed sample offset so resume skips consumed samples even
+when the world size changed).
+
+Framework-neutral: yields integer indices; drive any JAX data pipeline
+(grain, tf.data, numpy batching) with it.
+"""
+
+import math
+import random
+from typing import Dict, Iterator, List, Optional
+
+
+class ElasticDistributedSampler:
+    def __init__(
+        self,
+        dataset_size: int,
+        num_replicas: int = 1,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if rank >= num_replicas or rank < 0:
+            raise ValueError(
+                f"rank {rank} out of range for {num_replicas} replicas"
+            )
+        self.dataset_size = dataset_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        #: samples already consumed in the current epoch (global count)
+        self.completed_num = 0
+        self._recompute_sizes()
+
+    def _recompute_sizes(self):
+        remaining = self.dataset_size - self.completed_num
+        if self.drop_last:
+            self.num_samples = remaining // self.num_replicas
+        else:
+            self.num_samples = math.ceil(remaining / self.num_replicas)
+        self.total_size = self.num_samples * self.num_replicas
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.completed_num = 0
+        self._recompute_sizes()
+
+    def _epoch_indices(self) -> List[int]:
+        indices = list(range(self.dataset_size))
+        if self.shuffle:
+            rng = random.Random(self.seed + self.epoch)
+            rng.shuffle(indices)
+        return indices
+
+    def __iter__(self) -> Iterator[int]:
+        indices = self._epoch_indices()[self.completed_num:]
+        if not self.drop_last:
+            # pad to a replica multiple
+            pad = self.total_size - len(indices)
+            if pad > 0 and indices:
+                indices += indices[:pad]
+        else:
+            indices = indices[: self.total_size]
+        for i, idx in enumerate(indices[self.rank::self.num_replicas]):
+            # count global progress: each yielded index advances the global
+            # consumed count by num_replicas (all replicas move in lockstep)
+            self.completed_num += self.num_replicas
+            yield idx
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    # -------------------------------------------------------- resume state
+
+    def state_dict(self) -> Dict:
+        """Checkpointable progress (parity: elastic_sampler.py:101)."""
+        return {
+            "epoch": self.epoch,
+            "completed_num": min(self.completed_num, self.dataset_size),
+        }
+
+    def load_state_dict(self, state: Dict, num_replicas: Optional[int] = None,
+                        rank: Optional[int] = None):
+        """Restore, possibly into a different world size."""
+        self.epoch = state.get("epoch", 0)
+        self.completed_num = state.get("completed_num", 0)
+        if num_replicas is not None:
+            self.num_replicas = num_replicas
+        if rank is not None:
+            self.rank = rank
+        self._recompute_sizes()

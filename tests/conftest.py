@@ -1,16 +1,22 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+"""Test bootstrap: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's multi-node-without-a-cluster approach
 (dlrover/python/tests/test_utils.py) — sharding/mesh tests run on a virtual
 8-device CPU topology; no real TPU needed.
+
+Note: the session may pre-register a real TPU backend via sitecustomize, so
+the env-var route (JAX_PLATFORMS) is too late — use jax.config, which wins
+as long as no backend has initialized yet.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")
+# subprocesses spawned by tests (agents, probes) must also land on CPU
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,223 @@
+"""Tests for ElasticTrainer, sampler, and flash checkpoint."""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+from dlrover_tpu.trainer.elastic import (
+    ElasticTrainer,
+    compute_accum_steps,
+    make_elastic_train_step,
+)
+from dlrover_tpu.trainer.sampler import ElasticDistributedSampler
+
+
+def test_compute_accum_steps():
+    assert compute_accum_steps(4, 4) == 1
+    assert compute_accum_steps(4, 2) == 2
+    assert compute_accum_steps(4, 3) == 2  # ceil
+    assert compute_accum_steps(4, 1) == 4
+    assert compute_accum_steps(1, 1) == 1
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def test_elastic_train_step_matches_large_batch():
+    """accum over k microbatches == one step on the concatenated batch."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 1).astype(np.float32)
+    params = {
+        "w": jnp.zeros((4, 1)),
+        "b": jnp.zeros((1,)),
+    }
+    opt = optax.sgd(0.1)
+
+    def fresh():
+        p = jax.tree.map(jnp.copy, params)
+        return p, opt.init(p)
+
+    # one step, full batch (donated inputs -> use fresh copies per call)
+    step1 = make_elastic_train_step(_loss_fn, opt, accum_steps=1)
+    p, s = fresh()
+    p1, _, loss1 = step1(p, s, (x[None], y[None]))
+
+    # 4 microbatches of 2
+    step4 = make_elastic_train_step(_loss_fn, opt, accum_steps=4)
+    xs = x.reshape(4, 2, 4)
+    ys = y.reshape(4, 2, 1)
+    p, s = fresh()
+    p4, _, loss4 = step4(p, s, (xs, ys))
+
+    np.testing.assert_allclose(
+        np.asarray(p1["w"]), np.asarray(p4["w"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(float(loss1), float(loss4), rtol=1e-5)
+
+
+def test_elastic_trainer_world_change_caches_steps():
+    opt = optax.sgd(0.1)
+    trainer = ElasticTrainer(_loss_fn, opt, max_nodes=4, cur_nodes=4)
+    assert trainer.accum_steps == 1
+    s1 = trainer.train_step
+    trainer.set_world(2)
+    assert trainer.accum_steps == 2
+    s2 = trainer.train_step
+    assert s1 is not s2
+    trainer.set_world(4)
+    assert trainer.train_step is s1  # cached
+
+
+def test_microbatch_split():
+    opt = optax.sgd(0.1)
+    trainer = ElasticTrainer(_loss_fn, opt, max_nodes=2, cur_nodes=1)
+    batch = {"x": np.zeros((8, 3))}
+    mb = trainer.microbatch(batch)
+    assert mb["x"].shape == (2, 4, 3)
+
+
+# ------------------------------------------------------------------ sampler
+
+
+def test_sampler_partition_and_padding():
+    s = ElasticDistributedSampler(10, num_replicas=3, rank=0, shuffle=False)
+    idx = list(s)
+    assert len(idx) == 4  # ceil(10/3) with padding
+    all_ranks = []
+    for r in range(3):
+        sr = ElasticDistributedSampler(10, 3, r, shuffle=False)
+        all_ranks.extend(list(sr))
+    assert set(all_ranks) == set(range(10))
+
+
+def test_sampler_resume_after_world_change():
+    s = ElasticDistributedSampler(100, num_replicas=4, rank=0,
+                                  shuffle=False)
+    it = iter(s)
+    for _ in range(10):
+        next(it)
+    state = s.state_dict()
+    assert state["completed_num"] == 40  # 10 yields x 4 replicas
+
+    # resume into 2 replicas
+    s2 = ElasticDistributedSampler(100, num_replicas=2, rank=0,
+                                   shuffle=False)
+    s2.load_state_dict(state, num_replicas=2, rank=0)
+    remaining = list(s2)
+    assert len(remaining) == 30  # (100-40)/2
+    # first unconsumed sample is 40
+    assert remaining[0] == 40
+
+
+def test_sampler_shuffle_is_epoch_deterministic():
+    a = ElasticDistributedSampler(20, 2, 0, shuffle=True, seed=7)
+    b = ElasticDistributedSampler(20, 2, 0, shuffle=True, seed=7)
+    assert list(a) == list(b)
+    a.set_epoch(1)
+    b.set_epoch(0)
+    assert list(a) != list(b)
+
+
+# --------------------------------------------------------------- checkpoint
+
+
+def _sharded_state():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("d",))
+    w = jnp.arange(16.0).reshape(8, 2)
+    sharded = jax.device_put(w, NamedSharding(mesh, P("d", None)))
+    return {"w": sharded, "step": jnp.array(3)}
+
+
+def test_flash_checkpoint_ram_roundtrip():
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = FlashCheckpointer(
+            persist_dir=os.path.join(tmp, "persist"),
+            ram_dir=os.path.join(tmp, "ram"),
+            persist_interval=0,  # RAM only
+            use_orbax=False,
+        )
+        state = _sharded_state()
+        ckpt.save(7, state)
+        restored, step = ckpt.restore(target=state)
+        assert step == 7
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(state["w"])
+        )
+        assert restored["w"].sharding == state["w"].sharding
+
+
+def test_flash_checkpoint_restore_after_resharding():
+    """RAM snapshot taken on a 4-way mesh restores onto a 2-way mesh
+    (the mesh-reformation path after losing hosts)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = FlashCheckpointer(
+            persist_dir=os.path.join(tmp, "p"),
+            ram_dir=os.path.join(tmp, "r"),
+            persist_interval=0, use_orbax=False,
+        )
+        state = _sharded_state()
+        ckpt.save(5, state)
+
+        mesh2 = Mesh(np.array(jax.devices()[:2]), ("d",))
+        target = {
+            "w": jax.device_put(
+                jnp.zeros((8, 2)), NamedSharding(mesh2, P("d", None))
+            ),
+            "step": jnp.array(0),
+        }
+        restored, step = ckpt.restore(target=target)
+        assert step == 5
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.arange(16.0).reshape(8, 2)
+        )
+        assert restored["w"].sharding == target["w"].sharding
+
+
+def test_flash_checkpoint_persistent_tier_orbax():
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = FlashCheckpointer(
+            persist_dir=os.path.join(tmp, "persist"),
+            ram_dir=os.path.join(tmp, "ram"),
+            persist_interval=1, use_orbax=True,
+        )
+        state = {"w": jnp.ones((4, 4)), "n": jnp.array(1)}
+        ckpt.save(1, state, force_persist=True)
+        ckpt.wait()
+        # wipe RAM tier to force persistent restore
+        for f in os.listdir(ckpt.ram_dir):
+            os.remove(os.path.join(ckpt.ram_dir, f))
+        restored, step = ckpt.restore(target=state)
+        assert step == 1
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.ones((4, 4))
+        )
+        ckpt.close()
+
+
+def test_flash_checkpoint_keeps_max_ram():
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = FlashCheckpointer(
+            persist_dir=os.path.join(tmp, "p"),
+            ram_dir=os.path.join(tmp, "r"),
+            persist_interval=0, max_ram_keep=2, use_orbax=False,
+        )
+        state = {"x": jnp.zeros(2)}
+        for s in range(5):
+            ckpt.save(s, state)
+        steps = [s for s, _ in ckpt._list_ram()]
+        assert steps == [3, 4]
