@@ -121,15 +121,17 @@ class MasterRendezvousHandler:
             process_id += world[r]
         num_processes = sum(world.values())
         coordinator = self._elect_coordinator(
-            rdzv_round, sorted_ranks[0] == self._node_rank
+            rdzv_round, group, sorted_ranks[0] == self._node_rank
         )
         return rdzv_round, world, process_id, num_processes, coordinator
 
-    def _elect_coordinator(self, rdzv_round: int, is_rank0: bool) -> str:
-        """Rank-0 node publishes coordinator host:port via master KV store;
-        everyone else polls it. The jax.distributed coordinator must live on
-        the rank-0 process of the new world."""
-        key = f"{self._rdzv_name}/coordinator/{rdzv_round}"
+    def _elect_coordinator(self, rdzv_round: int, group: int,
+                           is_rank0: bool) -> str:
+        """The lowest-rank node of this round's (group-scoped) world
+        publishes a fresh coordinator host:port via the master KV store;
+        everyone else polls it. Keyed by round AND group so concurrent
+        network-check pair groups never cross-connect."""
+        key = f"{self._rdzv_name}/coordinator/{rdzv_round}/{group}"
         if is_rank0:
             addr = f"{_local_ip()}:{find_free_port()}"
             self._client.kv_store_set(key, addr.encode())

@@ -30,11 +30,12 @@ def retry_rpc_request(func):
                 return func(self, *args, **kwargs)
             except Exception as e:
                 exception = e
-                time.sleep(6)
                 logger.warning(
                     "Retry %d/%d for RPC %s: %s", i + 1, retry,
                     func.__name__, e,
                 )
+                if i < retry - 1:
+                    time.sleep(6)
         raise exception
 
     return wrapped

@@ -131,19 +131,24 @@ class IndexShardingClient(ShardingClient):
         self._prefetch_thread.start()
 
     def _prefetch_loop(self):
-        while not self._stopped:
-            shard = self.fetch_shard()
-            if shard is None:
-                self._exhausted = True
-                # unblock consumers
-                self._sample_queue.put(-1)
-                return
-            if shard.record_indices:
-                for idx in shard.record_indices:
-                    self._sample_queue.put(idx)
-            else:
-                for idx in range(shard.start, shard.end):
-                    self._sample_queue.put(idx)
+        try:
+            while not self._stopped:
+                shard = self.fetch_shard()
+                if shard is None:
+                    break
+                if shard.record_indices:
+                    for idx in shard.record_indices:
+                        self._sample_queue.put(idx)
+                else:
+                    for idx in range(shard.start, shard.end):
+                        self._sample_queue.put(idx)
+        except Exception as e:
+            logger.error("Shard prefetch thread failed: %s", e)
+        finally:
+            # always unblock consumers, even on RPC failure — a silent
+            # thread death would leave fetch_sample_index blocked forever
+            self._exhausted = True
+            self._sample_queue.put(-1)
 
     def fetch_sample_index(self) -> Optional[int]:
         """Next sample index, or None when the dataset is exhausted."""

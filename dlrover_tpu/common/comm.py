@@ -295,7 +295,7 @@ class RunningNodesRequest(BaseRequest):
 
 @dataclass
 class RunningNodes(BaseMessage):
-    nodes: List[Dict] = field(default_factory=dict)
+    nodes: List[Dict] = field(default_factory=list)
 
 
 @dataclass

@@ -28,8 +28,8 @@ _GRPC_OPTIONS = [
 
 def find_free_port(host: str = "") -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.bind((host, 0))
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
         return s.getsockname()[1]
 
 

@@ -99,26 +99,35 @@ class RendezvousManager(ABC):
                 return len(self._waiting_nodes)
             return 0
 
-    def _check_rdzv_completed(self) -> bool:
+    def _check_rdzv_completed(self):
         """Completion rule (parity: rdzv_manager.py:106): complete when
         max_nodes joined, or min_nodes joined and waiting_timeout elapsed
-        since last join; truncate world to a node_unit multiple."""
+        since last join; truncate world to a node_unit multiple.
+
+        Returns the world dict for the new round, or None if incomplete.
+        Truncated nodes STAY in the waiting set for the next round (they
+        are not members of this world and keep polling)."""
         p = self._rdzv_params
         n = len(self._waiting_nodes)
+        if n == 0:
+            return None
         if n >= p.max_nodes:
-            return True
-        if n >= p.min_nodes:
-            if time.time() - self._lastcall_time >= p.waiting_timeout:
-                # keep only a node_unit multiple
-                keep = (n // self._node_unit) * self._node_unit
-                if keep < p.min_nodes or keep == 0:
-                    return False
-                ranks = sorted(self._waiting_nodes)[:keep]
-                self._waiting_nodes = {
-                    r: self._waiting_nodes[r] for r in ranks
-                }
-                return True
-        return False
+            ranks = sorted(self._waiting_nodes)[: p.max_nodes]
+        elif (
+            n >= p.min_nodes
+            and time.time() - self._lastcall_time >= p.waiting_timeout
+        ):
+            # keep only a node_unit multiple
+            keep = (n // self._node_unit) * self._node_unit
+            if keep < p.min_nodes or keep == 0:
+                return None
+            ranks = sorted(self._waiting_nodes)[:keep]
+        else:
+            return None
+        world = {r: self._waiting_nodes[r] for r in ranks}
+        for r in ranks:
+            del self._waiting_nodes[r]
+        return world
 
     @abstractmethod
     def get_comm_world(
@@ -136,22 +145,25 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
 
     def get_comm_world(self, node_rank):
         with self._lock:
-            if not self._rdzv_nodes or set(self._waiting_nodes) != set(
-                self._rdzv_nodes
+            world = self._check_rdzv_completed()
+            if world is not None:
+                # every completion starts a NEW round, even with unchanged
+                # membership: restarted processes must re-elect a live
+                # coordinator, so the round number (which keys the
+                # coordinator KV entry) has to advance
+                self._rdzv_round += 1
+                self._rdzv_nodes = dict(sorted(world.items()))
+                self._latest_rdzv_nodes = list(self._rdzv_nodes)
+                logger.info(
+                    "Rendezvous round %d complete: nodes %s",
+                    self._rdzv_round, list(self._rdzv_nodes),
+                )
+            # a node that has re-joined is waiting for the NEXT round —
+            # never hand it the stale world it used to belong to
+            if (
+                node_rank in self._rdzv_nodes
+                and node_rank not in self._waiting_nodes
             ):
-                if self._check_rdzv_completed():
-                    self._rdzv_round += 1
-                    self._rdzv_nodes = dict(sorted(
-                        self._waiting_nodes.items()
-                    ))
-                    self._latest_rdzv_nodes = list(self._rdzv_nodes)
-                    self._waiting_nodes = {}
-                    logger.info(
-                        "Rendezvous round %d complete: nodes %s",
-                        self._rdzv_round, list(self._rdzv_nodes),
-                    )
-                    return self._rdzv_round, 0, self._rdzv_nodes
-            if node_rank in self._rdzv_nodes:
                 return self._rdzv_round, 0, self._rdzv_nodes
             return self._rdzv_round, 0, {}
 
@@ -172,48 +184,44 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._node_groups: List[Dict[int, int]] = []
         self._check_round = 2
 
+    def update_rdzv_params(self, min_nodes, max_nodes, waiting_timeout,
+                           node_unit, join_timeout=600.0):
+        super().update_rdzv_params(
+            min_nodes, max_nodes, waiting_timeout, node_unit, join_timeout
+        )
+        # the probe must cover every joined node; never truncate
+        self._node_unit = 1
+
     def get_comm_world(self, node_rank):
         with self._lock:
-            if not self._node_groups or set(self._waiting_nodes) == set(
-                self._rdzv_nodes
-            ):
-                pass
-            if self._check_rdzv_completed_nolock():
+            world = self._check_rdzv_completed()
+            if world is not None:
                 self._rdzv_round += 1
-                self._rdzv_nodes = dict(sorted(self._waiting_nodes.items()))
-                self._node_groups = self._group_nodes(self._rdzv_round)
+                self._rdzv_nodes = dict(sorted(world.items()))
+                self._node_groups = self._group_nodes(
+                    self._rdzv_round, self._rdzv_nodes
+                )
                 logger.info(
                     "Network-check round %d groups: %s",
                     self._rdzv_round, self._node_groups,
                 )
-                self._waiting_nodes = {}
                 self._reported_nodes = set()
-            for group_idx, group in enumerate(self._node_groups):
-                if node_rank in group:
-                    return self._rdzv_round, group_idx, group
+            if node_rank not in self._waiting_nodes:
+                for group_idx, group in enumerate(self._node_groups):
+                    if node_rank in group:
+                        return self._rdzv_round, group_idx, group
             return self._rdzv_round, 0, {}
 
-    def _check_rdzv_completed_nolock(self) -> bool:
-        if not self._waiting_nodes:
-            return False
-        p = self._rdzv_params
-        n = len(self._waiting_nodes)
-        if n >= p.max_nodes:
-            return True
-        return (
-            n >= p.min_nodes
-            and time.time() - self._lastcall_time >= p.waiting_timeout
-        )
-
-    def _group_nodes(self, round_num: int) -> List[Dict[int, int]]:
+    def _group_nodes(self, round_num: int,
+                     world: Dict[int, int]) -> List[Dict[int, int]]:
         """Pairwise grouping (parity: rdzv_manager.py:294)."""
         round_idx = (round_num - 1) % self._check_round
         node_groups: List[Dict[int, int]] = []
-        ranks = sorted(self._waiting_nodes)
+        ranks = sorted(world)
         if round_idx == 0:
             cur: Dict[int, int] = {}
             for r in ranks:
-                cur[r] = self._waiting_nodes[r]
+                cur[r] = world[r]
                 if len(cur) == 2:
                     node_groups.append(cur)
                     cur = {}
@@ -227,19 +235,13 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 r for r in ranks if not self._node_status.get(r, True)
             ]
             normal = [r for r in ranks if self._node_status.get(r, True)]
-            used_normal = []
             for a in abnormal:
                 if normal:
                     n0 = normal.pop(0)
-                    used_normal.append(n0)
-                    node_groups.append({
-                        a: self._waiting_nodes[a],
-                        n0: self._waiting_nodes[n0],
-                    })
-            leftover = {
-                r: self._waiting_nodes[r]
-                for r in normal
-            }
+                    node_groups.append({a: world[a], n0: world[n0]})
+                else:
+                    node_groups.append({a: world[a]})
+            leftover = {r: world[r] for r in normal}
             if leftover:
                 node_groups.append(leftover)
         return node_groups

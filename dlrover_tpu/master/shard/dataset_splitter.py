@@ -90,6 +90,12 @@ class TableDatasetSplitter(DatasetSplitter):
         self._shards: List[Shard] = []
         self._split_start = 0
 
+    def epoch_finished(self) -> bool:
+        # a lazily-materialised epoch is not finished while mid-epoch
+        # (_split_start > 0): without this, the dataset manager would stop
+        # refilling and silently drop the tail of the final epoch
+        return super().epoch_finished() and self._split_start == 0
+
     def get_shards(self) -> List[Shard]:
         return self._shards
 

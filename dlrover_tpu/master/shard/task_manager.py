@@ -145,16 +145,17 @@ class TaskManager:
         """1s loop requeueing tasks stuck past the timeout
         (parity: task_manager.py:205)."""
         while not self._should_stop:
-            for ds in list(self._datasets.values()):
-                doing = getattr(ds, "get_doing_tasks", lambda: {})()
-                now = time.time()
-                for task_id, dt in list(doing.items()):
-                    if now - dt.start_time > self._task_timeout:
-                        logger.warning(
-                            "Task %s timed out on node %s; requeue",
-                            task_id, dt.node_id,
-                        )
-                        ds.report_task_status(task_id, success=False)
+            with self._lock:
+                for ds in list(self._datasets.values()):
+                    doing = getattr(ds, "get_doing_tasks", lambda: {})()
+                    now = time.time()
+                    for task_id, dt in list(doing.items()):
+                        if now - dt.start_time > self._task_timeout:
+                            logger.warning(
+                                "Task %s timed out on node %s; requeue",
+                                task_id, dt.node_id,
+                            )
+                            ds.report_task_status(task_id, success=False)
             time.sleep(1)
 
     # ----------------------------------------------------------- checkpoint

@@ -1,0 +1,128 @@
+"""Toy elastic training workload: the minimum end-to-end slice.
+
+Run under the elastic launcher::
+
+    python -m dlrover_tpu.trainer.elastic_run --standalone \
+        examples/toy_train.py -- --steps 50 --ckpt-dir /tmp/toy_ckpt
+
+Exercises the full stack: agent rendezvous -> env bootstrap -> master data
+sharding -> jitted accumulation train step -> flash checkpoint save; on
+restart (failure or membership change) it restores from the RAM tier and
+continues from the saved step. Parity role: model_zoo/pytorch/mnist of the
+reference (the CI smoke workload).
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.agent.master_client import build_master_client
+from dlrover_tpu.agent.sharding.client import ShardingClient
+from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+from dlrover_tpu.trainer.distributed import init_from_env
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+
+
+def make_data(n=512, dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, dim).astype(np.float32)
+    w_true = rng.randn(dim, 1).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def loss_fn(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--ckpt-dir", type=str, default="/tmp/toy_ckpt")
+    parser.add_argument("--crash-at-step", type=int, default=-1,
+                        help="simulate a failure at this step (first run "
+                        "only) to exercise restore")
+    parser.add_argument("--out", type=str, default="")
+    args = parser.parse_args()
+
+    env = init_from_env()
+    client = build_master_client()
+
+    x, y = make_data()
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.adam(0.1)
+    opt_state = opt.init(params)
+
+    trainer = ElasticTrainer(
+        loss_fn, opt, max_nodes=max(1, env.node_num),
+        cur_nodes=max(1, env.node_num), master_client=client,
+        report_interval=5,
+    )
+    ckpt = FlashCheckpointer(
+        persist_dir=os.path.join(args.ckpt_dir, "persist"),
+        ram_dir=os.path.join(args.ckpt_dir, "ram"),
+        persist_interval=0, use_orbax=False,
+    )
+    state = {"params": params, "opt_state": opt_state,
+             "step": jnp.array(0)}
+    restored, step0 = ckpt.restore(target=state)
+    start_step = 0
+    if restored is not None:
+        state = restored
+        start_step = int(state["step"])
+        print(f"RESTORED from step {start_step}", flush=True)
+
+    sharding = ShardingClient(
+        dataset_name="toy", batch_size=args.batch_size,
+        num_epochs=10**6, dataset_size=len(x),
+        num_minibatches_per_shard=1, master_client=client,
+    )
+
+    params, opt_state = state["params"], state["opt_state"]
+    step = start_step
+    loss = None
+    while step < args.steps:
+        shard = sharding.fetch_shard()
+        if shard is None:
+            break
+        xb = x[shard.start:shard.end]
+        yb = y[shard.start:shard.end]
+        # pad to fixed shape so every step hits the same compiled program
+        pad = args.batch_size - len(xb)
+        if pad > 0:
+            xb = np.pad(xb, ((0, pad), (0, 0)))
+            yb = np.pad(yb, ((0, pad), (0, 0)))
+        batch = (xb[None], yb[None])  # single microbatch layout
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, batch
+        )
+        sharding.report_batch_done()
+        step += 1
+        trainer.report_step(step)
+        if step % 10 == 0 or step == args.steps:
+            ckpt.save(
+                step,
+                {"params": params, "opt_state": opt_state,
+                 "step": jnp.array(step)},
+            )
+        if args.crash_at_step == step and start_step == 0:
+            print(f"SIMULATED CRASH at step {step}", flush=True)
+            os._exit(17)
+
+    print(f"FINAL step={step} loss={float(loss):.6f}", flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(f"{step},{float(loss):.6f},{start_step}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+"""Regression tests for rendezvous-manager correctness fixes.
+
+Covers: round advancing on same-membership re-rendezvous (stale coordinator
+bug), truncated nodes kept waiting for the next round, lazy-splitter final
+epoch tail, network-check grouping on world (not waiting) state.
+"""
+
+import time
+
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.shard.batch_dataset_manager import BatchDatasetManager
+from dlrover_tpu.master.shard.dataset_splitter import TableDatasetSplitter
+
+
+def _mgr(min_nodes, max_nodes, timeout=0.2, node_unit=1):
+    m = ElasticTrainingRendezvousManager()
+    m.update_rdzv_params(min_nodes, max_nodes, timeout, node_unit)
+    return m
+
+
+def test_rerendezvous_same_membership_advances_round():
+    """After all nodes of a completed world re-join (process restart), a NEW
+    round must form — the round number keys the coordinator election, so a
+    stale round would hand restarted processes a dead coordinator."""
+    m = _mgr(2, 2)
+    m.join_rendezvous(0, 1)
+    m.join_rendezvous(1, 1)
+    r1, _, world1 = m.get_comm_world(0)
+    assert world1 == {0: 1, 1: 1}
+    assert r1 == 1
+
+    # both nodes restart and re-join with identical membership
+    m.join_rendezvous(0, 1)
+    # node 0 has re-joined: must NOT be handed the old world
+    r_stale, _, w_stale = m.get_comm_world(0)
+    assert w_stale == {}
+    m.join_rendezvous(1, 1)
+    r2, _, world2 = m.get_comm_world(0)
+    assert world2 == {0: 1, 1: 1}
+    assert r2 == 2  # round advanced -> fresh coordinator key
+
+
+def test_waiting_node_signals_membership_change():
+    m = _mgr(1, 2)
+    m.join_rendezvous(0, 1)
+    time.sleep(0.25)  # min-nodes completion waits out the waiting_timeout
+    r, _, w = m.get_comm_world(0)
+    assert w == {0: 1}
+    assert m.num_nodes_waiting() == 0
+    m.join_rendezvous(1, 1)
+    assert m.num_nodes_waiting() == 1  # running agents see the change
+
+
+def test_truncated_node_stays_waiting_for_next_round():
+    """node_unit=2, 3 joiners: world is 2 nodes; the third stays in the
+    waiting set and joins the next round instead of being dropped."""
+    m = _mgr(2, 4, timeout=0.1, node_unit=2)
+    for r in range(3):
+        m.join_rendezvous(r, 1)
+    time.sleep(0.15)
+    _, _, world = m.get_comm_world(0)
+    assert sorted(world) == [0, 1]
+    # node 2 still waiting, not silently dropped
+    assert m.num_nodes_waiting() == 1
+    _, _, w2 = m.get_comm_world(2)
+    assert w2 == {}
+    # a 4th node joins -> next round can form with {2, 3}
+    m.join_rendezvous(3, 1)
+    time.sleep(0.15)
+    _, _, w_next = m.get_comm_world(2)
+    assert sorted(w_next) == [2, 3]
+
+
+def test_lazy_splitter_serves_full_final_epoch():
+    """max_shard_count-limited splitter must not drop the epoch tail."""
+    splitter = TableDatasetSplitter(
+        "big", dataset_size=100, shard_size=10, num_epochs=1,
+        max_shard_count=4,
+    )
+    mgr = BatchDatasetManager(TaskType.TRAINING, 5, splitter)
+    served = 0
+    while True:
+        t = mgr.get_task("worker", 0)
+        if t.task_id < 0:
+            break
+        served += t.shard.end - t.shard.start
+        mgr.report_task_status(t.task_id, success=True)
+    assert served == 100  # every record of the epoch dispatched
+    assert mgr.completed()
+
+
+def test_network_check_rounds_regroup():
+    m = NetworkCheckRendezvousManager()
+    m.update_rdzv_params(4, 4, 0.2, node_unit=4)  # node_unit ignored
+    for r in range(4):
+        m.join_rendezvous(r, 1)
+    _, g0, w0 = m.get_comm_world(0)
+    assert w0 == {0: 1, 1: 1} and g0 == 0
+    _, g2, w2 = m.get_comm_world(2)
+    assert w2 == {2: 1, 3: 1} and g2 == 1
+    # round 0 results: node 3 abnormal
+    for r in range(4):
+        m.report_network_check_result(r, r != 3, 1.0)
+    ok, reason = m.network_check_success()
+    assert not ok
+    # round 1: rejoin all; abnormal node 3 paired with a normal node
+    for r in range(4):
+        m.join_rendezvous(r, 1)
+    _, _, w3 = m.get_comm_world(3)
+    assert 3 in w3 and len(w3) == 2
+    # node 3 passes when re-paired -> healthy overall
+    for r in range(4):
+        m.report_network_check_result(r, True, 1.0)
+    ok, _ = m.network_check_success()
+    assert ok
+    assert m.get_fault_nodes() == []
