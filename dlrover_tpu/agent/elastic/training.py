@@ -229,6 +229,16 @@ class ElasticTrainingAgent:
         env[NodeEnv.NODE_NUM] = str(len(world))
         env[NodeEnv.RESTART_COUNT] = str(self._restart_count)
         env[NodeEnv.MASTER_ADDR] = self._client.master_addr
+        # Make the framework importable in the spawned process even when it
+        # is not pip-installed and the entrypoint lives in another directory
+        # (``python script.py`` puts the script's dir on sys.path, not cwd).
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+        if pkg_root not in parts:
+            # appended, so user PYTHONPATH overrides still take precedence
+            env["PYTHONPATH"] = os.pathsep.join(parts + [pkg_root])
         cmd = [self._config.entrypoint] + list(self._config.args)
         if cmd[0].endswith(".py"):
             cmd = [sys.executable] + cmd

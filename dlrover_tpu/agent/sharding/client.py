@@ -146,12 +146,21 @@ class IndexShardingClient(ShardingClient):
             logger.error("Shard prefetch thread failed: %s", e)
         finally:
             # always unblock consumers, even on RPC failure — a silent
-            # thread death would leave fetch_sample_index blocked forever
-            self._exhausted = True
+            # thread death would leave fetch_sample_index blocked forever.
+            # A deliberate stop() is NOT exhaustion: the master may still
+            # hold undispatched shards (check the `exhausted` property).
+            if not self._stopped:
+                self._exhausted = True
             self._sample_queue.put(-1)
 
+    @property
+    def exhausted(self) -> bool:
+        """True once the dataset truly ran out (vs. a deliberate stop())."""
+        return self._exhausted
+
     def fetch_sample_index(self) -> Optional[int]:
-        """Next sample index, or None when the dataset is exhausted."""
+        """Next sample index, or None when iteration ended — check
+        ``exhausted`` to distinguish dataset end from a deliberate stop."""
         idx = self._sample_queue.get()
         if idx < 0:
             self._sample_queue.put(-1)  # keep signalling other consumers
@@ -171,3 +180,4 @@ class IndexShardingClient(ShardingClient):
 
     def stop(self):
         self._stopped = True
+        self._sample_queue.put(-1)  # unblock any consumer waiting in get()

@@ -89,14 +89,26 @@ class RendezvousManager(ABC):
         """Number of nodes waiting for a NEW round. Nonzero signals running
         agents to re-rendezvous (membership change)."""
         with self._lock:
-            # only report waiting nodes once a completed world exists and the
-            # waiting set differs from it (new node or node loss)
-            if self._rdzv_nodes and set(self._waiting_nodes) != set(
-                self._rdzv_nodes
-            ):
-                return len(self._waiting_nodes)
             if not self._rdzv_nodes:
                 return len(self._waiting_nodes)
+            waiting = set(self._waiting_nodes)
+            members = set(self._rdzv_nodes)
+            # a current-world member re-joined: node loss/restart, the world
+            # must re-form
+            if waiting & members:
+                return len(self._waiting_nodes)
+            # new nodes only matter if they can actually change the next
+            # world: it grows in node_unit multiples and is capped at
+            # max_nodes. A node_unit leftover (e.g. 3 joiners, unit=2) must
+            # NOT signal, or running agents livelock in restart loops while
+            # every re-rendezvous truncates back to the same world.
+            new_nodes = waiting - members
+            if (
+                new_nodes
+                and len(members) < self._rdzv_params.max_nodes
+                and len(new_nodes) >= self._node_unit
+            ):
+                return len(new_nodes)
             return 0
 
     def _check_rdzv_completed(self):
@@ -182,6 +194,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._node_times: Dict[int, float] = {}
         self._reported_nodes = set()
         self._node_groups: List[Dict[int, int]] = []
+        self._singleton_nodes: set = set()
         self._check_round = 2
 
     def update_rdzv_params(self, min_nodes, max_nodes, waiting_timeout,
@@ -217,6 +230,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         """Pairwise grouping (parity: rdzv_manager.py:294)."""
         round_idx = (round_num - 1) % self._check_round
         node_groups: List[Dict[int, int]] = []
+        self._singleton_nodes = set()
         ranks = sorted(world)
         if round_idx == 0:
             cur: Dict[int, int] = {}
@@ -240,6 +254,10 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                     n0 = normal.pop(0)
                     node_groups.append({a: world[a], n0: world[n0]})
                 else:
+                    # no healthy partner left: a solo probe exercises no
+                    # inter-host link, so its success must not clear the
+                    # abnormal status (see report_network_check_result)
+                    self._singleton_nodes.add(a)
                     node_groups.append({a: world[a]})
             leftover = {r: world[r] for r in normal}
             if leftover:
@@ -252,7 +270,10 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             self._reported_nodes.add(node_rank)
             # latest round wins: a node that failed round 0 but passes the
             # round-1 re-pair with a known-good partner is healthy (its round-0
-            # partner was the broken one)
+            # partner was the broken one) — unless it probed alone, which
+            # proves nothing about its links
+            if normal and node_rank in self._singleton_nodes:
+                normal = self._node_status.get(node_rank, False)
             self._node_status[node_rank] = normal
             self._node_times[node_rank] = elapsed
 

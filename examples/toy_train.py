@@ -117,10 +117,13 @@ def main():
             print(f"SIMULATED CRASH at step {step}", flush=True)
             os._exit(17)
 
-    print(f"FINAL step={step} loss={float(loss):.6f}", flush=True)
+    # loss stays None when the loop body never ran (e.g. restored checkpoint
+    # already at/after --steps, or the dataset was exhausted immediately)
+    loss_val = float(loss) if loss is not None else float("nan")
+    print(f"FINAL step={step} loss={loss_val:.6f}", flush=True)
     if args.out:
         with open(args.out, "w") as f:
-            f.write(f"{step},{float(loss):.6f},{start_step}")
+            f.write(f"{step},{loss_val:.6f},{start_step}")
     return 0
 
 

@@ -59,11 +59,12 @@ def test_sharding_protocol_over_grpc(master, client):
 
 def test_rendezvous_over_grpc(master, client):
     client.report_rdzv_params(
-        min_nodes=2, max_nodes=2, waiting_timeout=1.0, node_unit=1
+        min_nodes=2, max_nodes=3, waiting_timeout=0.5, node_unit=1
     )
     c1 = MasterClient(master.addr, node_id=1, node_type=NodeType.WORKER)
     client.join_rendezvous(0, 4)
     c1.join_rendezvous(1, 4)
+    time.sleep(0.6)  # min-nodes rule completes after waiting_timeout
     rdzv_round, group, world = client.get_comm_world(
         RendezvousName.TRAINING, 0
     )

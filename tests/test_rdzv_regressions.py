@@ -64,15 +64,32 @@ def test_truncated_node_stays_waiting_for_next_round():
     time.sleep(0.15)
     _, _, world = m.get_comm_world(0)
     assert sorted(world) == [0, 1]
-    # node 2 still waiting, not silently dropped
-    assert m.num_nodes_waiting() == 1
+    # node 2 still waiting, not silently dropped — but a lone leftover
+    # (< node_unit) must NOT signal membership change, or the running
+    # agents would livelock restarting into the same truncated world
+    assert m.num_nodes_waiting() == 0
     _, _, w2 = m.get_comm_world(2)
     assert w2 == {}
-    # a 4th node joins -> next round can form with {2, 3}
+    # a 4th node joins -> a full node_unit of new nodes now signals
     m.join_rendezvous(3, 1)
+    assert m.num_nodes_waiting() == 2
     time.sleep(0.15)
     _, _, w_next = m.get_comm_world(2)
     assert sorted(w_next) == [2, 3]
+
+
+def test_member_rejoin_always_signals_membership_change():
+    """A current-world member re-waiting (restart/loss) must signal even
+    when fewer than node_unit nodes wait."""
+    m = _mgr(2, 4, timeout=0.1, node_unit=2)
+    m.join_rendezvous(0, 1)
+    m.join_rendezvous(1, 1)
+    time.sleep(0.15)
+    _, _, world = m.get_comm_world(0)
+    assert sorted(world) == [0, 1]
+    assert m.num_nodes_waiting() == 0
+    m.join_rendezvous(1, 1)  # member restarts
+    assert m.num_nodes_waiting() == 1
 
 
 def test_lazy_splitter_serves_full_final_epoch():
@@ -118,3 +135,31 @@ def test_network_check_rounds_regroup():
     ok, _ = m.network_check_success()
     assert ok
     assert m.get_fault_nodes() == []
+
+
+def test_singleton_probe_cannot_clear_abnormal_status():
+    """Round-1 leaves some abnormal nodes without a healthy partner; their
+    solo probe exercises no inter-host link, so its success must not mark
+    them healthy (a broken-switch scenario would otherwise pass)."""
+    m = NetworkCheckRendezvousManager()
+    m.update_rdzv_params(4, 4, 0.2, node_unit=1)
+    for r in range(4):
+        m.join_rendezvous(r, 1)
+    for r in range(4):
+        m.get_comm_world(r)
+    # round 0: the switch serving nodes 1-3 is broken
+    for r in range(4):
+        m.report_network_check_result(r, r == 0, 1.0)
+    ok, _ = m.network_check_success()
+    assert not ok
+    # round 1: only one healthy partner (node 0) for three abnormal nodes
+    for r in range(4):
+        m.join_rendezvous(r, 1)
+    worlds = {r: m.get_comm_world(r)[2] for r in range(4)}
+    solo = [r for r, w in worlds.items() if len(w) == 1]
+    assert len(solo) == 2  # two abnormal nodes probe alone
+    for r in range(4):
+        m.report_network_check_result(r, True, 1.0)
+    ok, _ = m.network_check_success()
+    assert not ok
+    assert sorted(m.get_fault_nodes()) == sorted(solo)
