@@ -7,7 +7,7 @@ Parity reference: dlrover/python/elastic_agent/sharding/client.py:31,249
 import threading
 import time
 from collections import deque
-from queue import Queue
+from queue import Empty, Full, Queue
 from typing import Callable, Optional
 
 from dlrover_tpu.agent.master_client import get_master_client
@@ -124,48 +124,87 @@ class IndexShardingClient(ShardingClient):
         self._sample_queue: "Queue[int]" = Queue(maxsize=batch_size * 8)
         self._stopped = False
         self._exhausted = False
+        self._failed = False
         self._prefetch_thread = threading.Thread(
             target=self._prefetch_loop, daemon=True,
             name="shard-index-prefetch",
         )
         self._prefetch_thread.start()
 
+    def _put_index(self, idx: int) -> bool:
+        """Bounded put that aborts on stop() instead of blocking forever."""
+        while not self._stopped:
+            try:
+                self._sample_queue.put(idx, timeout=0.1)
+                return True
+            except Full:
+                continue
+        return False
+
     def _prefetch_loop(self):
+        clean = False
         try:
             while not self._stopped:
                 shard = self.fetch_shard()
                 if shard is None:
+                    clean = True  # master says: dataset done
                     break
-                if shard.record_indices:
-                    for idx in shard.record_indices:
-                        self._sample_queue.put(idx)
-                else:
-                    for idx in range(shard.start, shard.end):
-                        self._sample_queue.put(idx)
+                indices = shard.record_indices or range(
+                    shard.start, shard.end
+                )
+                for idx in indices:
+                    if not self._put_index(idx):
+                        break
+            else:
+                clean = True  # stop() requested; not a failure
         except Exception as e:
             logger.error("Shard prefetch thread failed: %s", e)
         finally:
-            # always unblock consumers, even on RPC failure — a silent
-            # thread death would leave fetch_sample_index blocked forever.
-            # A deliberate stop() is NOT exhaustion: the master may still
-            # hold undispatched shards (check the `exhausted` property).
+            # record WHY iteration ended, then unblock consumers. A
+            # deliberate stop() is neither exhaustion nor failure — the
+            # master may still hold undispatched shards.
             if not self._stopped:
-                self._exhausted = True
-            self._sample_queue.put(-1)
+                if clean:
+                    self._exhausted = True
+                else:
+                    self._failed = True
+            try:
+                self._sample_queue.put_nowait(-1)
+            except Full:
+                pass  # consumers drain and then hit the timeout path
 
     @property
     def exhausted(self) -> bool:
-        """True once the dataset truly ran out (vs. a deliberate stop())."""
+        """True only when the dataset cleanly ran out (not on stop() or a
+        prefetch failure)."""
         return self._exhausted
+
+    @property
+    def failed(self) -> bool:
+        """True when the prefetch thread died on an error (RPC loss etc.);
+        samples may remain undispatched on the master."""
+        return self._failed
 
     def fetch_sample_index(self) -> Optional[int]:
         """Next sample index, or None when iteration ended — check
-        ``exhausted`` to distinguish dataset end from a deliberate stop."""
-        idx = self._sample_queue.get()
-        if idx < 0:
-            self._sample_queue.put(-1)  # keep signalling other consumers
-            return None
-        return idx
+        ``exhausted`` / ``failed`` to distinguish dataset end from a
+        deliberate stop or an error."""
+        while True:
+            try:
+                idx = self._sample_queue.get(timeout=0.1)
+            except Empty:
+                # no sentinel needed: a dead/stopped producer + empty
+                # queue means iteration is over
+                if self._stopped or not self._prefetch_thread.is_alive():
+                    return None
+                continue
+            if idx < 0:
+                try:
+                    self._sample_queue.put_nowait(-1)  # re-signal others
+                except Full:
+                    pass
+                return None
+            return idx
 
     def fetch_batch_indices(self, batch_size: Optional[int] = None):
         """A batch of indices (possibly short on epoch end), or None."""
@@ -180,4 +219,9 @@ class IndexShardingClient(ShardingClient):
 
     def stop(self):
         self._stopped = True
-        self._sample_queue.put(-1)  # unblock any consumer waiting in get()
+        try:
+            # best-effort wakeup; consumers also poll _stopped on timeout,
+            # so a full queue cannot deadlock the stopping thread
+            self._sample_queue.put_nowait(-1)
+        except Full:
+            pass

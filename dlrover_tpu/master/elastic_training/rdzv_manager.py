@@ -83,6 +83,10 @@ class RendezvousManager(ABC):
             if node_rank not in self._waiting_nodes:
                 self._waiting_nodes[node_rank] = local_world_size
                 self._lastcall_time = time.time()
+            # joining proves liveness; a later failed/deleted status report
+            # prunes the node (servicer.rpc_update_node_status), which lets
+            # num_nodes_waiting see a spare as a REPLACEMENT for it
+            self._alive_nodes.add(node_rank)
         return self._rdzv_round
 
     def num_nodes_waiting(self) -> int:
@@ -92,23 +96,31 @@ class RendezvousManager(ABC):
             if not self._rdzv_nodes:
                 return len(self._waiting_nodes)
             waiting = set(self._waiting_nodes)
+            if not waiting:
+                return 0
             members = set(self._rdzv_nodes)
             # a current-world member re-joined: node loss/restart, the world
             # must re-form
             if waiting & members:
                 return len(self._waiting_nodes)
-            # new nodes only matter if they can actually change the next
-            # world: it grows in node_unit multiples and is capped at
-            # max_nodes. A node_unit leftover (e.g. 3 joiners, unit=2) must
-            # NOT signal, or running agents livelock in restart loops while
-            # every re-rendezvous truncates back to the same world.
-            new_nodes = waiting - members
-            if (
-                new_nodes
-                and len(members) < self._rdzv_params.max_nodes
-                and len(new_nodes) >= self._node_unit
-            ):
-                return len(new_nodes)
+            # Signal iff the next-round world would DIFFER from the current
+            # one. A node_unit leftover (3 joiners, unit=2) re-truncates to
+            # the same world -> signalling would livelock agents in restart
+            # loops; but a spare replacing a dead member, or a full unit of
+            # growth, forms a different world and must signal.
+            survivors = (
+                members & self._alive_nodes if self._alive_nodes else members
+            )
+            candidates = sorted(waiting | survivors)
+            p = self._rdzv_params
+            keep = min(
+                (len(candidates) // self._node_unit) * self._node_unit,
+                p.max_nodes,
+            )
+            if keep < max(p.min_nodes, 1):
+                return 0
+            if set(candidates[:keep]) != members:
+                return len(self._waiting_nodes)
             return 0
 
     def _check_rdzv_completed(self):

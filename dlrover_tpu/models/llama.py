@@ -1,0 +1,262 @@
+"""Llama-family decoder transformer, TPU-first.
+
+Parity reference: the reference's flagship LLM paths — nanoGPT in
+model_zoo/pytorch/nanogpt/model.py and the Megatron-style TP modules
+(atorch/atorch/modules/distributed_modules/transformer.py) — re-designed
+for XLA instead of translated:
+
+ - pure-pytree params (dict of arrays) + a mirrored *logical axes* tree;
+   every parallelism strategy is a rule table (parallel/sharding.py), not a
+   module rewrite;
+ - all decoder layers are SCAN-STACKED: one set of block weights with a
+   leading "layers" dim, iterated by ``lax.scan`` — one compiled block
+   regardless of depth, and the layers dim doubles as the pipeline-stage
+   axis under the "pipeline" rule set;
+ - ``jax.checkpoint`` with a dots-saveable policy = the reference's
+   activation checkpointing (auto/opt_lib/checkpoint_optimization.py:14);
+ - attention routes through ops.flash_attention (Pallas on TPU);
+ - bf16 params/activations, fp32 RMSNorm accumulation and softmax.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.ops.attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # activation checkpointing per block: "dots" saves matmul outputs
+    # (fastest, most memory), "minimal" recomputes everything (fits big
+    # models on small HBM), "off" disables remat
+    remat: str = "dots"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def llama2_7b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama2_13b(**kw) -> LlamaConfig:
+    return LlamaConfig(
+        hidden_size=5120, intermediate_size=13824, num_layers=40,
+        num_heads=40, num_kv_heads=40, **kw,
+    )
+
+
+def llama_1b(**kw) -> LlamaConfig:
+    """A ~1.1B config (TinyLlama shape) for single-chip benchmarking."""
+    return LlamaConfig(
+        hidden_size=2048, intermediate_size=5632, num_layers=22,
+        num_heads=32, num_kv_heads=4, **kw,
+    )
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    """Test-sized config that still exercises GQA + scan + remat."""
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_kv_heads", 2)
+    kw.setdefault("max_seq_len", 128)
+    return LlamaConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# params
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict:
+    """Initialize the parameter pytree. Block weights carry a leading
+    layers dim (scan stacking)."""
+    h, m, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    k_embed, k_blocks, k_out = jax.random.split(rng, 3)
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def dense_init(key, *shape, in_axis=0):
+        fan_in = shape[in_axis]
+        std = fan_in ** -0.5
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * std
+                ).astype(cfg.dtype)
+
+    ks = jax.random.split(k_blocks, 7)
+    block = {
+        "attn_norm": norm_init(L, h),
+        "wq": dense_init(ks[0], L, h, nh * hd, in_axis=1),
+        "wk": dense_init(ks[1], L, h, nkv * hd, in_axis=1),
+        "wv": dense_init(ks[2], L, h, nkv * hd, in_axis=1),
+        "wo": dense_init(ks[3], L, nh * hd, h, in_axis=1),
+        "mlp_norm": norm_init(L, h),
+        "w_gate": dense_init(ks[4], L, h, m, in_axis=1),
+        "w_up": dense_init(ks[5], L, h, m, in_axis=1),
+        "w_down": dense_init(ks[6], L, m, h, in_axis=1),
+    }
+    return {
+        "embed": (
+            jax.random.normal(
+                k_embed, (cfg.vocab_size, h), dtype=jnp.float32
+            ) * 0.02
+        ).astype(cfg.dtype),
+        "blocks": block,
+        "final_norm": norm_init(h),
+        "lm_head": dense_init(k_out, h, cfg.vocab_size, in_axis=0),
+    }
+
+
+def param_axes(cfg: LlamaConfig) -> Dict:
+    """Logical-axes tree mirroring init_params (see parallel/sharding.py)."""
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "norm"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    L, h, m = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    per_layer = (
+        2 * h  # norms
+        + h * nh * hd + 2 * h * nkv * hd + nh * hd * h  # attention
+        + 3 * h * m  # swiglu mlp
+    )
+    return cfg.vocab_size * h * 2 + h + L * per_layer
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rope_tables(
+    seq_len: int, head_dim: int, theta: float
+) -> Tuple[jax.Array, jax.Array]:
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)  # [seq, head_dim/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [batch, seq, heads, head_dim]; rotate pairs (even, odd)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    ).astype(x.dtype)
+
+
+def _block(cfg: LlamaConfig, x, layer_params, cos, sin, attn_fn):
+    """One decoder block. x: [batch, seq, hidden]."""
+    b, s, h = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    p = layer_params
+
+    y = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = (y @ p["wq"]).reshape(b, s, nh, hd)
+    k = (y @ p["wk"]).reshape(b, s, nkv, hd)
+    v = (y @ p["wv"]).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attn_fn(q, k, v)
+    x = x + attn.reshape(b, s, nh * hd) @ p["wo"]
+
+    y = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(y @ p["w_gate"])
+    x = x + (gate * (y @ p["w_up"])) @ p["w_down"]
+    return x
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,  # int32 [batch, seq]
+    cfg: LlamaConfig,
+    attn_fn=None,
+) -> jax.Array:
+    """Logits [batch, seq, vocab]. ``attn_fn`` overrides attention (e.g.
+    ring attention under sequence parallelism)."""
+    if attn_fn is None:
+        attn_fn = partial(flash_attention, causal=True)
+    s = tokens.shape[1]
+    cos, sin = rope_tables(s, cfg.head_dim, cfg.rope_theta)
+    x = params["embed"][tokens]
+
+    def body(x, layer_params):
+        return _block(cfg, x, layer_params, cos, sin, attn_fn), None
+
+    if cfg.remat == "dots":
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    elif cfg.remat == "minimal":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def next_token_loss(
+    params: Dict, batch: Tuple[jax.Array, jax.Array], cfg: LlamaConfig,
+    attn_fn=None,
+) -> jax.Array:
+    """Mean next-token cross entropy. batch = (tokens, targets), both
+    int32 [batch, seq]; target < 0 masks the position out."""
+    tokens, targets = batch
+    logits = forward(params, tokens, cfg, attn_fn=attn_fn)
+    mask = (targets >= 0).astype(jnp.float32)
+    safe_targets = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, safe_targets[..., None], axis=-1
+    )[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Approximate training FLOPs per token (6N + attention quadratic)."""
+    n = param_count(cfg) - cfg.vocab_size * cfg.hidden_size  # tied-ish
+    attn = 12 * cfg.num_layers * cfg.hidden_size * seq_len
+    return 6.0 * n + attn

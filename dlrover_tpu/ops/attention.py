@@ -1,0 +1,96 @@
+"""Attention ops: XLA reference implementation + Pallas TPU kernel dispatch.
+
+Parity reference: atorch/atorch/modules/transformer/layers.py:706
+(FlashAttention module injection) — the reference injects the Tri-Dao CUDA
+kernel; here the hot path is a Pallas TPU kernel
+(dlrover_tpu/ops/pallas/flash_attention.py) with an XLA fallback that
+compiles everywhere (CPU tests, interpret mode, non-TPU backends).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def mha_reference(
+    q: jax.Array,  # [batch, q_len, heads, head_dim]
+    k: jax.Array,  # [batch, kv_len, kv_heads, head_dim]
+    v: jax.Array,  # [batch, kv_len, kv_heads, head_dim]
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain XLA attention with GQA head-group broadcast.
+
+    Computes in float32 for softmax stability, returns q.dtype. XLA fuses
+    the mask/softmax chain; on TPU the two einsums hit the MXU directly.
+    """
+    b, qlen, h, d = q.shape
+    _, klen, kvh, _ = k.shape
+    if h % kvh:
+        raise ValueError(f"heads {h} not a multiple of kv_heads {kvh}")
+    group = h // kvh
+    scale = scale if scale is not None else d ** -0.5
+
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # fold the GQA group into the query head dim: [b, qlen, kvh, group, d]
+    qf = qf.reshape(b, qlen, kvh, group, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf)
+    if causal:
+        mask = jnp.tril(
+            jnp.ones((qlen, klen), dtype=bool), k=klen - qlen
+        )
+        scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+    return out.reshape(b, qlen, h, d).astype(q.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Memory-efficient attention: Pallas kernel on TPU, XLA elsewhere.
+
+    Layout [batch, seq, heads, head_dim] (the models' native layout).
+    """
+    if _use_pallas(q):
+        from dlrover_tpu.ops.pallas.flash_attention import (
+            flash_attention_tpu,
+        )
+
+        return flash_attention_tpu(
+            q, k, v, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k,
+        )
+    return mha_reference(q, k, v, causal=causal, scale=scale)
+
+
+def _use_pallas(x: jax.Array) -> bool:
+    try:
+        platform = (
+            x.devices().pop().platform
+            if hasattr(x, "devices")
+            else jax.default_backend()
+        )
+    except Exception:
+        platform = jax.default_backend()
+    if platform != "tpu":
+        return False
+    # MXU/VPU lane constraint: head_dim and seq must tile
+    d = x.shape[-1]
+    s = x.shape[1]
+    return d % 128 == 0 and s % 128 == 0

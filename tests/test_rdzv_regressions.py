@@ -78,6 +78,23 @@ def test_truncated_node_stays_waiting_for_next_round():
     assert sorted(w_next) == [2, 3]
 
 
+def test_spare_replaces_dead_member():
+    """World at max_nodes; a member is reported dead and a spare joins:
+    the spare must signal re-rendezvous (it REPLACES the dead member),
+    even though the world cannot grow."""
+    m = _mgr(2, 4, timeout=0.1, node_unit=1)
+    for r in range(4):
+        m.join_rendezvous(r, 1)
+    _, _, world = m.get_comm_world(0)
+    assert sorted(world) == [0, 1, 2, 3]
+    # spare joins while everyone is healthy: same prospective world -> 0
+    m.join_rendezvous(4, 1)
+    assert m.num_nodes_waiting() == 0
+    # control plane reports node 3 dead -> spare 4 now changes the world
+    m.remove_alive_node(3)
+    assert m.num_nodes_waiting() == 1
+
+
 def test_member_rejoin_always_signals_membership_change():
     """A current-world member re-waiting (restart/loss) must signal even
     when fewer than node_unit nodes wait."""

@@ -1,0 +1,85 @@
+"""IndexShardingClient stop/exhaustion/failure semantics.
+
+Regression tests: stop() must not deadlock on a full queue; a prefetch
+RPC failure must surface as ``failed``, not as clean exhaustion.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.sharding.client import IndexShardingClient
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.master.local_master import LocalJobMaster
+from dlrover_tpu.agent.master_client import MasterClient
+
+
+@pytest.fixture
+def master():
+    m = LocalJobMaster(port=0)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def _client(master, **kw):
+    mc = MasterClient(master.addr, node_id=0, node_type="worker")
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("dataset_size", 10_000)
+    kw.setdefault("num_minibatches_per_shard", 1)
+    return IndexShardingClient("stop-ds", master_client=mc, **kw)
+
+
+def test_stop_with_full_queue_does_not_deadlock(master):
+    client = _client(master)
+    # let the prefetch thread fill the bounded queue and block in put
+    time.sleep(0.3)
+    assert client._sample_queue.full()
+    done = threading.Event()
+
+    def stopper():
+        client.stop()
+        done.set()
+
+    t = threading.Thread(target=stopper, daemon=True)
+    t.start()
+    assert done.wait(timeout=2.0), "stop() deadlocked on the full queue"
+    # consumers unblock (drain then None) instead of hanging
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if client.fetch_sample_index() is None:
+            break
+    else:
+        pytest.fail("fetch_sample_index never returned None after stop()")
+    assert not client.exhausted  # a stop is NOT dataset exhaustion
+    assert not client.failed
+
+
+def test_exhaustion_is_clean_end(master):
+    client = _client(master, dataset_size=12, batch_size=4)
+    seen = []
+    while True:
+        idx = client.fetch_sample_index()
+        if idx is None:
+            break
+        seen.append(idx)
+    assert sorted(seen) == list(range(12))
+    assert client.exhausted
+    assert not client.failed
+
+
+def test_rpc_failure_reports_failed_not_exhausted(master):
+    client = _client(master)
+    time.sleep(0.1)
+    # kill the master mid-iteration: the prefetch RPC will error out
+    master.stop()
+    # drain; the client must eventually signal the end of iteration
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        if client.fetch_sample_index() is None:
+            break
+    else:
+        pytest.fail("iteration never ended after master death")
+    assert client.failed
+    assert not client.exhausted
