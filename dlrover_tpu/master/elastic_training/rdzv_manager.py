@@ -108,9 +108,9 @@ class RendezvousManager(ABC):
             # the same world -> signalling would livelock agents in restart
             # loops; but a spare replacing a dead member, or a full unit of
             # growth, forms a different world and must signal.
-            survivors = (
-                members & self._alive_nodes if self._alive_nodes else members
-            )
+            # every waiting node joined (join adds to _alive_nodes), so the
+            # alive set is non-empty here
+            survivors = members & self._alive_nodes
             candidates = sorted(waiting | survivors)
             p = self._rdzv_params
             keep = min(

@@ -1,0 +1,371 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+Parity reference: the reference injects Tri-Dao's CUDA FlashAttention
+(atorch/atorch/modules/transformer/layers.py:706, inject.py:58) — here the
+same O(seq) memory algorithm is a native TPU kernel: online-softmax
+accumulators live in VMEM scratch that persists across the k-block grid
+dimension; the two matmuls per block ride the MXU in fp32 accumulation.
+
+Layout inside the kernels is [batch*heads, seq, head_dim]; the public
+wrapper takes the models' [batch, seq, heads, head_dim] and handles GQA by
+broadcasting KV heads.
+
+Backward follows the FlashAttention-2 structure: a dQ kernel (grid over
+q-blocks, accumulating over k-blocks) and a dK/dV kernel (grid over
+k-blocks, accumulating over q-blocks), with the softmax re-derived from
+the saved logsumexp.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _row_ids(q_start, block_q, block_k):
+    return q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+
+def _col_ids(k_start, block_q, block_k):
+    return k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # k block (minor: sequential, scratch persists)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    # causal: skip blocks fully above the diagonal
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            mask = _row_ids(q_start, block_q, block_k) >= _col_ids(
+                k_start, block_q, block_k
+            )
+            s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, :1]  # [block_q, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        corr = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(l_safe[:, 0]))
+
+
+def _check_blocks(seq, block_q, block_k):
+    if seq % block_q or seq % block_k:
+        raise ValueError(
+            f"seq {seq} must be divisible by block_q={block_q} and "
+            f"block_k={block_k}; pad the sequence or pick smaller blocks"
+        )
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    """q,k,v: [bh, seq, d] -> (o [bh, seq, d], lse [bh, 1, seq] f32)."""
+    bh, seq, d = q.shape
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+    _check_blocks(seq, block_q, block_k)
+    grid = (bh, seq // block_q, seq // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+            # [bh, 1, seq]: keeps the lse block 3-D so its last two dims
+            # (1, block_q) satisfy the TPU (8,128)-or-full tiling rule
+            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_scr, *, scale, causal, block_q, block_k):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            mask = _row_ids(q_start, block_q, block_k) >= _col_ids(
+                k_start, block_q, block_k
+            )
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])  # [bq, bk]
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None])  # [bq, bk]
+        acc_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = (acc_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, block_q, block_k):
+    j = pl.program_id(1)  # k block (major)
+    i = pl.program_id(2)  # q block (minor: accumulates)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            mask = _row_ids(q_start, block_q, block_k) >= _col_ids(
+                k_start, block_q, block_k
+            )
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        do = do_ref[0].astype(jnp.float32)
+        # dV += P^T @ dO
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        # dK += dS^T @ Q  (Q already carries the scale factor)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+    bh, seq, d = q.shape
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+    _check_blocks(seq, block_q, block_k)
+    delta = jnp.sum(
+        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+    )[:, None, :]  # [bh, 1, seq] (3-D for TPU block tiling)
+
+    dq_kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    in_specs_q = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # q
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # v
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # do
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),  # lse
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),  # delta
+    ]
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, seq // block_q, seq // block_k),
+        in_specs=in_specs_q,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    in_specs_kv = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # q
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # k
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # v
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # do
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),  # lse
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),  # delta
+    ]
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, seq // block_k, seq // block_q),
+        in_specs=in_specs_kv,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public wrapper with custom VJP
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(
+        q, k, v, o, lse, do, scale, causal, block_q, block_k
+    )
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_tpu(
+    q: jax.Array,  # [batch, seq, heads, head_dim]
+    k: jax.Array,  # [batch, seq, kv_heads, head_dim]
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Flash attention in the models' [batch, seq, heads, head_dim]
+    layout; GQA via KV-head broadcast."""
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+    if kvh != h:
+        group = h // kvh
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    # [b, s, h, d] -> [b*h, s, d]
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    o = _flash_bhsd(
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, causal,
+        block_q, block_k,
+    )
+    return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
