@@ -22,11 +22,15 @@ def mha_reference(
     v: jax.Array,  # [batch, kv_len, kv_heads, head_dim]
     causal: bool = True,
     scale: Optional[float] = None,
-) -> jax.Array:
+    mask: Optional[jax.Array] = None,  # bool [q_len, kv_len], True=keep
+    return_lse: bool = False,
+):
     """Plain XLA attention with GQA head-group broadcast.
 
     Computes in float32 for softmax stability, returns q.dtype. XLA fuses
     the mask/softmax chain; on TPU the two einsums hit the MXU directly.
+    With ``return_lse`` also returns the logsumexp [batch, heads, q_len]
+    (float32) for blockwise/ring combination.
     """
     b, qlen, h, d = q.shape
     _, klen, kvh, _ = k.shape
@@ -42,13 +46,26 @@ def mha_reference(
     qf = qf.reshape(b, qlen, kvh, group, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf)
     if causal:
-        mask = jnp.tril(
-            jnp.ones((qlen, klen), dtype=bool), k=klen - qlen
-        )
+        tril = jnp.tril(jnp.ones((qlen, klen), dtype=bool), k=klen - qlen)
+        mask = tril if mask is None else (mask & tril)
+    if mask is not None:
         scores = jnp.where(mask[None, None, None], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
-    return out.reshape(b, qlen, h, d).astype(q.dtype)
+    # explicit online-softmax form; p hard-zeroed under the mask so a
+    # fully-masked row yields zeros (not the mean of V)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    if mask is not None:
+        p = jnp.where(mask[None, None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p / l_safe, vf)
+    out = out.reshape(b, qlen, h, d).astype(q.dtype)
+    if not return_lse:
+        return out
+    lse = (m + jnp.log(l_safe))[..., 0]  # [b, kvh, group, qlen]
+    lse = jnp.where(l[..., 0] == 0.0, NEG_INF, lse)
+    lse = lse.reshape(b, h, qlen)
+    return out, lse
 
 
 @functools.partial(
