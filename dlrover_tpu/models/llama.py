@@ -44,6 +44,11 @@ class LlamaConfig:
     # (fastest, most memory), "minimal" recomputes everything (fits big
     # models on small HBM), "off" disables remat
     remat: str = "dots"
+    # MoE (0 = dense): replaces every block's MLP with a top-k routed
+    # expert SwiGLU (parallel/moe.py); experts shard on the expert axis
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -67,6 +72,12 @@ def llama_1b(**kw) -> LlamaConfig:
         hidden_size=2048, intermediate_size=5632, num_layers=22,
         num_heads=32, num_kv_heads=4, **kw,
     )
+
+
+def llama_moe_tiny(**kw) -> LlamaConfig:
+    """Test-sized MoE config (4 experts, top-2)."""
+    kw.setdefault("num_experts", 4)
+    return llama_tiny(**kw)
 
 
 def llama_tiny(**kw) -> LlamaConfig:
@@ -100,7 +111,7 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict:
         return (jax.random.normal(key, shape, dtype=jnp.float32) * std
                 ).astype(cfg.dtype)
 
-    ks = jax.random.split(k_blocks, 7)
+    ks = jax.random.split(k_blocks, 8)
     block = {
         "attn_norm": norm_init(L, h),
         "wq": dense_init(ks[0], L, h, nh * hd, in_axis=1),
@@ -108,10 +119,21 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict:
         "wv": dense_init(ks[2], L, h, nkv * hd, in_axis=1),
         "wo": dense_init(ks[3], L, nh * hd, h, in_axis=1),
         "mlp_norm": norm_init(L, h),
-        "w_gate": dense_init(ks[4], L, h, m, in_axis=1),
-        "w_up": dense_init(ks[5], L, h, m, in_axis=1),
-        "w_down": dense_init(ks[6], L, m, h, in_axis=1),
     }
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        block.update({
+            "router": dense_init(ks[7], L, h, E, in_axis=1),
+            "w_gate": dense_init(ks[4], L, E, h, m, in_axis=2),
+            "w_up": dense_init(ks[5], L, E, h, m, in_axis=2),
+            "w_down": dense_init(ks[6], L, E, m, h, in_axis=2),
+        })
+    else:
+        block.update({
+            "w_gate": dense_init(ks[4], L, h, m, in_axis=1),
+            "w_up": dense_init(ks[5], L, h, m, in_axis=1),
+            "w_down": dense_init(ks[6], L, m, h, in_axis=1),
+        })
     return {
         "embed": (
             jax.random.normal(
@@ -126,19 +148,30 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict:
 
 def param_axes(cfg: LlamaConfig) -> Dict:
     """Logical-axes tree mirroring init_params (see parallel/sharding.py)."""
-    return {
-        "embed": ("vocab", "embed"),
-        "blocks": {
-            "attn_norm": ("layers", "norm"),
-            "wq": ("layers", "embed", "heads"),
-            "wk": ("layers", "embed", "kv_heads"),
-            "wv": ("layers", "embed", "kv_heads"),
-            "wo": ("layers", "heads", "embed"),
-            "mlp_norm": ("layers", "norm"),
+    blocks = {
+        "attn_norm": ("layers", "norm"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "norm"),
+    }
+    if cfg.num_experts > 0:
+        blocks.update({
+            "router": ("layers", "embed", None),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        blocks.update({
             "w_gate": ("layers", "embed", "mlp"),
             "w_up": ("layers", "embed", "mlp"),
             "w_down": ("layers", "mlp", "embed"),
-        },
+        })
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": blocks,
         "final_norm": ("norm",),
         "lm_head": ("embed", "vocab"),
     }
@@ -147,10 +180,14 @@ def param_axes(cfg: LlamaConfig) -> Dict:
 def param_count(cfg: LlamaConfig) -> int:
     L, h, m = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    if cfg.num_experts > 0:
+        mlp = h * cfg.num_experts + 3 * h * m * cfg.num_experts
+    else:
+        mlp = 3 * h * m
     per_layer = (
         2 * h  # norms
         + h * nh * hd + 2 * h * nkv * hd + nh * hd * h  # attention
-        + 3 * h * m  # swiglu mlp
+        + mlp
     )
     return cfg.vocab_size * h * 2 + h + L * per_layer
 
@@ -187,7 +224,8 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _block(cfg: LlamaConfig, x, layer_params, cos, sin, attn_fn):
-    """One decoder block. x: [batch, seq, hidden]."""
+    """One decoder block. x: [batch, seq, hidden]. Returns (x, aux_loss)
+    where aux_loss is the MoE balance loss (0 for dense)."""
     b, s, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     p = layer_params
@@ -202,9 +240,18 @@ def _block(cfg: LlamaConfig, x, layer_params, cos, sin, attn_fn):
     x = x + attn.reshape(b, s, nh * hd) @ p["wo"]
 
     y = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    if cfg.num_experts > 0:
+        from dlrover_tpu.parallel.moe import moe_mlp
+
+        out, aux = moe_mlp(
+            y, p["router"], p["w_gate"], p["w_up"], p["w_down"],
+            k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
+        return x + out, aux
     gate = jax.nn.silu(y @ p["w_gate"])
     x = x + (gate * (y @ p["w_up"])) @ p["w_down"]
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def forward(
@@ -212,17 +259,21 @@ def forward(
     tokens: jax.Array,  # int32 [batch, seq]
     cfg: LlamaConfig,
     attn_fn=None,
-) -> jax.Array:
+    return_aux: bool = False,
+):
     """Logits [batch, seq, vocab]. ``attn_fn`` overrides attention (e.g.
-    ring attention under sequence parallelism)."""
+    ring attention under sequence parallelism). With ``return_aux`` also
+    returns the summed MoE auxiliary loss."""
     if attn_fn is None:
         attn_fn = partial(flash_attention, causal=True)
     s = tokens.shape[1]
     cos, sin = rope_tables(s, cfg.head_dim, cfg.rope_theta)
     x = params["embed"][tokens]
 
-    def body(x, layer_params):
-        return _block(cfg, x, layer_params, cos, sin, attn_fn), None
+    def body(carry, layer_params):
+        x, aux_sum = carry
+        x, aux = _block(cfg, x, layer_params, cos, sin, attn_fn)
+        return (x, aux_sum + aux), None
 
     if cfg.remat == "dots":
         body = jax.checkpoint(
@@ -233,9 +284,14 @@ def forward(
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable
         )
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+    (x, aux), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+    )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if return_aux:
+        return logits, aux
+    return logits
 
 
 def next_token_loss(
@@ -245,18 +301,27 @@ def next_token_loss(
     """Mean next-token cross entropy. batch = (tokens, targets), both
     int32 [batch, seq]; target < 0 masks the position out."""
     tokens, targets = batch
-    logits = forward(params, tokens, cfg, attn_fn=attn_fn)
+    logits, aux = forward(
+        params, tokens, cfg, attn_fn=attn_fn, return_aux=True
+    )
     mask = (targets >= 0).astype(jnp.float32)
     safe_targets = jnp.maximum(targets, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(
         logp, safe_targets[..., None], axis=-1
     )[..., 0]
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return ce + aux  # aux arrives pre-scaled (parallel/moe.py coefs)
 
 
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
-    """Approximate training FLOPs per token (6N + attention quadratic)."""
+    """Approximate training FLOPs per token (6N_active + attention
+    quadratic). For MoE, only the top-k routed experts execute per token,
+    so N counts k experts — not all E."""
     n = param_count(cfg) - cfg.vocab_size * cfg.hidden_size  # tied-ish
+    if cfg.num_experts > 0:
+        L, h, m = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+        inactive = cfg.num_experts - min(cfg.moe_top_k, cfg.num_experts)
+        n -= L * 3 * h * m * inactive
     attn = 12 * cfg.num_layers * cfg.hidden_size * seq_len
     return 6.0 * n + attn

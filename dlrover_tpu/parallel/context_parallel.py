@@ -22,15 +22,15 @@ through ppermute/all_to_all transposes, so no custom backward is needed.
 """
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from dlrover_tpu.ops.attention import NEG_INF, mha_reference
-from dlrover_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQ_AXIS
+from dlrover_tpu.parallel.mesh import SEQ_AXIS, batch_axes
 
 
 def _ring_local(q, k, v, *, axis_name: str, sp: int, causal: bool,
@@ -112,9 +112,7 @@ def ring_attention(
     h, kvh = q.shape[2], k.shape[2]
     if kvh == 0 or h % kvh:
         raise ValueError(f"heads {h} not a multiple of kv_heads {kvh}")
-    batch_spec = tuple(
-        a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names
-    ) or None
+    batch_spec = batch_axes(mesh) or None
     spec = P(batch_spec, axis_name, None, None)
     fn = shard_map(
         functools.partial(
@@ -122,7 +120,7 @@ def ring_attention(
             scale=scale,
         ),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v)
 
@@ -174,9 +172,7 @@ def ulysses_attention(
         # they cannot be split sp ways themselves
         k = jnp.repeat(k, h // kvh, axis=2)
         v = jnp.repeat(v, h // kvh, axis=2)
-    batch_spec = tuple(
-        a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names
-    ) or None
+    batch_spec = batch_axes(mesh) or None
     spec = P(batch_spec, axis_name, None, None)
     fn = shard_map(
         functools.partial(
@@ -184,7 +180,7 @@ def ulysses_attention(
             scale=scale, attn_impl=attn_impl,
         ),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v)
 

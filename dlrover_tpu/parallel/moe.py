@@ -1,0 +1,125 @@
+"""Mixture-of-Experts with expert parallelism, TPU-first.
+
+Parity reference: atorch/atorch/modules/moe/ — ``MOELayer`` with explicit
+``_AllToAll`` autograd dispatch (moe_layer.py:87,161), expert process
+groups (:29), top-k and switch gating (topk_gating.py, switch_gating.py),
+and the MoE-aware DDP that excludes expert params from the global
+allreduce (ddp.py:26).
+
+TPU-native redesign: dispatch/combine are capacity-bucketed EINSUMS over a
+one-hot routing tensor; sharding expert weights on the "expert" mesh axis
+and tokens on the data axes makes GSPMD insert the all-to-alls the
+reference wrote by hand — and the expert/non-expert gradient split falls
+out of the sharding rules (expert params simply aren't replicated), no
+special DDP needed. Gating runs in fp32; an auxiliary load-balance loss
+(Switch-style) and router z-loss are returned for the trainer to add.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# loss coefficients owned HERE (callers add aux unscaled): Switch-style
+# balance loss at 1e-2, router z-loss at 1e-3
+BALANCE_LOSS_COEF = 1e-2
+Z_LOSS_COEF = 1e-3
+
+
+def topk_gating(
+    logits: jax.Array,  # [tokens, experts] fp32
+    k: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing with per-expert capacity.
+
+    Returns (dispatch [N, E, C] bool-ish fp32, combine [N, E, C] fp32,
+    aux_loss scalar). Tokens overflowing an expert's capacity are dropped
+    (standard Switch/GShard semantics).
+    """
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # aux load-balance loss (Switch eq.4): E * sum_e f_e * p_e, using the
+    # top-1 assignment fraction f_e and mean router prob p_e
+    top1 = jnp.argmax(probs, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(f * p)
+
+    dispatch = jnp.zeros((n, e, capacity), jnp.float32)
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    # iterate the k choices (k is small and static); queue positions carry
+    # a running per-expert offset so later rounds don't collide with slots
+    # already filled by earlier rounds
+    counts = jnp.zeros((e,), jnp.float32)
+    masked_probs = probs
+    for _ in range(k):
+        choice = jnp.argmax(masked_probs, axis=-1)  # [N]
+        gate = jnp.take_along_axis(
+            masked_probs, choice[:, None], axis=-1
+        )[:, 0]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [N, E]
+        # position of each token within its chosen expert's queue
+        pos = (
+            (jnp.cumsum(onehot, axis=0) - 1.0) + counts[None, :]
+        ) * onehot  # [N, E]
+        in_cap = (pos < capacity) & (onehot > 0)
+        counts = counts + jnp.sum(onehot, axis=0)
+        pos_cap = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+        slot = jax.nn.one_hot(pos_cap, capacity, dtype=jnp.float32)
+        contrib = (
+            onehot * in_cap.astype(jnp.float32)
+        )[..., None] * slot  # [N, E, C]
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gate[:, None, None]
+        masked_probs = masked_probs * (1.0 - onehot)  # exclude chosen
+
+    # renormalize combine weights over the selected experts
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.where(denom == 0.0, 1.0, denom)
+    return dispatch, combine, aux_loss
+
+
+def moe_mlp(
+    x: jax.Array,  # [batch, seq, hidden]
+    gate_w: jax.Array,  # [hidden, experts]
+    w_gate: jax.Array,  # [experts, hidden, mlp]  (SwiGLU gate proj)
+    w_up: jax.Array,  # [experts, hidden, mlp]
+    w_down: jax.Array,  # [experts, mlp, hidden]
+    k: int = 2,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """MoE SwiGLU block: route -> expert compute -> combine.
+
+    Returns (out [batch, seq, hidden], aux_loss). ``aux_loss`` is FULLY
+    scaled (balance + z-loss coefficients applied here) — callers add it
+    to the main loss as-is. Expert dims shard over
+    the "expert" mesh axis via the models' logical-axes rules; the
+    dispatch/combine einsums become all-to-alls under GSPMD.
+    """
+    b, s, h = x.shape
+    e = gate_w.shape[-1]
+    n = b * s
+    capacity = max(1, int(capacity_factor * n * k / e))
+    flat = x.reshape(n, h)
+
+    router_logits = (flat.astype(jnp.float32)
+                     @ gate_w.astype(jnp.float32))  # [N, E]
+    # router z-loss keeps logits small (stability on bf16)
+    z_loss = Z_LOSS_COEF * jnp.mean(
+        jax.nn.logsumexp(router_logits, axis=-1) ** 2
+    )
+    dispatch, combine, balance = topk_gating(router_logits, k, capacity)
+    aux = BALANCE_LOSS_COEF * balance + z_loss
+
+    xe = jnp.einsum(
+        "nec,nd->ecd", dispatch.astype(x.dtype), flat
+    )  # [E, C, H]
+    gate_act = jax.nn.silu(jnp.einsum("ecd,edm->ecm", xe, w_gate))
+    up = jnp.einsum("ecd,edm->ecm", xe, w_up)
+    ye = jnp.einsum("ecm,emd->ecd", gate_act * up, w_down)  # [E, C, H]
+    out = jnp.einsum(
+        "nec,ecd->nd", combine.astype(x.dtype), ye
+    ).reshape(b, s, h)
+    return out, aux

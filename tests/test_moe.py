@@ -1,0 +1,95 @@
+"""MoE / expert-parallelism tests on the 8-device CPU mesh.
+
+Parity coverage for the reference's MOELayer + gating tests
+(atorch/atorch/modules/moe/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel import sharding as shd
+from dlrover_tpu.parallel.mesh import create_mesh
+from dlrover_tpu.parallel.moe import moe_mlp, topk_gating
+from dlrover_tpu.trainer.sharded import make_trainer_for_llama
+
+
+def test_topk_gating_routes_within_capacity():
+    logits = jax.random.normal(jax.random.key(0), (32, 4))
+    dispatch, combine, aux = topk_gating(logits, k=2, capacity=16)
+    assert dispatch.shape == (32, 4, 16)
+    # each token dispatched to at most k experts
+    per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    assert (per_token <= 2 + 1e-6).all()
+    # each (expert, slot) holds at most one token
+    per_slot = np.asarray(jnp.sum(dispatch, axis=0))
+    assert (per_slot <= 1 + 1e-6).all()
+    # combine weights normalized per token (where any expert selected)
+    w = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    assert np.allclose(w[per_token > 0], 1.0, atol=1e-5)
+    assert float(aux) > 0
+
+
+def test_capacity_drops_overflow_tokens():
+    # all tokens prefer expert 0; tiny capacity forces drops
+    logits = jnp.tile(jnp.array([[10.0, 0.0, 0.0, 0.0]]), (32, 1))
+    dispatch, _, _ = topk_gating(logits, k=1, capacity=4)
+    assert float(jnp.sum(dispatch[:, 0])) == 4.0  # only 4 slots used
+
+
+def test_moe_mlp_shapes_and_grads():
+    h, m, e = 16, 32, 4
+    ks = jax.random.split(jax.random.key(1), 5)
+    x = jax.random.normal(ks[0], (2, 8, h))
+    gate_w = jax.random.normal(ks[1], (h, e)) * 0.1
+    w_gate = jax.random.normal(ks[2], (e, h, m)) * 0.1
+    w_up = jax.random.normal(ks[3], (e, h, m)) * 0.1
+    w_down = jax.random.normal(ks[4], (e, m, h)) * 0.1
+    out, aux = moe_mlp(x, gate_w, w_gate, w_up, w_down, k=2)
+    assert out.shape == x.shape
+    assert np.isfinite(float(aux))
+    g = jax.grad(
+        lambda ws: jnp.sum(
+            moe_mlp(x, gate_w, ws[0], ws[1], ws[2], k=2)[0] ** 2
+        )
+    )((w_gate, w_up, w_down))
+    # every expert that received tokens gets gradient signal
+    assert float(jnp.sum(jnp.abs(g[0]))) > 0
+
+
+def test_moe_llama_trains_with_expert_parallelism():
+    cfg = llama.llama_moe_tiny()
+    mesh = create_mesh([("data", 2), ("expert", 4)])
+    trainer = make_trainer_for_llama(
+        cfg, mesh, strategy="tp_fsdp", optimizer=optax.adam(1e-2),
+    )
+    params, opt_state = trainer.init(jax.random.key(0))
+    # expert weights actually sharded over the expert axis
+    wg = params["blocks"]["w_gate"]
+    assert wg.sharding.spec == P(None, "expert")
+    assert wg.sharding.shard_shape(wg.shape)[1] == cfg.num_experts // 4
+
+    tokens = np.asarray(
+        jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab_size)
+    )
+    batch = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, batch
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_moe_dense_parity_param_count():
+    """param_count accounting matches the real pytree for MoE configs."""
+    for cfg in (llama.llama_tiny(), llama.llama_moe_tiny()):
+        params = llama.init_params(jax.random.key(0), cfg)
+        real = sum(
+            x.size for x in jax.tree.leaves(params)
+        )
+        assert real == llama.param_count(cfg), cfg.num_experts

@@ -1,0 +1,96 @@
+"""GPipe pipeline-parallel tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.mesh import create_mesh
+from dlrover_tpu.parallel.pipeline import (
+    gpipe_apply,
+    pipeline_llama_forward,
+)
+
+
+def _cfg():
+    return llama.llama_tiny(num_layers=4, remat="off")
+
+
+def test_pipeline_forward_matches_dense():
+    cfg = _cfg()
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0,
+                                cfg.vocab_size)
+    mesh = create_mesh([("pipe", 4)], devices=jax.devices()[:4])
+    logits_pp = jax.jit(
+        lambda p, t: pipeline_llama_forward(
+            p, t, cfg, mesh, num_microbatches=4
+        )
+    )(params, tokens)
+    logits_dense = llama.forward(params, tokens, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits_pp), np.asarray(logits_dense),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_pipeline_degrades_to_scan_on_pp1():
+    cfg = _cfg()
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0,
+                                cfg.vocab_size)
+    mesh = create_mesh([("data", 8)])  # no pipe axis
+    logits = pipeline_llama_forward(params, tokens, cfg, mesh,
+                                    num_microbatches=2)
+    dense = llama.forward(params, tokens, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(dense), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_pipeline_rejects_indivisible_layers():
+    cfg = llama.llama_tiny(num_layers=3, remat="off")
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    mesh = create_mesh([("pipe", 4)], devices=jax.devices()[:4])
+    with pytest.raises(ValueError):
+        pipeline_llama_forward(params, tokens, cfg, mesh,
+                               num_microbatches=2)
+
+
+def test_pipeline_training_learns():
+    """End-to-end: grads flow backward through the ppermute chain."""
+    cfg = _cfg()
+    mesh = create_mesh([("pipe", 4)], devices=jax.devices()[:4])
+    params = llama.init_params(jax.random.key(0), cfg)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0,
+                                cfg.vocab_size)
+
+    def loss_fn(p):
+        logits = pipeline_llama_forward(
+            p, tokens, cfg, mesh, num_microbatches=4
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, tokens[..., None], axis=-1)
+        )
+
+    step = jax.jit(
+        lambda p, s: (lambda l, g: (l, *_apply(opt, g, s, p)))(
+            *jax.value_and_grad(loss_fn)(p)
+        )
+    )
+
+    def _apply(opt, g, s, p):
+        updates, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s2
+
+    losses = []
+    for _ in range(8):
+        loss, params, opt_state = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
