@@ -1,0 +1,212 @@
+"""auto_accelerate: pick and apply the best acceleration strategy.
+
+Parity reference: atorch/atorch/auto/accelerate.py:390 (auto_accelerate),
+auto/engine/acceleration_engine.py:13 (rank-0 gRPC task engine),
+auto/dry_runner/dry_runner.py (profiling), combination strategy
+generation (auto/engine/sg_algo/combination_sg.py).
+
+TPU-native redesign — the engine's gRPC choreography DISAPPEARS: torch
+needed a rank-0 service because every rank is a peer process that must be
+told which transform to apply; JAX is single-controller, so the search is
+a plain function — enumerate candidates (auto/strategy.py), rank with the
+analytic memory/time models (auto/analyser.py), optionally dry-run the
+top-k by compiling + timing the real jitted step, return the winning
+ShardedTrainer. On multi-host the same deterministic search runs
+everywhere and agrees without communication."""
+
+import dataclasses
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from dlrover_tpu.auto.analyser import (
+    ModelProfile,
+    estimate_memory,
+    estimate_step_time,
+)
+from dlrover_tpu.auto.strategy import Strategy, enumerate_strategies
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel.mesh import create_mesh
+
+
+@dataclasses.dataclass
+class CandidateReport:
+    strategy: Strategy
+    memory_bytes: float
+    est_step_seconds: float
+    measured_step_seconds: Optional[float] = None
+    fits: bool = True
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class AccelerateResult:
+    trainer: object
+    strategy: Strategy
+    reports: List[CandidateReport]
+
+
+def _device_hbm_bytes(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    if "lite" in kind or "v5e" in kind:
+        return 16e9
+    if "v4" in kind:
+        return 32e9
+    return 95e9  # v5p/v6e class
+
+
+def build_trainer(cfg, strategy: Strategy, devices=None,
+                  optimizer=None):
+    """Materialize a ShardedTrainer for one strategy."""
+    from dlrover_tpu.trainer.sharded import make_trainer_for_llama
+
+    mesh = create_mesh(list(strategy.mesh_spec), devices)
+    attn_fn = None
+    if strategy.context_parallel:
+        from dlrover_tpu.parallel.context_parallel import (
+            make_context_parallel_attn,
+        )
+
+        attn_fn = make_context_parallel_attn(
+            mesh, kind=strategy.context_parallel
+        )
+    cfg = dataclasses.replace(cfg, remat=strategy.remat)
+    return make_trainer_for_llama(
+        cfg, mesh, strategy=strategy.sharding,
+        accum_steps=strategy.accum_steps, optimizer=optimizer,
+        attn_fn=attn_fn,
+    )
+
+
+def dryrun_strategy(
+    cfg, strategy: Strategy, global_batch: int, seq_len: int,
+    devices=None, steps: int = 3, optimizer=None,
+) -> float:
+    """Compile + time the real train step (parity: DryRunner.profile)."""
+    trainer = build_trainer(cfg, strategy, devices, optimizer)
+    params, opt_state = trainer.init(jax.random.key(0))
+    tokens = np.random.randint(
+        0, cfg.vocab_size, (global_batch, seq_len), dtype=np.int32
+    )
+    batch = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
+    params, opt_state, loss = trainer.train_step(
+        params, opt_state, batch
+    )
+    float(loss)  # sync out compile+first step
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, batch
+        )
+    float(loss)
+    return (time.perf_counter() - t0) / steps
+
+
+def auto_accelerate(
+    cfg,
+    global_batch: int,
+    seq_len: int,
+    devices: Optional[Sequence] = None,
+    strategies: Optional[List[Strategy]] = None,
+    dryrun_top_k: int = 0,
+    load_strategy_path: Optional[str] = None,
+    optimizer=None,
+    hbm_bytes: Optional[float] = None,
+    mfu_guess: float = 0.4,
+) -> AccelerateResult:
+    """Pick the best strategy for ``cfg`` on ``devices`` and return the
+    ready-to-train ShardedTrainer (parity: auto_accelerate
+    accelerate.py:390, incl. the load_strategy fast path :505)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if load_strategy_path:
+        from dlrover_tpu.auto.strategy import load_strategy
+
+        strategy = load_strategy(load_strategy_path)
+        strategy = adjust_strategy(strategy, len(devices), global_batch)
+        trainer = build_trainer(cfg, strategy, devices, optimizer)
+        return AccelerateResult(trainer, strategy, [])
+
+    profile = ModelProfile.from_llama(cfg, seq_len)
+    hbm = hbm_bytes or _device_hbm_bytes(devices[0])
+    candidates = strategies or enumerate_strategies(
+        len(devices), global_batch,
+        num_experts=getattr(cfg, "num_experts", 0),
+    )
+    reports: List[CandidateReport] = []
+    for s in candidates:
+        if s.num_devices != len(devices):
+            continue
+        mem = estimate_memory(profile, s, global_batch, seq_len)
+        t = estimate_step_time(
+            profile, s, global_batch, seq_len, mfu=mfu_guess,
+        )
+        reports.append(CandidateReport(
+            s, mem.total, t, fits=mem.total < 0.9 * hbm,
+        ))
+    fitting = [r for r in reports if r.fits]
+    if not fitting:
+        # nothing fits the analytic model: keep the most-sharded, most
+        # rematerialized candidate and let XLA be the judge
+        fitting = sorted(reports, key=lambda r: r.memory_bytes)[:1]
+        if not fitting:
+            raise ValueError(
+                f"no strategy candidates for {len(devices)} devices"
+            )
+    fitting.sort(key=lambda r: r.est_step_seconds)
+
+    if dryrun_top_k > 0:
+        for r in fitting[:dryrun_top_k]:
+            try:
+                r.measured_step_seconds = dryrun_strategy(
+                    cfg, r.strategy, global_batch, seq_len, devices,
+                    optimizer=optimizer,
+                )
+                logger.info(
+                    "dryrun %s: %.1f ms", r.strategy,
+                    r.measured_step_seconds * 1e3,
+                )
+            except Exception as e:  # OOM / compile failure disqualifies
+                r.fits, r.error = False, str(e)[:200]
+                logger.warning("dryrun failed for %s: %s", r.strategy, e)
+        measured = [
+            r for r in fitting[:dryrun_top_k]
+            if r.measured_step_seconds is not None
+        ]
+        if measured:
+            measured.sort(key=lambda r: r.measured_step_seconds)
+            best = measured[0]
+        else:
+            best = fitting[0]
+    else:
+        best = fitting[0]
+    logger.info(
+        "auto_accelerate picked %s (est %.1f ms/step, mem %.1f GB)",
+        best.strategy, best.est_step_seconds * 1e3,
+        best.memory_bytes / 1e9,
+    )
+    trainer = build_trainer(cfg, best.strategy, devices, optimizer)
+    return AccelerateResult(trainer, best.strategy, reports)
+
+
+def adjust_strategy(
+    strategy: Strategy, num_devices: int, global_batch: int
+) -> Strategy:
+    """Refit a saved strategy to the CURRENT device count (parity:
+    accelerate.py:305 adjust_strategy — the data-parallel dim absorbs
+    cluster size changes; model-parallel dims are preserved)."""
+    model_axes = [
+        (a, s) for a, s in strategy.mesh_spec if a not in ("data",)
+    ]
+    model_size = 1
+    for _, s in model_axes:
+        model_size *= s
+    if num_devices % model_size:
+        raise ValueError(
+            f"saved strategy needs a multiple of {model_size} devices, "
+            f"have {num_devices}"
+        )
+    data = num_devices // model_size
+    new_spec = tuple([("data", data)] + model_axes)
+    return dataclasses.replace(strategy, mesh_spec=new_spec)

@@ -1,0 +1,134 @@
+"""Acceleration strategies: the searchable configuration space.
+
+Parity reference: atorch strategies are pickled lists of (method-name,
+config, tunable) applied by module rewrite (auto/accelerate.py:246-302
+save/load, auto/engine/strategy.py:49 StrategyInfoCollection).
+
+TPU-native redesign: a strategy is a small, JSON-serializable value
+object — (mesh shape x sharding rule table x remat policy x precision x
+accum steps). Applying one never rewrites a model; it parameterizes the
+jit (trainer/sharded.py). The reference's 12 opt_lib methods map onto
+these four orthogonal knobs (SURVEY §7: "the opt_lib becomes a library of
+sharding rules + compiler flags")."""
+
+import dataclasses
+import json
+from typing import List, Optional, Tuple
+
+REMAT_POLICIES = ("off", "dots", "minimal")
+PRECISIONS = ("bf16", "fp32")
+
+
+@dataclasses.dataclass(frozen=True)
+class Strategy:
+    """One point in the acceleration search space."""
+
+    mesh_spec: Tuple[Tuple[str, int], ...]  # e.g. (("data",2),("fsdp",4))
+    sharding: str = "fsdp"  # rule table name (parallel/sharding.py)
+    remat: str = "dots"
+    precision: str = "bf16"
+    accum_steps: int = 1
+    context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
+
+    def __post_init__(self):
+        if self.remat not in REMAT_POLICIES:
+            raise ValueError(f"remat {self.remat!r}")
+        if self.precision not in PRECISIONS:
+            raise ValueError(f"precision {self.precision!r}")
+        if self.accum_steps < 1:
+            raise ValueError("accum_steps >= 1")
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for _, s in self.mesh_spec:
+            n *= s
+        return n
+
+    def axis(self, name: str) -> int:
+        for a, s in self.mesh_spec:
+            if a == name:
+                return s
+        return 1
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["mesh_spec"] = [list(x) for x in self.mesh_spec]
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Strategy":
+        d = json.loads(s)
+        d["mesh_spec"] = tuple(tuple(x) for x in d["mesh_spec"])
+        return cls(**d)
+
+
+def save_strategy(strategy: Strategy, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(strategy.to_json())
+
+
+def load_strategy(path: str) -> Strategy:
+    with open(path) as f:
+        return Strategy.from_json(f.read())
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def enumerate_strategies(
+    num_devices: int,
+    global_batch: int,
+    max_tensor: int = 8,
+    context_lengths_long: bool = False,
+    num_experts: int = 0,
+) -> List[Strategy]:
+    """Candidate generation (parity: combination strategy generation,
+    auto/engine/sg_algo/combination_sg.py) — every legal
+    (data, fsdp, tensor[, seq|expert]) factorization with matching rule
+    tables and remat policies."""
+    out: List[Strategy] = []
+    for tensor in _divisors(num_devices):
+        if tensor > max_tensor:
+            continue
+        rest = num_devices // tensor
+        for fsdp in _divisors(rest):
+            data = rest // fsdp
+            if global_batch % (data * fsdp):
+                continue
+            specs = [("data", data), ("fsdp", fsdp), ("tensor", tensor)]
+            if tensor > 1:
+                name = "tp_fsdp" if fsdp > 1 else "tp"
+            elif fsdp > 1:
+                name = "fsdp"
+            else:
+                name = "ddp"
+            for remat in ("dots", "minimal"):
+                out.append(Strategy(
+                    mesh_spec=tuple(specs), sharding=name, remat=remat,
+                ))
+    if context_lengths_long:
+        for sp in _divisors(num_devices):
+            if sp == 1:
+                continue
+            data = num_devices // sp
+            if global_batch % max(data, 1):
+                continue
+            out.append(Strategy(
+                mesh_spec=(("data", data), ("seq", sp)),
+                sharding="sequence", remat="dots",
+                context_parallel="ring",
+            ))
+    if num_experts > 1:
+        for ep in _divisors(min(num_devices, num_experts)):
+            if ep == 1:
+                continue
+            data = num_devices // ep
+            if num_devices % ep or global_batch % max(data, 1):
+                continue
+            out.append(Strategy(
+                mesh_spec=(("data", data), ("expert", ep)),
+                sharding="tp_fsdp", remat="dots",
+            ))
+    return out

@@ -75,9 +75,13 @@ def topk_gating(
         combine = combine + contrib * gate[:, None, None]
         masked_probs = masked_probs * (1.0 - onehot)  # exclude chosen
 
-    # renormalize combine weights over the selected experts
-    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-    combine = combine / jnp.where(denom == 0.0, 1.0, denom)
+    if k > 1:
+        # renormalize combine weights over the selected experts
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.where(denom == 0.0, 1.0, denom)
+    # k == 1 keeps the RAW gate probability (Switch semantics):
+    # renormalizing would pin every weight to 1.0 and zero the router's
+    # gradient through the LM loss
     return dispatch, combine, aux_loss
 
 

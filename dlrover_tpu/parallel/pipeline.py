@@ -143,6 +143,18 @@ def pipeline_llama_forward(
     def block_fn(x, layer_params):
         return llama._block(cfg, x, layer_params, cos, sin, attn_fn)
 
+    # honor the config's activation-checkpointing policy per block, same
+    # as the un-pipelined llama.forward
+    if cfg.remat == "dots":
+        block_fn = jax.checkpoint(
+            block_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    elif cfg.remat == "minimal":
+        block_fn = jax.checkpoint(
+            block_fn, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
     x, aux = gpipe_apply(
         block_fn, params["blocks"], x, mesh, num_microbatches
     )

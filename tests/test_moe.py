@@ -85,6 +85,25 @@ def test_moe_llama_trains_with_expert_parallelism():
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+def test_top1_router_gets_lm_gradient():
+    """Switch (k=1) routing must keep the RAW gate weight so the router
+    trains through the LM loss (renormalizing pins weights to 1.0)."""
+    h, m, e = 16, 32, 4
+    ks = jax.random.split(jax.random.key(7), 5)
+    x = jax.random.normal(ks[0], (2, 8, h))
+    gate_w = jax.random.normal(ks[1], (h, e)) * 0.1
+    w_gate = jax.random.normal(ks[2], (e, h, m)) * 0.1
+    w_up = jax.random.normal(ks[3], (e, h, m)) * 0.1
+    w_down = jax.random.normal(ks[4], (e, m, h)) * 0.1
+
+    def out_only_loss(gw):
+        out, _ = moe_mlp(x, gw, w_gate, w_up, w_down, k=1)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(out_only_loss)(gate_w)
+    assert float(jnp.linalg.norm(g)) > 1e-5
+
+
 def test_moe_dense_parity_param_count():
     """param_count accounting matches the real pytree for MoE configs."""
     for cfg in (llama.llama_tiny(), llama.llama_moe_tiny()):

@@ -36,6 +36,25 @@ def test_pipeline_forward_matches_dense():
     )
 
 
+def test_pipeline_honors_remat_policy():
+    """cfg.remat must apply under the pipeline too (same numerics, less
+    activation memory)."""
+    cfg = llama.llama_tiny(num_layers=4, remat="dots")
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0,
+                                cfg.vocab_size)
+    mesh = create_mesh([("pipe", 4)], devices=jax.devices()[:4])
+    logits_pp = jax.jit(
+        lambda p, t: pipeline_llama_forward(
+            p, t, cfg, mesh, num_microbatches=4
+        )
+    )(params, tokens)
+    dense = llama.forward(params, tokens, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits_pp), np.asarray(dense), rtol=2e-2, atol=2e-2
+    )
+
+
 def test_pipeline_degrades_to_scan_on_pp1():
     cfg = _cfg()
     params = llama.init_params(jax.random.key(0), cfg)
