@@ -21,6 +21,7 @@ import os
 import signal
 import socket
 import subprocess
+import threading
 import sys
 import time
 from dataclasses import dataclass, field
@@ -161,12 +162,32 @@ class ElasticTrainingAgent:
         self._proc: Optional[subprocess.Popen] = None
         self._stopped = False
         self._remaining_restarts = config.max_restarts
+        self._heartbeat_thread: Optional[threading.Thread] = None
+
+    def _start_heartbeat(self, interval: float = 15.0):
+        """Feed the master's liveness watchdog (parity: the reference
+        agent's report_heartbeat loop; the master's heartbeat monitor
+        only arms for nodes that report)."""
+
+        def loop():
+            while not self._stopped:
+                try:
+                    self._client.report_heartbeat()
+                except Exception as e:
+                    logger.warning("heartbeat failed: %s", e)
+                time.sleep(interval)
+
+        self._heartbeat_thread = threading.Thread(
+            target=loop, daemon=True, name="agent-heartbeat"
+        )
+        self._heartbeat_thread.start()
 
     # ------------------------------------------------------------ lifecycle
 
     def run(self) -> RunResult:
         """The agent main loop (parity: _invoke_run training.py:365)."""
         self._client.update_node_status(NodeStatus.RUNNING)
+        self._start_heartbeat()
         try:
             result = self._invoke_run()
         except Exception as e:

@@ -72,6 +72,7 @@ class JobExitReason:
     HARDWARE_ERROR = "hardware_error"
     UNKNOWN_ERROR = "unknown_error"
     PENDING_TIMEOUT = "pending_timeout"
+    HANG_ERROR = "hang_error"
 
 
 class DistributionStrategy:

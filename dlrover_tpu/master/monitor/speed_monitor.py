@@ -105,6 +105,19 @@ class SpeedMonitor:
     def add_task_completed(self, node_id: int, elapsed: float):
         self._task_completed_times[node_id] = elapsed
 
+    def worker_hanged(self, hang_seconds: float) -> bool:
+        """True when training has started but no global-step sample
+        arrived within ``hang_seconds`` (parity: resource-stagnation hang
+        signal, dist_job_manager.py:662 / training_node.py:297)."""
+        if not self._global_step_records:
+            return bool(
+                self._start_training_time
+                and time.time() - self._start_training_time
+                > hang_seconds
+            )
+        last = self._global_step_records[-1]
+        return time.time() - last.timestamp > hang_seconds
+
     def all_worker_joined(self) -> bool:
         return (
             self._target_worker_num > 0

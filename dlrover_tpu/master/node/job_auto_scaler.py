@@ -1,0 +1,94 @@
+"""Job auto-scaler: periodic resource optimization -> ScalePlan execution.
+
+Parity reference: dlrover/python/master/node/job_auto_scaler.py:40
+(new_job_auto_scaler factory, AllreduceTrainingAutoScaler:251 — the
+allreduce variant adjusts worker count; the PS variant's migration logic
+has no TPU analogue).
+"""
+
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.resource.optimizer import (
+    ResourceOptimizer,
+    ResourcePlan,
+)
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+
+
+class AllreduceTrainingAutoScaler:
+    """Executes ResourcePlans for the worker group (parity:
+    job_auto_scaler.py:251)."""
+
+    def __init__(
+        self,
+        job_manager,
+        job_optimizer: ResourceOptimizer,
+        scaler: Optional[Scaler] = None,
+        interval: float = 60.0,
+    ):
+        self._job_manager = job_manager
+        self._job_optimizer = job_optimizer
+        self._scaler = scaler
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start_auto_scaling(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._periodic_optimize, daemon=True,
+                name="auto-scaler",
+            )
+            self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _periodic_optimize(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                plan = self._job_optimizer.generate_job_resource_plan()
+                if plan and not plan.empty():
+                    self.execute_job_optimization_plan(plan)
+            except Exception as e:
+                logger.error("auto-scale iteration failed: %s", e)
+
+    def execute_job_optimization_plan(self, plan: ResourcePlan):
+        """Diff the plan against current bookkeeping and scale."""
+        scale_plan = ScalePlan()
+        for node_type, group in plan.node_group_resources.items():
+            if node_type != NodeType.WORKER:
+                continue
+            mgr = self._job_manager._node_managers.get(node_type)
+            if mgr is None:
+                continue
+            have = len(mgr.unfinished_nodes())
+            want = group.count
+            if want > have:
+                new_nodes = mgr.scale_up_nodes(
+                    want - have, group.node_resource
+                )
+                scale_plan.launch_nodes.extend(new_nodes)
+            elif want < have:
+                removed = mgr.scale_down_nodes(have - want)
+                scale_plan.remove_nodes.extend(removed)
+            scale_plan.node_group_resources[node_type] = group
+        if not scale_plan.empty() and self._scaler:
+            logger.info(
+                "Execute plan: +%d -%d workers (%s)",
+                len(scale_plan.launch_nodes),
+                len(scale_plan.remove_nodes), plan.comment,
+            )
+            self._scaler.scale(scale_plan)
+        return scale_plan
+
+
+def new_job_auto_scaler(job_manager, job_optimizer, scaler=None,
+                        interval: float = 60.0):
+    """parity: job_auto_scaler.py:40."""
+    return AllreduceTrainingAutoScaler(
+        job_manager, job_optimizer, scaler, interval
+    )

@@ -1,0 +1,112 @@
+"""Per-role training node manager base.
+
+Parity reference: dlrover/python/master/node/training_node.py:150
+(TrainingNodeManager: scale up/down over the node dict, next-id
+allocation) and the critical-node marking at :40-104 — on TPU, "critical"
+means the host's chips belong to the active ICI slice, so its loss forces
+a slice re-form.
+"""
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+
+
+class TrainingNodeManager:
+    def __init__(self, node_type: str,
+                 nodes: Optional[Dict[int, Node]] = None):
+        self._node_type = node_type
+        self._nodes: Dict[int, Node] = nodes or {}
+        self._lock = threading.Lock()
+        start = max(self._nodes) + 1 if self._nodes else 0
+        self._node_id_iter = itertools.count(start)
+
+    @property
+    def nodes(self) -> Dict[int, Node]:
+        return self._nodes
+
+    def update_nodes(self, nodes: Dict[int, Node]):
+        with self._lock:
+            self._nodes = nodes
+            start = max(nodes) + 1 if nodes else 0
+            self._node_id_iter = itertools.count(start)
+
+    def next_node_id(self) -> int:
+        return next(self._node_id_iter)
+
+    def get_node(self, node_id: int) -> Optional[Node]:
+        return self._nodes.get(node_id)
+
+    def add_node(self, node: Node):
+        with self._lock:
+            self._nodes[node.id] = node
+
+    def running_nodes(self) -> List[Node]:
+        return [
+            n for n in self._nodes.values()
+            if n.status == NodeStatus.RUNNING
+        ]
+
+    def alive_nodes(self) -> List[Node]:
+        return [
+            n for n in self._nodes.values()
+            if n.status in (NodeStatus.PENDING, NodeStatus.RUNNING)
+        ]
+
+    def unfinished_nodes(self) -> List[Node]:
+        """Alive PLUS in-flight (INITIAL) nodes — the provisioning diff
+        base, so slow platform launches are not double-provisioned."""
+        return [
+            n for n in self._nodes.values()
+            if not n.is_released and n.status in (
+                NodeStatus.INITIAL, NodeStatus.PENDING,
+                NodeStatus.RUNNING,
+            )
+        ]
+
+    def all_nodes_exited(self) -> bool:
+        alive = self.alive_nodes()
+        return not alive and bool(self._nodes)
+
+    def scale_up_nodes(self, num: int, resource) -> List[Node]:
+        """Create bookkeeping entries for num new nodes; the scaler turns
+        them into processes/VMs (parity: training_node.py:186)."""
+        new_nodes = []
+        with self._lock:
+            for _ in range(num):
+                nid = self.next_node_id()
+                node = Node(
+                    self._node_type, nid, config_resource=resource,
+                    status=NodeStatus.INITIAL,
+                )
+                self._nodes[nid] = node
+                new_nodes.append(node)
+        logger.info(
+            "Scale up %d %s nodes: %s", num, self._node_type,
+            [n.id for n in new_nodes],
+        )
+        return new_nodes
+
+    def scale_down_nodes(self, num: int) -> List[Node]:
+        """Pick nodes to remove, newest first (parity:
+        training_node.py:219)."""
+        removed = []
+        with self._lock:
+            candidates = sorted(
+                (n for n in self._nodes.values()
+                 if n.status in (NodeStatus.INITIAL, NodeStatus.PENDING,
+                                 NodeStatus.RUNNING)),
+                key=lambda n: -n.id,
+            )
+            for node in candidates[:num]:
+                node.is_released = True
+                removed.append(node)
+        logger.info(
+            "Scale down %d %s nodes: %s", num, self._node_type,
+            [n.id for n in removed],
+        )
+        return removed

@@ -1,0 +1,79 @@
+"""Local heuristic resource optimizer for TPU jobs.
+
+Parity reference: dlrover/python/master/resource/local_optimizer.py:66
+(PSLocalOptimizer: stats-window heuristics) and resource/job.py:511
+(AllreduceJobResourceOptimizer), adjust_oom_resource resource/job.py:301.
+
+TPU shape: the tunable resource is the WORKER (TPU host) count and host
+RAM. Heuristics:
+ - throughput-based worker count: if the job runs below the target node
+   count and the speed samples show linear scaling headroom, ask the
+   platform to restore/grow capacity in node_unit multiples;
+ - OOM: grow host memory 1.5x up to a cap (the reference's
+   oom_memory_up_rate);
+ - straggler-aware shrink is delegated to the network-check straggler
+   list (rdzv_manager.get_straggler_nodes).
+"""
+
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.resource.optimizer import (
+    ResourceOptimizer,
+    ResourcePlan,
+)
+
+OOM_MEMORY_UP_RATE = 1.5
+MAX_HOST_MEMORY_MB = 512 * 1024
+
+
+class TPULocalOptimizer(ResourceOptimizer):
+    def __init__(self, job_args=None, speed_monitor=None,
+                 node_unit: int = 1):
+        self._job_args = job_args
+        self._speed_monitor = speed_monitor
+        self._node_unit = max(1, node_unit)
+
+    def init_job_resource(self, job_resource=None) -> ResourcePlan:
+        plan = ResourcePlan(comment="initial")
+        node_num = getattr(self._job_args, "node_num", 0) or 0
+        resource = getattr(self._job_args, "node_resource", None)
+        if node_num:
+            plan.node_group_resources[NodeType.WORKER] = (
+                NodeGroupResource(node_num, resource or NodeResource())
+            )
+        return plan
+
+    def generate_job_resource_plan(self) -> ResourcePlan:
+        plan = ResourcePlan()
+        if self._speed_monitor is None:
+            return plan
+        target = self._speed_monitor._target_worker_num
+        running = len(self._speed_monitor.running_workers)
+        if target and running < target:
+            # restore to the node_unit-aligned target (a partial slice
+            # cannot run; never over-provision past the rounded target)
+            unit = self._node_unit
+            total = ((target + unit - 1) // unit) * unit
+            plan.node_group_resources[NodeType.WORKER] = (
+                NodeGroupResource(total, NodeResource())
+            )
+            plan.comment = (
+                f"restore to {total} workers ({running}/{target} running)"
+            )
+            logger.info("Resource plan: %s", plan.comment)
+        return plan
+
+    def adjust_oom_resource(self, node) -> None:
+        """parity: resource/job.py:301."""
+        res = node.config_resource or NodeResource()
+        old = res.memory or 16 * 1024
+        res.memory = int(min(old * OOM_MEMORY_UP_RATE,
+                             MAX_HOST_MEMORY_MB))
+        node.config_resource = res
+        logger.info(
+            "OOM on %s: host memory %d -> %d MB", node.name, old,
+            res.memory,
+        )

@@ -1,0 +1,41 @@
+"""Resource plans + optimizer interface.
+
+Parity reference: dlrover/python/master/resource/optimizer.py:48
+(ResourcePlan), resource/job.py:171 (JobResourceOptimizer ABC).
+"""
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict
+
+from dlrover_tpu.common.node import NodeGroupResource
+
+
+@dataclass
+class ResourcePlan:
+    """Target resources per node group, produced by an optimizer."""
+
+    node_group_resources: Dict[str, NodeGroupResource] = field(
+        default_factory=dict
+    )
+    comment: str = ""
+
+    def empty(self) -> bool:
+        return not self.node_group_resources
+
+
+class ResourceOptimizer(ABC):
+    """parity: resource/job.py:171 — produces ResourcePlans from runtime
+    stats; the Brain-backed variant is a drop-in (brain/client)."""
+
+    @abstractmethod
+    def init_job_resource(self, job_resource) -> ResourcePlan:
+        """Plan for job start."""
+
+    @abstractmethod
+    def generate_job_resource_plan(self) -> ResourcePlan:
+        """Periodic plan from runtime metrics."""
+
+    @abstractmethod
+    def adjust_oom_resource(self, node) -> None:
+        """Grow a node's memory request after an OOM kill."""

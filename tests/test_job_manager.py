@@ -1,0 +1,222 @@
+"""Distributed job-manager / autoscaler tests with a fake platform.
+
+Mirrors the reference's mocked-k8s tests (dlrover/python/tests/
+test_job_manager.py feeding hand-built events)."""
+
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.dist_master import DistributedJobMaster
+from dlrover_tpu.master.node.dist_job_manager import create_job_manager
+from dlrover_tpu.master.node.job_auto_scaler import new_job_auto_scaler
+from dlrover_tpu.master.resource.local_optimizer import TPULocalOptimizer
+from dlrover_tpu.master.resource.optimizer import ResourcePlan
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.watcher.base_watcher import (
+    InMemoryWatcher,
+    NodeEvent,
+)
+
+
+class RecordingScaler(Scaler):
+    def __init__(self):
+        super().__init__("test")
+        self.plans = []
+
+    def scale(self, plan: ScalePlan):
+        self.plans.append(plan)
+
+
+def _evt(node_id, status, exit_reason="", etype=NodeEventType.MODIFIED):
+    n = Node(NodeType.WORKER, node_id, status=status)
+    if exit_reason:
+        n.set_exit_reason(exit_reason)
+    return NodeEvent(etype, n)
+
+
+def _mgr(scaler=None, node_num=0):
+    args = SimpleNamespace(node_num=node_num,
+                           node_resource=NodeResource(memory=1024))
+    return create_job_manager(
+        args, SpeedMonitor(), scaler=scaler,
+        job_optimizer=TPULocalOptimizer(job_args=args),
+    )
+
+
+def test_start_launches_initial_workers():
+    scaler = RecordingScaler()
+    mgr = _mgr(scaler, node_num=3)
+    mgr.start()
+    mgr.stop()
+    assert len(scaler.plans) == 1
+    assert len(scaler.plans[0].launch_nodes) == 3
+
+
+def test_failed_worker_relaunches_with_new_id():
+    scaler = RecordingScaler()
+    mgr = _mgr(scaler, node_num=2)
+    mgr.start()
+    mgr.process_event(_evt(0, NodeStatus.RUNNING))
+    mgr.process_event(_evt(0, NodeStatus.FAILED,
+                           NodeExitReason.KILLED))
+    mgr.stop()
+    relaunch_plans = [p for p in scaler.plans[1:] if p.launch_nodes]
+    assert len(relaunch_plans) == 1
+    new_node = relaunch_plans[0].launch_nodes[0]
+    assert new_node.id == 2  # fresh id
+    assert new_node.rank_index == 0  # same rank slot
+    assert new_node.relaunch_count == 1
+
+
+def test_oom_relaunch_grows_memory():
+    scaler = RecordingScaler()
+    mgr = _mgr(scaler, node_num=1)
+    mgr.start()
+    node = mgr.get_node(NodeType.WORKER, 0)
+    node.config_resource = NodeResource(memory=1000)
+    mgr.process_event(_evt(0, NodeStatus.RUNNING))
+    mgr.process_event(_evt(0, NodeStatus.FAILED, NodeExitReason.OOM))
+    mgr.stop()
+    assert node.config_resource.memory == 1500
+
+
+def test_fatal_error_never_relaunches():
+    scaler = RecordingScaler()
+    mgr = _mgr(scaler, node_num=1)
+    mgr.start()
+    mgr.process_event(_evt(0, NodeStatus.RUNNING))
+    mgr.process_event(
+        _evt(0, NodeStatus.FAILED, NodeExitReason.FATAL_ERROR)
+    )
+    mgr.stop()
+    assert not [p for p in scaler.plans[1:] if p.launch_nodes]
+
+
+def test_relaunch_count_exhaustion():
+    scaler = RecordingScaler()
+    mgr = _mgr(scaler, node_num=1)
+    mgr.start()
+    nid = 0
+    for round_i in range(5):
+        mgr.process_event(_evt(nid, NodeStatus.RUNNING))
+        mgr.process_event(
+            _evt(nid, NodeStatus.FAILED, NodeExitReason.KILLED)
+        )
+        plans = [p for p in scaler.plans[1:] if p.launch_nodes]
+        if round_i < 3:
+            nid = plans[-1].launch_nodes[0].id
+    mgr.stop()
+    # default max_relaunch_count=3 -> exactly 3 relaunches
+    assert len([p for p in scaler.plans[1:] if p.launch_nodes]) == 3
+
+
+def test_heartbeat_watchdog_only_arms_after_first_report():
+    scaler = RecordingScaler()
+    args = SimpleNamespace(node_num=1, node_resource=NodeResource())
+    mgr = create_job_manager(
+        args, SpeedMonitor(), scaler=scaler,
+        job_optimizer=TPULocalOptimizer(job_args=args),
+    )
+    mgr._heartbeat_timeout = 0.6
+    mgr.start()
+    mgr.process_event(_evt(0, NodeStatus.RUNNING))
+    # no heartbeat ever reported -> watchdog must NOT kill the node
+    time.sleep(1.0)
+    assert mgr.get_node(NodeType.WORKER, 0).status == NodeStatus.RUNNING
+    # a stale heartbeat arms the watchdog -> failure + relaunch
+    mgr.collect_node_heartbeat(NodeType.WORKER, 0, time.time() - 100)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if [p for p in scaler.plans[1:] if p.launch_nodes]:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("heartbeat loss did not trigger relaunch")
+    assert mgr.get_node(NodeType.WORKER, 0).status == NodeStatus.FAILED
+    mgr.stop()
+
+
+def test_watcher_event_stream_drives_manager():
+    watcher = InMemoryWatcher()
+    scaler = RecordingScaler()
+    args = SimpleNamespace(node_num=1, node_resource=NodeResource())
+    mgr = create_job_manager(args, SpeedMonitor(), scaler=scaler,
+                             watcher=watcher)
+    mgr.start()
+    watcher.push(_evt(0, NodeStatus.RUNNING))
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        n = mgr.get_node(NodeType.WORKER, 0)
+        if n and n.status == NodeStatus.RUNNING:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("watcher event not processed")
+    mgr.stop()
+
+
+def test_auto_scaler_executes_plan_diff():
+    scaler = RecordingScaler()
+    mgr = _mgr(scaler, node_num=2)
+    mgr.start()
+    mgr.process_event(_evt(0, NodeStatus.RUNNING))
+    mgr.process_event(_evt(1, NodeStatus.RUNNING))
+    auto = new_job_auto_scaler(
+        mgr, TPULocalOptimizer(), scaler, interval=3600
+    )
+    plan = ResourcePlan()
+    plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+        4, NodeResource()
+    )
+    sp = auto.execute_job_optimization_plan(plan)
+    assert len(sp.launch_nodes) == 2  # 2 alive -> 4 wanted
+    plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+        1, NodeResource()
+    )
+    sp = auto.execute_job_optimization_plan(plan)
+    assert len(sp.remove_nodes) >= 1
+    mgr.stop()
+
+
+def test_local_optimizer_restores_lost_capacity():
+    sm = SpeedMonitor()
+    sm.set_target_worker_num(4)
+    sm.add_running_worker(NodeType.WORKER, 0)
+    sm.add_running_worker(NodeType.WORKER, 1)
+    opt = TPULocalOptimizer(speed_monitor=sm, node_unit=2)
+    plan = opt.generate_job_resource_plan()
+    group = plan.node_group_resources[NodeType.WORKER]
+    assert group.count == 4  # 2 running + 2 restored (node_unit multiple)
+
+
+def test_dist_master_lifecycle_with_fake_platform():
+    watcher = InMemoryWatcher()
+    scaler = RecordingScaler()
+    args = SimpleNamespace(node_num=2, node_unit=1,
+                           node_resource=NodeResource())
+    master = DistributedJobMaster(
+        port=0, job_args=args, scaler=scaler, watcher=watcher,
+        autoscale_interval=3600,
+    )
+    master.prepare()
+    assert len(scaler.plans[0].launch_nodes) == 2
+    watcher.push(_evt(0, NodeStatus.RUNNING))
+    watcher.push(_evt(1, NodeStatus.RUNNING))
+    time.sleep(0.3)
+    assert len(master.job_manager.get_running_nodes()) == 2
+    # both workers succeed -> run() returns 0
+    watcher.push(_evt(0, NodeStatus.SUCCEEDED))
+    watcher.push(_evt(1, NodeStatus.SUCCEEDED))
+    time.sleep(0.3)
+    rc = master.run(check_interval=0.1)
+    assert rc == 0

@@ -1,0 +1,88 @@
+"""ProcessScaler: real subprocesses as fake cluster nodes.
+
+The multi-node-without-a-cluster platform (SURVEY §4): master +
+DistributedJobManager + ProcessScaler drive real child processes through
+the launch -> fail -> relaunch -> succeed lifecycle."""
+
+import sys
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import NodeResource
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.node.dist_job_manager import create_job_manager
+from dlrover_tpu.master.scaler.process_scaler import ProcessScaler
+
+
+def _wait(pred, timeout=15.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _build(command, node_num=2):
+    scaler = ProcessScaler(
+        "test-job", master_addr="localhost:0", command=command,
+    )
+    args = SimpleNamespace(node_num=node_num,
+                           node_resource=NodeResource())
+    mgr = create_job_manager(
+        args, SpeedMonitor(), scaler=scaler, watcher=scaler.watcher,
+    )
+    return scaler, mgr
+
+
+def test_successful_job_lifecycle():
+    scaler, mgr = _build([sys.executable, "-c", "import time; "
+                          "time.sleep(0.2)"])
+    mgr.start()
+    try:
+        assert _wait(mgr.all_workers_exited)
+        assert mgr.all_workers_succeeded()
+    finally:
+        mgr.stop()
+        scaler.stop()
+
+
+def test_crash_relaunch_until_exhausted():
+    scaler, mgr = _build(
+        [sys.executable, "-c", "import sys; sys.exit(3)"], node_num=1
+    )
+    mgr.start()
+    try:
+        # initial launch + 3 relaunches (default max_relaunch_count)
+        assert _wait(
+            lambda: len(mgr.get_all_nodes()) == 4, timeout=30
+        ), [n.name for n in mgr.get_all_nodes()]
+        assert _wait(mgr.all_workers_exited, timeout=30)
+        assert not mgr.all_workers_succeeded()
+    finally:
+        mgr.stop()
+        scaler.stop()
+
+
+def test_sigterm_maps_to_killed_and_relaunches():
+    scaler, mgr = _build(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        node_num=1,
+    )
+    mgr.start()
+    try:
+        assert _wait(lambda: scaler._procs)
+        pid_proc = next(iter(scaler._procs.values()))
+        pid_proc.terminate()
+        # killed -> relaunched with a fresh process
+        assert _wait(
+            lambda: len(mgr.get_all_nodes()) >= 2, timeout=30
+        )
+        node0 = mgr.get_node(NodeType.WORKER, 0)
+        assert node0.exit_reason == "killed"
+    finally:
+        mgr.stop()
+        scaler.stop()
