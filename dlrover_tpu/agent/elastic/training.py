@@ -170,11 +170,15 @@ class ElasticTrainingAgent:
         only arms for nodes that report)."""
 
         def loop():
+            failures = 0
             while not self._stopped:
                 try:
                     self._client.report_heartbeat()
+                    failures = 0
                 except Exception as e:
-                    logger.warning("heartbeat failed: %s", e)
+                    failures += 1
+                    if failures <= 2:  # quiet after the master goes away
+                        logger.warning("heartbeat failed: %s", e)
                 time.sleep(interval)
 
         self._heartbeat_thread = threading.Thread(
