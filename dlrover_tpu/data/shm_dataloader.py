@@ -1,0 +1,128 @@
+"""Coworker data loading over the native shm ring + device prefetch.
+
+Parity reference: atorch/atorch/data/shm_dataloader.py:138
+(ShmDataloader), shm_context.py:527 (create_coworker_shm_context), and
+preloader.py:8 (GpuPreLoader — async H2D with a CUDA stream).
+
+TPU shape: coworker PROCESSES (CPU pods / extra host processes) produce
+batches into the C++ shm ring; the trainer iterates them; DevicePrefetch
+keeps N batches in flight to the TPU with ``jax.device_put`` (dispatch is
+async in JAX — overlap comes free; the buffer bounds host memory).
+"""
+
+import multiprocessing as mp
+import threading
+from queue import Queue
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.data.shm_ring import RingClosed, ShmRing
+
+
+def _producer_main(ring_name: str, slot_bytes: int,
+                   dataset_fn, worker_id: int, num_workers: int):
+    """Runs in a coworker process: iterate dataset_fn(), push batches."""
+    ring = ShmRing.attach(ring_name, slot_bytes=slot_bytes)
+    try:
+        for i, batch in enumerate(dataset_fn()):
+            if i % num_workers != worker_id:
+                continue
+            ring.push(batch)
+    except RingClosed:
+        pass
+    except Exception as e:  # pragma: no cover - crash path
+        logger.error("shm producer %d failed: %s", worker_id, e)
+
+
+class ShmDataLoader:
+    """Iterate batches produced by coworker processes over the shm ring.
+
+    ``dataset_fn`` must be a picklable zero-arg callable returning an
+    iterable of batches (numpy arrays / tuples / pytrees).
+    """
+
+    def __init__(
+        self,
+        dataset_fn: Callable[[], Iterable],
+        num_workers: int = 1,
+        slot_bytes: int = 64 << 20,
+        num_slots: int = 8,
+        name: Optional[str] = None,
+    ):
+        self._ring = ShmRing(
+            name or f"/dlrover_shm_{id(self):x}",
+            slot_bytes=slot_bytes, num_slots=num_slots, create=True,
+        )
+        ctx = mp.get_context("spawn")
+        self._procs = [
+            ctx.Process(
+                target=_producer_main,
+                args=(self._ring.name, slot_bytes, dataset_fn, w,
+                      num_workers),
+                daemon=True,
+            )
+            for w in range(num_workers)
+        ]
+        for p in self._procs:
+            p.start()
+        self._watcher = threading.Thread(
+            target=self._close_when_done, daemon=True
+        )
+        self._watcher.start()
+
+    def _close_when_done(self):
+        for p in self._procs:
+            p.join()
+        self._ring.close()  # EOF after every producer finished
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            try:
+                yield self._ring.pop()
+            except RingClosed:
+                return
+
+    def shutdown(self):
+        self._ring.close()
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        self._ring.destroy()
+
+
+class DevicePrefetch:
+    """Wrap a batch iterator, keeping ``depth`` batches in flight on
+    device (parity: GpuPreLoader preloader.py:8 — the CUDA-stream H2D
+    overlap maps to JAX's async device_put dispatch)."""
+
+    def __init__(self, it: Iterable, depth: int = 2, sharding=None):
+        self._it = iter(it)
+        self._depth = depth
+        self._sharding = sharding
+        self._queue: "Queue" = Queue(maxsize=depth)
+        self._done = object()
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread.start()
+
+    def _put_device(self, batch):
+        if self._sharding is not None:
+            return jax.tree.map(
+                lambda x: jax.device_put(x, self._sharding), batch
+            )
+        return jax.tree.map(jax.device_put, batch)
+
+    def _fill(self):
+        try:
+            for batch in self._it:
+                self._queue.put(self._put_device(batch))
+        finally:
+            self._queue.put(self._done)
+
+    def __iter__(self):
+        while True:
+            item = self._queue.get()
+            if item is self._done:
+                return
+            yield item
