@@ -217,6 +217,12 @@ int64_t shm_ring_pop(void* handle, uint8_t* out, uint64_t out_capacity,
   return static_cast<int64_t>(size);
 }
 
+// Actual per-slot payload capacity from the control block, so attachers
+// size their pop buffers to the creator's layout instead of guessing.
+uint64_t shm_ring_slot_size(void* handle) {
+  return static_cast<Ring*>(handle)->ctl->slot_size;
+}
+
 int shm_ring_size(void* handle) {
   Ring* r = static_cast<Ring*>(handle);
   if (lock_robust(r->ctl) != 0) return -1;

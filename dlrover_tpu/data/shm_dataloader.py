@@ -11,6 +11,7 @@ async in JAX — overlap comes free; the buffer bounds host memory).
 """
 
 import multiprocessing as mp
+import os
 import threading
 from queue import Queue
 from typing import Any, Callable, Iterable, Iterator, Optional
@@ -21,10 +22,10 @@ from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.data.shm_ring import RingClosed, ShmRing
 
 
-def _producer_main(ring_name: str, slot_bytes: int,
-                   dataset_fn, worker_id: int, num_workers: int):
+def _producer_main(ring_name: str, dataset_fn, worker_id: int,
+                   num_workers: int):
     """Runs in a coworker process: iterate dataset_fn(), push batches."""
-    ring = ShmRing.attach(ring_name, slot_bytes=slot_bytes)
+    ring = ShmRing.attach(ring_name)
     try:
         for i, batch in enumerate(dataset_fn()):
             if i % num_workers != worker_id:
@@ -51,16 +52,21 @@ class ShmDataLoader:
         num_slots: int = 8,
         name: Optional[str] = None,
     ):
+        # pid + random suffix: id(self) repeats across processes, and
+        # create() unlinks same-named stale segments — two jobs on one
+        # host must never collide on the default name
+        default_name = (
+            f"/dlrover_shm_{os.getpid():x}_{os.urandom(4).hex()}"
+        )
         self._ring = ShmRing(
-            name or f"/dlrover_shm_{id(self):x}",
+            name or default_name,
             slot_bytes=slot_bytes, num_slots=num_slots, create=True,
         )
         ctx = mp.get_context("spawn")
         self._procs = [
             ctx.Process(
                 target=_producer_main,
-                args=(self._ring.name, slot_bytes, dataset_fn, w,
-                      num_workers),
+                args=(self._ring.name, dataset_fn, w, num_workers),
                 daemon=True,
             )
             for w in range(num_workers)

@@ -79,6 +79,8 @@ def _load_library():
             ]
             lib.shm_ring_size.restype = ctypes.c_int
             lib.shm_ring_size.argtypes = [ctypes.c_void_p]
+            lib.shm_ring_slot_size.restype = ctypes.c_uint64
+            lib.shm_ring_slot_size.argtypes = [ctypes.c_void_p]
             lib.shm_ring_close.argtypes = [ctypes.c_void_p]
             lib.shm_ring_destroy.argtypes = [ctypes.c_void_p]
             _LIB = lib
@@ -105,8 +107,11 @@ class ShmRing:
         else:
             self._handle = self._lib.shm_ring_attach(name.encode())
             if self._handle:
-                # slot size comes from the control block; keep a safe cap
-                self.slot_bytes = slot_bytes
+                # slot size is whatever the creator laid out — read it
+                # from the control block so pop buffers always fit
+                self.slot_bytes = int(
+                    self._lib.shm_ring_slot_size(self._handle)
+                )
         if not self._handle:
             raise OSError(f"shm ring {'create' if create else 'attach'} "
                           f"failed for {name!r}")
@@ -115,8 +120,10 @@ class ShmRing:
         )
 
     @classmethod
-    def attach(cls, name: str, slot_bytes: int = 64 << 20) -> "ShmRing":
-        return cls(name, slot_bytes=slot_bytes, create=False)
+    def attach(cls, name: str) -> "ShmRing":
+        """Attach to an existing ring; slot size comes from its control
+        block, so there is no layout knob on this side."""
+        return cls(name, create=False)
 
     def push_bytes(self, data: bytes, timeout_ms: int = 60_000):
         rc = self._lib.shm_ring_push(

@@ -225,15 +225,21 @@ class DistributedJobManager:
         thread are never killed by it."""
         while not self._stopped.wait(self._heartbeat_timeout / 3):
             now = time.time()
+            # get_running_nodes snapshots each role dict under the
+            # per-manager lock (the one add_node takes)
             for node in self.get_running_nodes():
-                if node.heartbeat_time <= 0:
-                    continue
-                if now - node.heartbeat_time > self._heartbeat_timeout:
-                    logger.warning(
-                        "%s heartbeat lost for %.0fs -> failed",
-                        node.name, now - node.heartbeat_time,
-                    )
-                    self._handle_hung_node(node)
+                try:
+                    if node.heartbeat_time <= 0:
+                        continue
+                    if now - node.heartbeat_time > self._heartbeat_timeout:
+                        logger.warning(
+                            "%s heartbeat lost for %.0fs -> failed",
+                            node.name, now - node.heartbeat_time,
+                        )
+                        self._handle_hung_node(node)
+                except Exception:
+                    logger.exception(
+                        "heartbeat watchdog failed on %s", node.name)
 
     def _handle_hung_node(self, node: Node):
         """A hung node's PROCESS is still alive: relaunch_node's plan

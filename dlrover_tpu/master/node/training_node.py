@@ -46,31 +46,39 @@ class TrainingNodeManager:
             self._nodes[node.id] = node
 
     def running_nodes(self) -> List[Node]:
-        return [
-            n for n in self._nodes.values()
-            if n.status == NodeStatus.RUNNING
-        ]
+        # snapshot under the same lock add_node takes, so concurrent
+        # relaunches can't mutate the dict mid-iteration
+        with self._lock:
+            return [
+                n for n in self._nodes.values()
+                if n.status == NodeStatus.RUNNING
+            ]
 
     def alive_nodes(self) -> List[Node]:
-        return [
-            n for n in self._nodes.values()
-            if n.status in (NodeStatus.PENDING, NodeStatus.RUNNING)
-        ]
+        with self._lock:
+            return [
+                n for n in self._nodes.values()
+                if n.status in (NodeStatus.PENDING, NodeStatus.RUNNING)
+            ]
 
     def unfinished_nodes(self) -> List[Node]:
         """Alive PLUS in-flight (INITIAL) nodes — the provisioning diff
         base, so slow platform launches are not double-provisioned."""
-        return [
-            n for n in self._nodes.values()
-            if not n.is_released and n.status in (
-                NodeStatus.INITIAL, NodeStatus.PENDING,
-                NodeStatus.RUNNING,
-            )
-        ]
+        with self._lock:
+            return [
+                n for n in self._nodes.values()
+                if not n.is_released and n.status in (
+                    NodeStatus.INITIAL, NodeStatus.PENDING,
+                    NodeStatus.RUNNING,
+                )
+            ]
 
     def all_nodes_exited(self) -> bool:
-        alive = self.alive_nodes()
-        return not alive and bool(self._nodes)
+        """True only when every node has finished — unreleased INITIAL
+        nodes (startup, relaunch-in-flight) count as unfinished, so the
+        master does not fail a job before the platform reports the new
+        node's status (parity: reference training_node.py:234-241)."""
+        return not self.unfinished_nodes() and bool(self._nodes)
 
     def scale_up_nodes(self, num: int, resource) -> List[Node]:
         """Create bookkeeping entries for num new nodes; the scaler turns

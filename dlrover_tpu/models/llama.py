@@ -44,6 +44,11 @@ class LlamaConfig:
     # (fastest, most memory), "minimal" recomputes everything (fits big
     # models on small HBM), "off" disables remat
     remat: str = "dots"
+    # chunked cross-entropy: compute logits + log-softmax over sequence
+    # chunks of this many tokens inside a rematerialized scan, so the
+    # [batch, seq, vocab] fp32 logits tensor is never materialized
+    # (0 = off). Saves ~vocab/hidden x activation memory at the head.
+    loss_chunk: int = 0
     # MoE (0 = dense): replaces every block's MLP with a top-k routed
     # expert SwiGLU (parallel/moe.py); experts shard on the expert axis
     num_experts: int = 0
@@ -254,16 +259,13 @@ def _block(cfg: LlamaConfig, x, layer_params, cos, sin, attn_fn):
     return x, jnp.zeros((), jnp.float32)
 
 
-def forward(
+def hidden_states(
     params: Dict,
     tokens: jax.Array,  # int32 [batch, seq]
     cfg: LlamaConfig,
     attn_fn=None,
-    return_aux: bool = False,
-):
-    """Logits [batch, seq, vocab]. ``attn_fn`` overrides attention (e.g.
-    ring attention under sequence parallelism). With ``return_aux`` also
-    returns the summed MoE auxiliary loss."""
+) -> Tuple[jax.Array, jax.Array]:
+    """Final-norm hidden states [batch, seq, hidden] + MoE aux loss."""
     if attn_fn is None:
         attn_fn = partial(flash_attention, causal=True)
     s = tokens.shape[1]
@@ -287,11 +289,69 @@ def forward(
     (x, aux), _ = jax.lax.scan(
         body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
     )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,  # int32 [batch, seq]
+    cfg: LlamaConfig,
+    attn_fn=None,
+    return_aux: bool = False,
+):
+    """Logits [batch, seq, vocab]. ``attn_fn`` overrides attention (e.g.
+    ring attention under sequence parallelism). With ``return_aux`` also
+    returns the summed MoE auxiliary loss."""
+    x, aux = hidden_states(params, tokens, cfg, attn_fn=attn_fn)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     if return_aux:
         return logits, aux
     return logits
+
+
+def _masked_nll(logits: jax.Array, targets: jax.Array) -> Tuple[
+        jax.Array, jax.Array]:
+    """(sum of masked nll, mask count). targets < 0 mask positions out."""
+    mask = (targets >= 0).astype(jnp.float32)
+    safe_targets = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, safe_targets[..., None], axis=-1
+    )[..., 0]
+    return jnp.sum(nll * mask), jnp.sum(mask)
+
+
+def _chunked_ce(x: jax.Array, lm_head: jax.Array, targets: jax.Array,
+                chunk: int) -> Tuple[jax.Array, jax.Array]:
+    """Cross entropy without materializing full [tokens, vocab] logits:
+    a rematerialized scan over token chunks — each chunk's logits and
+    log-softmax are recomputed in the backward pass, so peak memory is
+    one [chunk, vocab] block instead of [batch*seq, vocab]."""
+    h = x.shape[-1]
+    xf = x.reshape(-1, h)
+    tf = targets.reshape(-1)
+    n = xf.shape[0]
+    if n % chunk:
+        # pad to a chunk multiple with masked (-1) targets so chunking
+        # never silently degrades to the full-logits allocation
+        pad = chunk - n % chunk
+        xf = jnp.concatenate([xf, jnp.zeros((pad, h), xf.dtype)])
+        tf = jnp.concatenate([tf, jnp.full((pad,), -1, tf.dtype)])
+        n += pad
+    xc = xf.reshape(n // chunk, chunk, h)
+    tc = tf.reshape(n // chunk, chunk)
+
+    def body(carry, inp):
+        nll_sum, cnt = carry
+        xs, ts = inp
+        logits = (xs @ lm_head).astype(jnp.float32)
+        s, c = _masked_nll(logits, ts)
+        return (nll_sum + s, cnt + c), None
+
+    (nll_sum, cnt), _ = jax.lax.scan(
+        jax.checkpoint(body), (jnp.zeros(()), jnp.zeros(())), (xc, tc)
+    )
+    return nll_sum, cnt
 
 
 def next_token_loss(
@@ -301,16 +361,15 @@ def next_token_loss(
     """Mean next-token cross entropy. batch = (tokens, targets), both
     int32 [batch, seq]; target < 0 masks the position out."""
     tokens, targets = batch
-    logits, aux = forward(
-        params, tokens, cfg, attn_fn=attn_fn, return_aux=True
-    )
-    mask = (targets >= 0).astype(jnp.float32)
-    safe_targets = jnp.maximum(targets, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(
-        logp, safe_targets[..., None], axis=-1
-    )[..., 0]
-    ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    x, aux = hidden_states(params, tokens, cfg, attn_fn=attn_fn)
+    if cfg.loss_chunk > 0:
+        nll_sum, cnt = _chunked_ce(
+            x, params["lm_head"], targets, cfg.loss_chunk
+        )
+    else:
+        logits = (x @ params["lm_head"]).astype(jnp.float32)
+        nll_sum, cnt = _masked_nll(logits, targets)
+    ce = nll_sum / jnp.maximum(cnt, 1.0)
     return ce + aux  # aux arrives pre-scaled (parallel/moe.py coefs)
 
 

@@ -92,10 +92,15 @@ def flash_attention(
         # largest block that tiles the sequence, never exceeding the
         # caller's block size (callers tune it to bound VMEM scratch)
         seq = q.shape[1]
-        bq = max(b for b in (128, 256, block_q)
-                 if seq % b == 0 and b <= block_q)
-        bk = max(b for b in (128, 256, block_k)
-                 if seq % b == 0 and b <= block_k)
+        bq_candidates = [b for b in (128, 256, block_q)
+                         if seq % b == 0 and b <= block_q]
+        bk_candidates = [b for b in (128, 256, block_k)
+                         if seq % b == 0 and b <= block_k]
+        if not bq_candidates or not bk_candidates:
+            # caller capped blocks below the kernel's 128-lane minimum
+            # (or nothing divides seq) — XLA path is always correct
+            return mha_reference(q, k, v, causal=causal, scale=scale)
+        bq, bk = max(bq_candidates), max(bk_candidates)
         return flash_attention_tpu(
             q, k, v, causal=causal, scale=scale, block_q=bq, block_k=bk,
         )

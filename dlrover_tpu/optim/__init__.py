@@ -1,0 +1,18 @@
+"""TPU-native optimizers (reference parity: atorch/atorch/optimizers/).
+
+- master_weights / bf16_adamw: bf16 params with fp32 master copies
+  (parity: atorch/atorch/optimizers/bf16_optimizer.py:45 BF16Optimizer),
+  re-designed as an optax gradient-transformation wrapper so it composes
+  with any inner optimizer and shards like the params it mirrors.
+- wsam_value_and_grad: Weighted Sharpness-Aware Minimization
+  (parity: atorch/atorch/optimizers/wsam.py:11 WeightedSAM), re-designed
+  as a gradient-side transform (two jitted grad evaluations fused into
+  the train step) instead of a torch optimizer subclass.
+"""
+
+from dlrover_tpu.optim.bf16 import (  # noqa: F401
+    MasterWeightsState,
+    bf16_adamw,
+    master_weights,
+)
+from dlrover_tpu.optim.wsam import wsam_value_and_grad  # noqa: F401

@@ -1,0 +1,78 @@
+"""BF16 params with fp32 master weights, as an optax wrapper.
+
+Parity reference: atorch/atorch/optimizers/bf16_optimizer.py:45
+(BF16Optimizer: fp32 master copies, grads cast up, params written back
+down). The torch version wraps an optimizer instance and copies tensors
+in-place; here the master copies live *inside the optimizer state
+pytree*, so they inherit the params' GSPMD sharding automatically (ZeRO
+layouts shard the masters too) and the whole update stays one fused XLA
+program.
+
+Exactness note: the returned updates are ``master_new - params`` computed
+in fp32. ``optax.apply_updates`` evaluates ``params + update`` with dtype
+promotion to fp32 and casts back to the params' dtype, so the new bf16
+params are exactly ``round_bf16(master_new)`` — no drift between master
+and working copies.
+"""
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MasterWeightsState(NamedTuple):
+    master: Any  # fp32 copies of the (bf16) params
+    inner_state: Any
+
+
+def master_weights(
+    inner: optax.GradientTransformation,
+    master_dtype: jnp.dtype = jnp.float32,
+) -> optax.GradientTransformation:
+    """Run ``inner`` against fp32 master copies of lower-precision params.
+
+    The train loop keeps compute params in bf16; grads arrive in any
+    dtype and are cast to ``master_dtype`` before the inner update.
+    """
+
+    def init(params):
+        master = jax.tree.map(
+            lambda p: p.astype(master_dtype), params
+        )
+        return MasterWeightsState(master, inner.init(master))
+
+    def update(grads, state, params=None):
+        g = jax.tree.map(lambda x: x.astype(master_dtype), grads)
+        updates, inner_state = inner.update(g, state.inner_state,
+                                            state.master)
+        master = optax.apply_updates(state.master, updates)
+        # delta vs the current working params so that
+        # params + delta == master_new exactly (in fp32, then rounded)
+        deltas = jax.tree.map(
+            lambda m, p: m - p.astype(master_dtype), master, params
+        )
+        return deltas, MasterWeightsState(master, inner_state)
+
+    return optax.GradientTransformation(init, update)
+
+
+def bf16_adamw(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mu_dtype: Optional[jnp.dtype] = jnp.bfloat16,
+) -> optax.GradientTransformation:
+    """AdamW over fp32 masters with bf16 first moment (HBM saver).
+
+    State per param: fp32 master + bf16 mu + fp32 nu = 10 bytes/param,
+    vs 12 for full-fp32 adamw-with-masters and 6 for all-bf16 adamw.
+    """
+    inner = optax.adamw(
+        learning_rate, b1=b1, b2=b2, eps=eps,
+        weight_decay=weight_decay, mu_dtype=mu_dtype,
+    )
+    return master_weights(inner)

@@ -1,0 +1,55 @@
+"""Weighted Sharpness-Aware Minimization (WSAM), gradient-side.
+
+Parity reference: atorch/atorch/optimizers/wsam.py:11 (WeightedSAM, from
+"Sharpness-Aware Minimization Revisited: Weighted Sharpness as a
+Regularization Term", KDD'23). The torch version is an optimizer subclass
+whose step() runs a second closure evaluation; on TPU the natural shape
+is a *grad transform*: both gradient evaluations trace into the same
+jitted train step, so XLA schedules them back-to-back on device with no
+host round-trip.
+
+The regularized objective is  f^w(w) = f(w) + gamma/(1-gamma) * sharpness
+with sharpness = f(w + e) - f(w), e = rho * g / ||g||, giving
+
+    grad = (1 - beta) * g  +  beta * g_adv,   beta = gamma/(1-gamma)
+         = g + beta * (g_adv - g)
+"""
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def wsam_value_and_grad(
+    loss_fn: Callable,
+    rho: float = 0.05,
+    gamma: float = 0.9,
+) -> Callable:
+    """Wrap ``loss_fn(params, batch) -> scalar`` into
+    ``(params, batch) -> (loss, wsam_grads)``.
+
+    Drop-in replacement for ``jax.value_and_grad(loss_fn)`` inside a
+    train step (costs one extra fwd+bwd).
+    """
+    base = jax.value_and_grad(loss_fn)
+    beta = gamma / (1.0 - gamma)
+
+    def value_and_grad(params, batch) -> Tuple[jax.Array, Any]:
+        loss, g = base(params, batch)
+        gnorm = optax.global_norm(g)
+        scale = rho / (gnorm + 1e-12)
+        adv = jax.tree.map(
+            lambda p, gi: (p.astype(jnp.float32)
+                           + scale * gi.astype(jnp.float32)
+                           ).astype(p.dtype),
+            params, g,
+        )
+        _, g_adv = base(adv, batch)
+        grads = jax.tree.map(
+            lambda a, b: a + beta * (b - a), g, g_adv
+        )
+        return loss, grads
+
+    return value_and_grad

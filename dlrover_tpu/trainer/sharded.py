@@ -51,6 +51,7 @@ class ShardedTrainer:
         optimizer: Optional[optax.GradientTransformation] = None,
         accum_steps: int = 1,
         batch_extra_axes: Tuple[Optional[str], ...] = ("seq",),
+        value_and_grad: Optional[Callable] = None,
     ):
         self.mesh = mesh
         self.rules = shd.get_rules(strategy)
@@ -59,6 +60,9 @@ class ShardedTrainer:
         self.optimizer = optimizer or optax.adamw(3e-4)
         self._loss_fn = loss_fn
         self._init_fn = init_fn
+        # custom (params, batch) -> (loss, grads), e.g. optim.wsam's
+        # sharpness-aware double evaluation
+        self._value_and_grad = value_and_grad
         self.param_shardings = shd.tree_shardings(
             axes_tree, mesh, self.rules
         )
@@ -101,7 +105,9 @@ class ShardedTrainer:
         if self._jit_step is not None:
             return self._jit_step
 
-        grad_fn = jax.value_and_grad(self._loss_fn)
+        grad_fn = self._value_and_grad or jax.value_and_grad(
+            self._loss_fn
+        )
         accum = self.accum_steps
 
         def step(params, opt_state, batch):

@@ -80,7 +80,7 @@ def test_close_drains_then_raises():
 
 
 def _producer_proc(name):
-    ring = ShmRing.attach(name, slot_bytes=1 << 20)
+    ring = ShmRing.attach(name)
     for i in range(20):
         ring.push(np.full((4, 4), i, dtype=np.int32))
 
