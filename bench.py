@@ -52,8 +52,9 @@ def main():
     on_tpu = dev.platform == "tpu"
     if on_tpu:
         # sized for a 16GB-HBM chip (v5e): params+adam ≈ 8.8GB bf16;
-        # "dots" remat + Pallas flash attention measured fastest that fits
-        # (vs "minimal" full-remat and batch 8 variants)
+        # "dots" remat + GQA-native Pallas flash attention (auto blocks:
+        # 128x1024 for the 32q/4kv GQA fold) measured fastest that fits
+        # (vs "minimal" full-remat, batch 8, and chunked-CE variants)
         cfg = llama.llama_1b(remat="dots")
         batch, seq, steps, warmup = 4, 2048, 20, 3
     else:

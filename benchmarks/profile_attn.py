@@ -1,0 +1,62 @@
+"""Micro-bench flash attention block sizes on model shapes (dev tool)."""
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.ops.attention import mha_reference
+from dlrover_tpu.ops.pallas.flash_attention import (
+    flash_attention_tpu as flash_attention,
+)
+
+
+def timeit(fn, *args, n=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0].ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0].ravel()[0]))
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    batch, seq, nh, nkv, d = 4, 2048, 32, 4, 64
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((batch, seq, nh, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((batch, seq, nkv, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((batch, seq, nkv, d)), jnp.bfloat16)
+
+    # causal attention flops (fwd): 2 matmuls, half the blocks
+    fwd_flops = 4 * batch * nh * seq * seq * d / 2
+    for bq, bk in [(128, 1024), (128, 2048), (256, 1024), (256, 2048),
+                   (256, 512), (512, 1024), (512, 512), (128, 512)]:
+        fn_f = jax.jit(partial(
+            flash_attention, causal=True, block_q=bq, block_k=bk))
+        t_f = timeit(fn_f, q, k, v)
+        fn_b = jax.jit(jax.value_and_grad(
+            lambda q, k, v: partial(
+                flash_attention, causal=True, block_q=bq, block_k=bk
+            )(q, k, v).astype(jnp.float32).mean(), argnums=(0, 1, 2)))
+        t_b = timeit(fn_b, q, k, v)
+        print(f"bq={bq:5d} bk={bk:5d}: fwd {t_f*1e3:6.2f} ms "
+              f"({fwd_flops/t_f/1e12:5.1f} TF/s)  fwd+bwd {t_b*1e3:6.2f} ms"
+              f"  (x22: fwd {t_f*22*1e3:5.1f} / fb {t_b*22*1e3:6.1f})")
+
+    fn_f = jax.jit(partial(mha_reference, causal=True))
+    t_f = timeit(fn_f, q, k, v)
+    fn_b = jax.jit(jax.value_and_grad(
+        lambda q, k, v: mha_reference(q, k, v, causal=True)
+        .astype(jnp.float32).mean(), argnums=(0, 1, 2)))
+    t_b = timeit(fn_b, q, k, v)
+    print(f"mha_reference : fwd {t_f*1e3:6.2f} ms  fwd+bwd {t_b*1e3:6.2f} "
+          f"ms  (x22: fwd {t_f*22*1e3:5.1f} / fb {t_b*22*1e3:6.1f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Single-chip bench config sweep (dev tool, not the driver bench).
+
+Runs one (batch, remat, loss_chunk, opt, blocks, accum) config and prints
+a JSON line; drive it from sweep_all.sh / manually. Isolated per-process
+so an OOM config doesn't poison the rest of the sweep.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--remat", default="dots")
+    ap.add_argument("--loss-chunk", type=int, default=0)
+    ap.add_argument("--opt", default="adamw",
+                    choices=["adamw", "bf16_adamw", "adamw_mu16"])
+    ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args()
+
+    import optax
+    from functools import partial
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.ops.attention import flash_attention
+    from dlrover_tpu.optim import bf16_adamw
+    from dlrover_tpu.parallel.mesh import create_mesh
+    from dlrover_tpu.trainer.sharded import make_trainer_for_llama
+
+    dev = jax.devices()[0]
+    cfg = llama.llama_1b(remat=args.remat, loss_chunk=args.loss_chunk)
+
+    if args.opt == "adamw":
+        opt = optax.adamw(1e-4, b1=0.9, b2=0.95)
+    elif args.opt == "bf16_adamw":
+        opt = bf16_adamw(1e-4, b1=0.9, b2=0.95)
+    else:
+        opt = optax.adamw(1e-4, b1=0.9, b2=0.95,
+                          mu_dtype=jax.numpy.bfloat16)
+
+    attn = partial(flash_attention, causal=True,
+                   block_q=args.block_q, block_k=args.block_k)
+
+    mesh = create_mesh([("data", 1)], devices=[dev])
+    trainer = make_trainer_for_llama(
+        cfg, mesh, strategy="ddp", accum_steps=args.accum,
+        optimizer=opt, attn_fn=attn,
+    )
+    params, opt_state = trainer.init(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (args.batch, args.seq),
+                          dtype=np.int32)
+    mb = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
+
+    for _ in range(args.warmup):
+        params, opt_state, loss = trainer.train_step(params, opt_state, mb)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = trainer.train_step(params, opt_state, mb)
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    step_time = dt / args.steps
+    toks = args.batch * args.seq / step_time
+    fpt = llama.flops_per_token(cfg, args.seq)
+    mfu = 100.0 * toks * fpt / 197e12 if dev.platform == "tpu" else 0.0
+    mem = (dev.memory_stats() if hasattr(dev, "memory_stats") else {}) or {}
+    print(json.dumps({
+        "batch": args.batch, "remat": args.remat,
+        "loss_chunk": args.loss_chunk, "opt": args.opt,
+        "blocks": [args.block_q, args.block_k], "accum": args.accum,
+        "step_ms": round(step_time * 1e3, 1),
+        "tok_s": round(toks, 0), "mfu": round(mfu, 2),
+        "loss": round(loss_val, 4),
+        "peak_gb": round(mem.get("peak_bytes_in_use", 0) / 2**30, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

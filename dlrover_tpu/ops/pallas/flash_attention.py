@@ -1,4 +1,4 @@
-"""Flash attention as Pallas TPU kernels (forward + backward).
+"""Flash attention as Pallas TPU kernels (forward + backward), GQA-native.
 
 Parity reference: the reference injects Tri-Dao's CUDA FlashAttention
 (atorch/atorch/modules/transformer/layers.py:706, inject.py:58) — here the
@@ -6,9 +6,16 @@ same O(seq) memory algorithm is a native TPU kernel: online-softmax
 accumulators live in VMEM scratch that persists across the k-block grid
 dimension; the two matmuls per block ride the MXU in fp32 accumulation.
 
-Layout inside the kernels is [batch*heads, seq, head_dim]; the public
-wrapper takes the models' [batch, seq, heads, head_dim] and handles GQA by
-broadcasting KV heads.
+GQA is handled *inside* the kernel: all ``group = heads // kv_heads``
+query heads that share a KV head are folded into the matmul row
+dimension, so
+  - K/V are never materialized per-query-head (8x less VMEM traffic for
+    llama-style 32q/4kv),
+  - the QK^T and PV matmuls are ``group``-times taller (MXU likes tall),
+  - the dK/dV group reduction falls out of the contraction for free.
+Layout inside the kernels is [batch*kv_heads, group, seq, head_dim]; the
+public wrapper maps the models' [batch, seq, heads, head_dim] (query head
+i uses kv head i // group, matching jnp.repeat semantics).
 
 Backward follows the FlashAttention-2 structure: a dQ kernel (grid over
 q-blocks, accumulating over k-blocks) and a dK/dV kernel (grid over
@@ -28,15 +35,37 @@ NEG_INF = -1e30
 LANES = 128
 
 
-def _row_ids(q_start, block_q, block_k):
-    return q_start + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
+def _causal_mask(q_start, k_start, g, block_q, block_k):
+    """[g*block_q, block_k] bool: row token >= col token.
+
+    Rows are g-major (row = g_idx*block_q + q_idx), so the query position
+    is ``q_start + row % block_q`` — computed with a bitwise AND
+    (block sizes are powers of two) to stay on Mosaic's supported ops.
+    """
+    rows = jax.lax.broadcasted_iota(
+        jnp.int32, (g * block_q, block_k), 0
     )
+    cols = jax.lax.broadcasted_iota(
+        jnp.int32, (g * block_q, block_k), 1
+    )
+    return (q_start + (rows & (block_q - 1))) >= (k_start + cols)
 
 
-def _col_ids(k_start, block_q, block_k):
-    return k_start + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
+def _stack_groups(ref, g):
+    """[1, g, block, d] ref -> [g*block, d] value, via per-group slices
+    stacked on sublanes (the relayout Mosaic supports; a direct 4-D
+    reshape hits "unsupported shape cast")."""
+    if g == 1:
+        return ref[0, 0]
+    return jnp.concatenate([ref[0, gi] for gi in range(g)], axis=0)
+
+
+def _stack_cols(ref, g):
+    """[1, g, 1, block] ref (lanes) -> [g*block, 1] column (sublanes)."""
+    if g == 1:
+        return ref[0, 0, 0][:, None]
+    return jnp.concatenate(
+        [ref[0, gi, 0][:, None] for gi in range(g)], axis=0
     )
 
 
@@ -44,7 +73,8 @@ def _col_ids(k_start, block_q, block_k):
 # forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+                m_scr, l_scr, acc_scr, *, scale, causal, g,
+                block_q, block_k):
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # k block (minor: sequential, scratch persists)
     nk = pl.num_programs(2)
@@ -64,22 +94,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _compute():
+        q = _stack_groups(q_ref, g)
         # bf16 x bf16 -> fp32 accumulate: the MXU's native mode. Casting
         # inputs to fp32 first would fall off the fast path (~4x slower).
         s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            q, k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
+        ) * scale  # [g*block_q, block_k]
         if causal:
-            mask = _row_ids(q_start, block_q, block_k) >= _col_ids(
-                k_start, block_q, block_k
-            )
+            mask = _causal_mask(q_start, k_start, g, block_q, block_k)
             s = jnp.where(mask, s, NEG_INF)
-        m_prev = m_scr[:, :1]  # [block_q, 1]
+        m_prev = m_scr[:, :1]  # [g*block_q, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)  # [block_q, block_k]
-        corr = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        p = jnp.exp(s - m_new)  # [g*block_q, block_k]
+        corr = jnp.exp(m_prev - m_new)  # [g*block_q, 1]
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0],
@@ -93,8 +122,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(l_safe[:, 0]))
+        out = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = m_scr[:, 0] + jnp.log(l_safe[:, 0])  # (g*block_q,)
+        for gi in range(g):
+            o_ref[0, gi] = out[gi * block_q:(gi + 1) * block_q]
+            lse_ref[0, gi, 0] = lse[gi * block_q:(gi + 1) * block_q]
 
 
 def _check_blocks(seq, block_q, block_k):
@@ -103,41 +135,45 @@ def _check_blocks(seq, block_q, block_k):
             f"seq {seq} must be divisible by block_q={block_q} and "
             f"block_k={block_k}; pad the sequence or pick smaller blocks"
         )
+    if block_q & (block_q - 1):
+        # the causal mask derives query positions with `rows & (block_q-1)`
+        raise ValueError(f"block_q must be a power of two, got {block_q}")
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k):
-    """q,k,v: [bh, seq, d] -> (o [bh, seq, d], lse [bh, 1, seq] f32)."""
-    bh, seq, d = q.shape
+    """q: [bk_h, g, seq, d]; k,v: [bk_h, seq, d] ->
+    (o [bk_h, g, seq, d], lse [bk_h, g, 1, seq] f32)."""
+    bkh, g, seq, d = q.shape
     block_q = min(block_q, seq)
     block_k = min(block_k, seq)
     _check_blocks(seq, block_q, block_k)
-    grid = (bh, seq // block_q, seq // block_k)
+    grid = (bkh, seq // block_q, seq // block_k)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, g=g,
         block_q=block_q, block_k=block_k,
     )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, g, block_q, d), lambda b, i, j: (b, 0, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, g, block_q, d), lambda b, i, j: (b, 0, i, 0)),
+            # [bkh, g, 1, seq]: keeps the lse block's last two dims
+            # (1, block_q) under the TPU (8,128)-or-full tiling rule
+            pl.BlockSpec((1, g, 1, block_q), lambda b, i, j: (b, 0, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
-            # [bh, 1, seq]: keeps the lse block 3-D so its last two dims
-            # (1, block_q) satisfy the TPU (8,128)-or-full tiling rule
-            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
+            jax.ShapeDtypeStruct((bkh, g, seq, d), q.dtype),
+            jax.ShapeDtypeStruct((bkh, g, 1, seq), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((g * block_q, LANES), jnp.float32),
+            pltpu.VMEM((g * block_q, LANES), jnp.float32),
+            pltpu.VMEM((g * block_q, d), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -147,7 +183,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
 # backward
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_scr, *, scale, causal, block_q, block_k):
+               acc_scr, *, scale, causal, g, block_q, block_k):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -164,22 +200,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
+        q = _stack_groups(q_ref, g)
+        do = _stack_groups(do_ref, g)
+        lse = _stack_cols(lse_ref, g)  # [g*bq, 1]
+        delta = _stack_cols(delta_ref, g)
         s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            q, k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            mask = _row_ids(q_start, block_q, block_k) >= _col_ids(
-                k_start, block_q, block_k
-            )
+            mask = _causal_mask(q_start, k_start, g, block_q, block_k)
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])  # [bq, bk]
+        p = jnp.exp(s - lse)  # [g*bq, bk]
         dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0],
+            do, v_ref[0],
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0, 0][:, None])  # [bq, bk]
+        ds = p * (dp - delta)  # [g*bq, bk]
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -187,12 +225,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(j == nk - 1)
     def _finalize():
-        dq_ref[0] = (acc_scr[:] * scale).astype(dq_ref.dtype)
+        dq = (acc_scr[:] * scale).astype(dq_ref.dtype)
+        for gi in range(g):
+            dq_ref[0, gi] = dq[gi * block_q:(gi + 1) * block_q]
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, block_q, block_k):
+                *, scale, causal, g, block_q, block_k):
     j = pl.program_id(1)  # k block (major)
     i = pl.program_id(2)  # q block (minor: accumulates)
     nq = pl.num_programs(2)
@@ -210,30 +250,33 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
+        q = _stack_groups(q_ref, g)
+        do = _stack_groups(do_ref, g)
+        lse = _stack_cols(lse_ref, g)  # [g*bq, 1]
+        delta = _stack_cols(delta_ref, g)
         s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            q, k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            mask = _row_ids(q_start, block_q, block_k) >= _col_ids(
-                k_start, block_q, block_k
-            )
+            mask = _causal_mask(q_start, k_start, g, block_q, block_k)
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])
-        # dV += P^T @ dO
+        p = jnp.exp(s - lse)
+        # dV += P^T @ dO — contracting over g*block_q rows also sums the
+        # GQA group's contributions (the repeat-bwd reduction, for free)
         dv_scr[:] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0],
+            do, v_ref[0],
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0, 0][:, None])
+        ds = p * (dp - delta)
         # dK += dS^T @ Q (scale applied once at finalize)
         dk_scr[:] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -244,59 +287,61 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
-    bh, seq, d = q.shape
+    bkh, g, seq, d = q.shape
     block_q = min(block_q, seq)
     block_k = min(block_k, seq)
     _check_blocks(seq, block_q, block_k)
     delta = jnp.sum(
         o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
-    )[:, None, :]  # [bh, 1, seq] (3-D for TPU block tiling)
+    )[:, :, None, :]  # [bkh, g, 1, seq] (4-D for TPU block tiling)
 
     dq_kernel = functools.partial(
-        _dq_kernel, scale=scale, causal=causal,
+        _dq_kernel, scale=scale, causal=causal, g=g,
         block_q=block_q, block_k=block_k,
     )
     in_specs_q = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # q
+        pl.BlockSpec((1, g, block_q, d), lambda b, i, j: (b, 0, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # k
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # v
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # do
-        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),  # lse
-        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),  # delta
+        pl.BlockSpec((1, g, block_q, d), lambda b, i, j: (b, 0, i, 0)),
+        pl.BlockSpec((1, g, 1, block_q), lambda b, i, j: (b, 0, 0, i)),
+        pl.BlockSpec((1, g, 1, block_q), lambda b, i, j: (b, 0, 0, i)),
     ]
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, seq // block_q, seq // block_k),
+        grid=(bkh, seq // block_q, seq // block_k),
         in_specs=in_specs_q,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        out_specs=pl.BlockSpec(
+            (1, g, block_q, d), lambda b, i, j: (b, 0, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((bkh, g, seq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((g * block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
     dkv_kernel = functools.partial(
-        _dkv_kernel, scale=scale, causal=causal,
+        _dkv_kernel, scale=scale, causal=causal, g=g,
         block_q=block_q, block_k=block_k,
     )
     in_specs_kv = [
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # q
+        pl.BlockSpec((1, g, block_q, d), lambda b, j, i: (b, 0, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # k
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # v
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # do
-        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),  # lse
-        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),  # delta
+        pl.BlockSpec((1, g, block_q, d), lambda b, j, i: (b, 0, i, 0)),
+        pl.BlockSpec((1, g, 1, block_q), lambda b, j, i: (b, 0, 0, i)),
+        pl.BlockSpec((1, g, 1, block_q), lambda b, j, i: (b, 0, 0, i)),
     ]
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, seq // block_k, seq // block_q),
+        grid=(bkh, seq // block_k, seq // block_q),
         in_specs=in_specs_kv,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, seq, d), v.dtype),
+            jax.ShapeDtypeStruct((bkh, seq, d), k.dtype),
+            jax.ShapeDtypeStruct((bkh, seq, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -311,7 +356,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
 # public wrapper with custom VJP
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+def _flash_gqa(q, k, v, scale, causal, block_q, block_k):
     o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
     return o
 
@@ -329,7 +374,7 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
     return dq, dk, dv
 
 
-_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash_gqa.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention_tpu(
@@ -342,21 +387,19 @@ def flash_attention_tpu(
     block_k: int = 512,
 ) -> jax.Array:
     """Flash attention in the models' [batch, seq, heads, head_dim]
-    layout; GQA via KV-head broadcast."""
+    layout; GQA folded into the kernels' matmul rows (no KV repeat)."""
     b, s, h, d = q.shape
     kvh = k.shape[2]
+    g = h // kvh
     scale = scale if scale is not None else d ** -0.5
-    if kvh != h:
-        group = h // kvh
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
-    # [b, s, h, d] -> [b*h, s, d]
-    def to_bhsd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # [b, s, h, d] -> [b*kvh, g, s, d]: query head i = (i // g, i % g)
+    qg = q.transpose(0, 2, 1, 3).reshape(b * kvh, g, s, d)
 
-    o = _flash_bhsd(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, causal,
-        block_q, block_k,
+    def kv_layout(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * kvh, s, d)
+
+    o = _flash_gqa(
+        qg, kv_layout(k), kv_layout(v), scale, causal, block_q, block_k,
     )
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 

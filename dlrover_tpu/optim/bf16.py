@@ -44,6 +44,12 @@ def master_weights(
         return MasterWeightsState(master, inner.init(master))
 
     def update(grads, state, params=None):
+        if params is None:
+            raise ValueError(
+                "master_weights requires the current params to be passed "
+                "to update() (they are the bf16 working copies the "
+                "returned deltas are applied to)"
+            )
         g = jax.tree.map(lambda x: x.astype(master_dtype), grads)
         updates, inner_state = inner.update(g, state.inner_state,
                                             state.master)
