@@ -51,6 +51,7 @@ class ElasticLaunchConfig:
     node_unit: int = 1
     max_restarts: int = 3
     monitor_interval: float = 3.0
+    heartbeat_interval: float = 15.0
     network_check: bool = False
     entrypoint: str = ""
     args: List[str] = field(default_factory=list)
@@ -191,7 +192,7 @@ class ElasticTrainingAgent:
     def run(self) -> RunResult:
         """The agent main loop (parity: _invoke_run training.py:365)."""
         self._client.update_node_status(NodeStatus.RUNNING)
-        self._start_heartbeat()
+        self._start_heartbeat(self._config.heartbeat_interval)
         try:
             result = self._invoke_run()
         except Exception as e:

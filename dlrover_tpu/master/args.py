@@ -23,4 +23,7 @@ def parse_master_args(argv=None):
     parser.add_argument("--namespace", type=str, default="default")
     parser.add_argument("--pending_timeout", type=int, default=900)
     parser.add_argument("--relaunch_always", type=str2bool, default=False)
+    parser.add_argument("--heartbeat_timeout", type=float, default=90.0,
+                        help="seconds without an agent heartbeat before "
+                             "the master declares the node dead")
     return parser.parse_args(argv)

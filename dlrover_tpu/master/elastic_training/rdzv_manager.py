@@ -39,6 +39,7 @@ class RendezvousManager(ABC):
     def __init__(self):
         self._lock = Lock()
         self._alive_nodes = set()
+        self._succeeded_nodes = set()
         self._waiting_nodes: Dict[int, int] = {}  # node_rank -> local procs
         self._rdzv_nodes: Dict[int, int] = {}  # the latest completed world
         self._lastcall_time = 0.0
@@ -75,6 +76,14 @@ class RendezvousManager(ABC):
             if node_id in self._waiting_nodes:
                 del self._waiting_nodes[node_id]
 
+    def mark_node_succeeded(self, node_id: int):
+        """A normal exit: the node leaves the alive set WITHOUT tripping
+        the shrink signal — survivors finishing their last steps must not
+        be restarted because a peer completed first."""
+        with self._lock:
+            self._succeeded_nodes.add(node_id)
+        self.remove_alive_node(node_id)
+
     def join_rendezvous(self, node_rank: int, local_world_size: int) -> int:
         """A node (TPU host agent) joins the next round; returns round."""
         with self._lock:
@@ -83,6 +92,7 @@ class RendezvousManager(ABC):
             if node_rank not in self._waiting_nodes:
                 self._waiting_nodes[node_rank] = local_world_size
                 self._lastcall_time = time.time()
+            self._succeeded_nodes.discard(node_rank)
             # joining proves liveness; a later failed/deleted status report
             # prunes the node (servicer.rpc_update_node_status), which lets
             # num_nodes_waiting see a spare as a REPLACEMENT for it
@@ -96,9 +106,13 @@ class RendezvousManager(ABC):
             if not self._rdzv_nodes:
                 return len(self._waiting_nodes)
             waiting = set(self._waiting_nodes)
-            if not waiting:
+            # normally-exited members don't count: their absence is not a
+            # failure the survivors need to react to
+            members = set(self._rdzv_nodes) - self._succeeded_nodes
+            survivors = members & self._alive_nodes
+            if not waiting and survivors == members:
+                # full current world alive, nobody new: nothing to do
                 return 0
-            members = set(self._rdzv_nodes)
             # a current-world member re-joined: node loss/restart, the world
             # must re-form
             if waiting & members:
@@ -106,11 +120,11 @@ class RendezvousManager(ABC):
             # Signal iff the next-round world would DIFFER from the current
             # one. A node_unit leftover (3 joiners, unit=2) re-truncates to
             # the same world -> signalling would livelock agents in restart
-            # loops; but a spare replacing a dead member, or a full unit of
-            # growth, forms a different world and must signal.
-            # every waiting node joined (join adds to _alive_nodes), so the
-            # alive set is non-empty here
-            survivors = members & self._alive_nodes
+            # loops; but a spare replacing a dead member, a full unit of
+            # growth, or a DEAD MEMBER the survivors must shed (the master
+            # pruned it from the alive set on heartbeat loss/failure; the
+            # waiting set may be empty then) forms a different world and
+            # must signal.
             candidates = sorted(waiting | survivors)
             p = self._rdzv_params
             keep = min(
@@ -120,7 +134,9 @@ class RendezvousManager(ABC):
             if keep < max(p.min_nodes, 1):
                 return 0
             if set(candidates[:keep]) != members:
-                return len(self._waiting_nodes)
+                # at least 1 even when nobody waits (pure shrink): agents
+                # only compare this against zero
+                return max(1, len(self._waiting_nodes))
             return 0
 
     def _check_rdzv_completed(self):

@@ -16,6 +16,7 @@ def run(args) -> int:
         node_num=args.node_num,
         platform=args.platform,
         distribution_strategy=args.distribution_strategy,
+        heartbeat_timeout=args.heartbeat_timeout,
     )
     if args.platform == "local":
         from dlrover_tpu.master.local_master import LocalJobMaster

@@ -344,8 +344,12 @@ def create_job_manager(job_args, speed_monitor, scaler=None,
                        watcher=None, job_optimizer=None,
                        error_monitor=None) -> DistributedJobManager:
     """parity: dist_job_manager.py:700."""
+    kwargs = {}
+    hb = getattr(job_args, "heartbeat_timeout", None)
+    if hb is not None:
+        kwargs["heartbeat_timeout"] = hb
     return DistributedJobManager(
         job_args=job_args, speed_monitor=speed_monitor, scaler=scaler,
         watcher=watcher, job_optimizer=job_optimizer,
-        error_monitor=error_monitor,
+        error_monitor=error_monitor, **kwargs,
     )

@@ -218,7 +218,9 @@ class MasterServicer:
                 req.restart_count,
             )
         for mgr in self._rdzv_managers.values():
-            if req.status in ("succeeded", "failed", "deleted"):
+            if req.status == "succeeded":
+                mgr.mark_node_succeeded(req.node_id)
+            elif req.status in ("failed", "deleted"):
                 mgr.remove_alive_node(req.node_id)
         return comm.Response(success=True)
 

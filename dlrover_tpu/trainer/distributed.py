@@ -43,20 +43,41 @@ def read_dist_env() -> DistributedEnv:
 
 def init_from_env(timeout_s: int = 300) -> DistributedEnv:
     """Initialize jax.distributed from the agent-provided env (no-op for a
-    single process)."""
+    single process).
+
+    ``DLROVER_TPU_DIST_HEARTBEAT_TIMEOUT`` (seconds) bounds how long a
+    process blocks on collectives with a dead peer before the runtime
+    kills it so the agent can re-rendezvous. The default (45s, vs jax's
+    100s) keeps dead-peer detection inside the north-star <60s recovery
+    budget.
+    """
     env = read_dist_env()
     if env.is_distributed and env.coordinator_addr:
         import jax
 
+        # decided from the env, NOT jax.default_backend(): touching a
+        # backend before jax.distributed.initialize() would create a
+        # single-process client and the world would silently not form
+        if os.getenv("JAX_PLATFORMS", "").startswith("cpu"):
+            # cross-process CPU collectives (the multi-host test fabric;
+            # TPU uses ICI/DCN natively)
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+        hb_timeout = int(float(
+            os.getenv("DLROVER_TPU_DIST_HEARTBEAT_TIMEOUT", "45")
+        ))
         logger.info(
             "jax.distributed.initialize(%s, num_processes=%d, "
-            "process_id=%d)",
+            "process_id=%d, heartbeat_timeout=%ds)",
             env.coordinator_addr, env.num_processes, env.process_id,
+            hb_timeout,
         )
         jax.distributed.initialize(
             coordinator_address=env.coordinator_addr,
             num_processes=env.num_processes,
             process_id=env.process_id,
             initialization_timeout=timeout_s,
+            heartbeat_timeout_seconds=hb_timeout,
         )
     return env

@@ -37,6 +37,10 @@ def parse_args(argv=None):
                         default=int(os.getenv(NodeEnv.NODE_RANK, "0")))
     parser.add_argument("--max_restarts", type=int, default=3)
     parser.add_argument("--monitor_interval", type=float, default=3.0)
+    parser.add_argument("--heartbeat_interval", type=float, default=15.0,
+                        help="agent liveness heartbeat period to the "
+                             "master (the master's watchdog timeout "
+                             "should be >= 3x this)")
     parser.add_argument("--rdzv_timeout", type=float, default=30.0)
     parser.add_argument("--node_unit", type=int, default=1,
                         help="world sizes stay multiples of this "
@@ -124,6 +128,7 @@ def run(args) -> int:
         node_unit=args.node_unit,
         max_restarts=args.max_restarts,
         monitor_interval=args.monitor_interval,
+        heartbeat_interval=args.heartbeat_interval,
         network_check=args.network_check,
         entrypoint=args.entrypoint,
         args=entry_args,

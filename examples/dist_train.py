@@ -1,0 +1,138 @@
+"""Multi-host elastic training workload: real ``jax.distributed`` world.
+
+Run one launcher per "host" against a shared master::
+
+    dlrover-tpu-run --master_addr HOST:PORT --nnodes 1:2 --node_rank R \
+        examples/dist_train.py -- --steps 40 --ckpt-dir /tmp/dist_ckpt_R
+
+Each process contributes its slice of the global batch (sharded over the
+``data`` mesh axis), so every train step runs a cross-process gradient
+psum — killing a peer stalls the survivor's collectives, which is exactly
+what the elastic machinery must recover from: the coordination-service
+heartbeat (DLROVER_TPU_DIST_HEARTBEAT_TIMEOUT) kills the stalled process,
+the master's watchdog prunes the dead node, the agent re-rendezvouses,
+and training resumes from the flash checkpoint in the surviving world.
+
+Progress is appended to ``--progress`` as ``step,world,loss,unix_ts``
+lines — the failover drill derives its recovery_seconds metric from them.
+Parity role: the reference's multi-node system tests
+(.github/actions/dlrover-system-test-*/action.yaml).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+from dlrover_tpu.trainer.distributed import init_from_env
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=40)
+    parser.add_argument("--per-proc-batch", type=int, default=8)
+    parser.add_argument("--dim", type=int, default=16)
+    parser.add_argument("--ckpt-dir", type=str, default="/tmp/dist_ckpt")
+    parser.add_argument("--progress", type=str, default="")
+    parser.add_argument("--out", type=str, default="")
+    parser.add_argument("--step-time", type=float, default=0.2,
+                        help="min seconds per step (keeps the drill's "
+                             "kill window wide)")
+    args = parser.parse_args()
+
+    env = init_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    world = jax.process_count()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P("data"))
+    print(f"WORLD process_count={world} pid={jax.process_index()}",
+          flush=True)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(args.dim, 1).astype(np.float32)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"] + params["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    opt = optax.adam(0.05)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = {"w": jnp.zeros((args.dim, 1)), "b": jnp.zeros((1,))}
+    opt_state = opt.init(params)
+    params = jax.device_put(params, repl)
+
+    ckpt = FlashCheckpointer(
+        persist_dir=os.path.join(args.ckpt_dir, "persist"),
+        ram_dir=os.path.join(args.ckpt_dir, "ram"),
+        persist_interval=0, use_orbax=False,
+    )
+    state = {"params": params, "opt_state": opt_state,
+             "step": jnp.array(0)}
+    restored, _ = ckpt.restore(target=state)
+    start_step = 0
+    if restored is not None:
+        state = restored
+        start_step = int(state["step"])
+        print(f"RESTORED from step {start_step}", flush=True)
+    params, opt_state = state["params"], state["opt_state"]
+    params = jax.device_put(jax.device_get(params), repl)
+    opt_state = jax.device_put(jax.device_get(opt_state), repl)
+
+    n_local = args.per_proc_batch * jax.local_device_count()
+    global_batch = n_local * world
+    step = start_step
+    loss = None
+    while step < args.steps:
+        t0 = time.time()
+        # deterministic per-(step, process) slice of a global batch
+        seed = 1000 * step + jax.process_index()
+        r = np.random.RandomState(seed)
+        xl = r.randn(n_local, args.dim).astype(np.float32)
+        yl = (xl @ w_true).astype(np.float32)
+        x = jax.make_array_from_process_local_data(
+            data_sh, xl, (global_batch, args.dim))
+        y = jax.make_array_from_process_local_data(
+            data_sh, yl, (global_batch, 1))
+        params, opt_state, loss = train_step(params, opt_state, (x, y))
+        loss_val = float(loss)
+        step += 1
+        if args.progress:
+            with open(args.progress, "a") as f:
+                f.write(f"{step},{world},{loss_val:.6f},{time.time()}\n")
+        if step % 5 == 0 or step == args.steps:
+            ckpt.save(
+                step,
+                {"params": jax.device_get(params),
+                 "opt_state": jax.device_get(opt_state),
+                 "step": jnp.array(step)},
+            )
+        dt = time.time() - t0
+        if dt < args.step_time:
+            time.sleep(args.step_time - dt)
+
+    loss_val = float(loss) if loss is not None else float("nan")
+    print(f"FINAL step={step} loss={loss_val:.6f} world={world}",
+          flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(f"{step},{loss_val:.6f},{start_step},{world}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -180,3 +180,61 @@ def test_singleton_probe_cannot_clear_abnormal_status():
     ok, _ = m.network_check_success()
     assert not ok
     assert sorted(m.get_fault_nodes()) == sorted(solo)
+
+
+def test_dead_member_signals_shrink_without_waiters():
+    """A member pruned from the alive set (heartbeat loss / node failure)
+    must signal membership change even though nobody is WAITING — the
+    survivors' agents re-rendezvous into the smaller world. Regression:
+    num_nodes_waiting used to return 0 whenever the waiting set was
+    empty, so a 2-node world losing a host never re-formed."""
+    m = _mgr(1, 2)
+    m.join_rendezvous(0, 1)
+    m.join_rendezvous(1, 1)
+    _, _, world = m.get_comm_world(0)
+    assert world == {0: 1, 1: 1}
+    assert m.num_nodes_waiting() == 0  # healthy steady state
+
+    m.remove_alive_node(1)  # master watchdog pruned the dead host
+    assert m.num_nodes_waiting() > 0  # survivor must re-rendezvous
+
+    m.join_rendezvous(0, 1)
+    time.sleep(0.25)  # waiting_timeout elapses; min_nodes=1 completes
+    _, _, world = m.get_comm_world(0)
+    assert world == {0: 1}
+    assert m.num_nodes_waiting() == 0  # signal clears after re-form
+
+
+def test_dead_member_no_signal_below_min_nodes():
+    """If the survivors cannot form a valid world (fewer than min_nodes),
+    the shrink must NOT signal — restarting the survivors would only tear
+    down work that cannot resume anyway; they wait for a replacement."""
+    m = _mgr(2, 2)
+    m.join_rendezvous(0, 1)
+    m.join_rendezvous(1, 1)
+    _, _, world = m.get_comm_world(0)
+    assert world == {0: 1, 1: 1}
+
+    m.remove_alive_node(1)
+    assert m.num_nodes_waiting() == 0  # 1 survivor < min_nodes=2
+
+    m.join_rendezvous(2, 1)  # a replacement arrives
+    assert m.num_nodes_waiting() > 0  # now a new 2-node world can form
+
+
+def test_succeeded_member_does_not_signal_shrink():
+    """A member that exits SUCCEEDED leaves the alive set but must not
+    trip the shrink signal — otherwise every staggered multi-node
+    completion restarts the still-finishing survivors."""
+    m = _mgr(1, 2)
+    m.join_rendezvous(0, 1)
+    m.join_rendezvous(1, 1)
+    _, _, world = m.get_comm_world(0)
+    assert world == {0: 1, 1: 1}
+
+    m.mark_node_succeeded(1)  # normal exit, NOT a failure
+    assert m.num_nodes_waiting() == 0
+
+    # but the same rank re-joining later (a new run) still works
+    m.join_rendezvous(1, 1)
+    assert m.num_nodes_waiting() > 0
