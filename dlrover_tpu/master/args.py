@@ -15,15 +15,24 @@ def parse_master_args(argv=None):
                         help="gRPC port; 0 picks a free port")
     parser.add_argument("--job_name", type=str, default="local-job")
     parser.add_argument("--platform", type=str, default="local",
-                        choices=["local", "kubernetes", "tpu_vm"])
+                        choices=["local", "process", "tpu_vm"])
+    parser.add_argument("--host", type=str, default="",
+                        help="externally-reachable master host baked into "
+                             "worker VM metadata (default: this host's "
+                             "primary IP; 'localhost' for local runs)")
     parser.add_argument("--distribution_strategy", type=str,
                         default="allreduce")
-    parser.add_argument("--node_num", type=int, default=1,
-                        help="expected number of worker nodes (TPU hosts)")
+    parser.add_argument("--node_num", type=int, default=None,
+                        help="expected number of worker nodes (TPU hosts); "
+                             "overrides the job spec when given")
     parser.add_argument("--namespace", type=str, default="default")
     parser.add_argument("--pending_timeout", type=int, default=900)
     parser.add_argument("--relaunch_always", type=str2bool, default=False)
-    parser.add_argument("--heartbeat_timeout", type=float, default=90.0,
+    parser.add_argument("--heartbeat_timeout", type=float, default=None,
                         help="seconds without an agent heartbeat before "
-                             "the master declares the node dead")
+                             "the master declares the node dead "
+                             "(default 90)")
+    parser.add_argument("--job_spec", type=str, default="",
+                        help="path to a declarative ElasticTpuJob "
+                             "YAML/JSON spec (scheduler/job_spec.py)")
     return parser.parse_args(argv)

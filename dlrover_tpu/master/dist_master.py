@@ -87,8 +87,12 @@ class DistributedJobMaster:
             # requeue the dead worker's data shards
             # (parity: TaskRescheduleCallback event_callback.py:117)
             self.task_manager.recover_tasks(node.type, node.id)
+            # rendezvous sets are keyed by RANK: a relaunched node keeps
+            # its rank under a fresh id
+            rank = (node.rank_index if node.rank_index is not None
+                    else node.id)
             for mgr in self.rdzv_managers.values():
-                mgr.remove_alive_node(node.id)
+                mgr.remove_alive_node(rank)
 
         def on_deleted(node):
             on_failed(node)

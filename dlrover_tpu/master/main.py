@@ -1,31 +1,90 @@
 """Master entry (parity: dlrover/python/master/main.py:36).
 
-Local platform -> LocalJobMaster; kubernetes/tpu_vm -> DistributedJobMaster.
+Local platform -> LocalJobMaster; process/tpu_vm ->
+DistributedJobMaster with the platform scaler/watcher from
+scheduler.factory. ``--job_spec`` ingests a declarative ElasticTpuJob
+document (the CRD equivalent, scheduler/job_spec.py) and CLI flags
+override it.
 """
 
+import socket
 import sys
-import types
 
+from dlrover_tpu.common.grpc_utils import find_free_port
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.args import parse_master_args
+from dlrover_tpu.scheduler.job_spec import JobArgs
+
+
+def build_job_args(args) -> JobArgs:
+    if getattr(args, "job_spec", ""):
+        job_args = JobArgs.from_file(args.job_spec,
+                                     platform=args.platform)
+        # CLI overrides for the handful of flags that also exist here
+        if args.node_num is not None:
+            job_args.node_num = args.node_num
+        if args.heartbeat_timeout is not None:
+            job_args.heartbeat_timeout = args.heartbeat_timeout
+        job_args.platform = args.platform
+        return job_args
+    return JobArgs(
+        job_name=args.job_name,
+        platform=args.platform,
+        node_num=args.node_num if args.node_num is not None else 1,
+        distribution_strategy=args.distribution_strategy,
+        heartbeat_timeout=args.heartbeat_timeout,
+        relaunch_always=args.relaunch_always,
+    )
+
+
+def _master_host(args) -> str:
+    """The address workers dial: must be reachable from worker VMs, so
+    default to this host's primary outbound IP (localhost only works for
+    same-host platforms)."""
+    if args.host:
+        return args.host
+    if args.platform in ("local", "process"):
+        return "localhost"
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return socket.gethostname()
 
 
 def run(args) -> int:
-    job_args = types.SimpleNamespace(
-        job_name=args.job_name,
-        node_num=args.node_num,
-        platform=args.platform,
-        distribution_strategy=args.distribution_strategy,
-        heartbeat_timeout=args.heartbeat_timeout,
-    )
+    job_args = build_job_args(args)
     if args.platform == "local":
         from dlrover_tpu.master.local_master import LocalJobMaster
 
         master = LocalJobMaster(port=args.port, job_args=job_args)
     else:
         from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.scheduler.factory import build_platform
 
-        master = DistributedJobMaster(port=args.port, job_args=job_args)
+        # The scaler bakes the master address into worker metadata, so
+        # the port must be fixed before the platform is built. Probing a
+        # free port then binding is racy, so retry on bind failure.
+        master = None
+        for attempt in range(3):
+            port = args.port or find_free_port()
+            scaler, watcher = build_platform(
+                job_args, f"{_master_host(args)}:{port}"
+            )
+            try:
+                master = DistributedJobMaster(
+                    port=port, job_args=job_args, scaler=scaler,
+                    watcher=watcher,
+                )
+                break
+            except Exception as e:
+                if args.port or attempt == 2:
+                    raise
+                logger.warning(
+                    "port %d lost to a race (%s); retrying", port, e
+                )
+        assert master is not None
     master.prepare()
     # print the bound port so a parent launcher can discover it
     print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)

@@ -71,6 +71,8 @@ class DistributedJobManager:
         resource = getattr(
             self._job_args, "node_resource", None
         ) or NodeResource()
+        if self._scaler:
+            self._scaler.start()
         if node_num and self._scaler:
             mgr = self._node_managers[NodeType.WORKER]
             new_nodes = mgr.scale_up_nodes(node_num, resource)

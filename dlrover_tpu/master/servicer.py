@@ -217,11 +217,18 @@ class MasterServicer:
                 req.node_type, req.node_id, req.status, req.exit_reason,
                 req.restart_count,
             )
+        # rendezvous sets are keyed by node RANK (agents join with their
+        # rank); a relaunched node has a fresh id but keeps its rank
+        rank = req.node_id
+        if self._job_manager:
+            node = self._job_manager.get_node(req.node_type, req.node_id)
+            if node is not None and node.rank_index is not None:
+                rank = node.rank_index
         for mgr in self._rdzv_managers.values():
             if req.status == "succeeded":
-                mgr.mark_node_succeeded(req.node_id)
+                mgr.mark_node_succeeded(rank)
             elif req.status in ("failed", "deleted"):
-                mgr.remove_alive_node(req.node_id)
+                mgr.remove_alive_node(rank)
         return comm.Response(success=True)
 
     def rpc_update_node_address(

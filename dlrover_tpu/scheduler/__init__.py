@@ -1,0 +1,8 @@
+"""Platform layer: declarative job specs + cloud scalers/watchers.
+
+Parity reference: dlrover/python/scheduler/ (kubernetes.py, ray.py,
+job.py) + the Go operator's provisioning role
+(dlrover/go/operator/pkg/controllers/elasticjob_controller.go) — on TPU
+the "cluster" is a fleet of TPU VMs, so the platform primitives are
+TPU-VM create/delete/list instead of pod CRUD.
+"""

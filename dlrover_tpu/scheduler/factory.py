@@ -1,0 +1,76 @@
+"""Platform factory: JobArgs -> (scaler, watcher).
+
+Parity reference: the reference picks its platform in
+dlrover/python/master/dist_master.py + scheduler/factory.py; here one
+function owns the mapping:
+
+  local   -> ProcessScaler + its InMemoryWatcher (single host / tests)
+  tpu_vm  -> TpuVmScaler/TpuVmWatcher over RestTpuVmApi, or FakeTpuVmApi
+             when DLROVER_TPU_FAKE_PLATFORM=1 (system tests without a
+             cloud project)
+"""
+
+import os
+from typing import Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.scaler.base_scaler import Scaler
+from dlrover_tpu.master.watcher.base_watcher import NodeWatcher
+
+
+def build_platform(
+    job_args, master_addr: str
+) -> Tuple[Optional[Scaler], Optional[NodeWatcher]]:
+    platform = getattr(job_args, "platform", "local")
+    job_name = getattr(job_args, "job_name", "job")
+    if platform == "tpu_vm":
+        from dlrover_tpu.scheduler.tpu_vm import (
+            FakeTpuVmApi,
+            RestTpuVmApi,
+        )
+        from dlrover_tpu.scheduler.tpu_vm_scaler import TpuVmScaler
+        from dlrover_tpu.scheduler.tpu_vm_watcher import TpuVmWatcher
+
+        project = getattr(job_args, "project", "")
+        zone = getattr(job_args, "zone", "")
+        if os.getenv("DLROVER_TPU_FAKE_PLATFORM") == "1":
+            logger.info("tpu_vm platform using FAKE fleet API")
+            api = FakeTpuVmApi(auto_ready=True)
+        elif project and zone:
+            api = RestTpuVmApi(project, zone)
+        else:
+            logger.warning(
+                "tpu_vm platform without project/zone: no fleet "
+                "automation (agents must be started manually)"
+            )
+            return None, None
+        scaler = TpuVmScaler(
+            job_name, api, master_addr,
+            accelerator_type=getattr(job_args, "accelerator_type", ""),
+            runtime_version=getattr(job_args, "runtime_version", ""),
+            preemptible=getattr(job_args, "preemptible", False),
+            worker_env=getattr(job_args, "worker_env", None),
+        )
+        watcher = TpuVmWatcher(job_name, api)
+        return scaler, watcher
+    if platform == "process":
+        from dlrover_tpu.master.scaler.process_scaler import ProcessScaler
+
+        command = list(getattr(job_args, "worker_command", []) or [])
+        if not command:
+            logger.warning(
+                "process platform needs spec worker.command to launch "
+                "agents; no fleet automation"
+            )
+            return None, None
+        scaler = ProcessScaler(
+            job_name, master_addr, command=command,
+            env=dict(getattr(job_args, "worker_env", {}) or {}),
+        )
+        return scaler, scaler.watcher
+    if platform != "local":
+        logger.warning(
+            "platform %r has no scaler/watcher implementation; no fleet "
+            "automation (agents must be started manually)", platform,
+        )
+    return None, None

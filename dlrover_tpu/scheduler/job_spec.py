@@ -1,0 +1,135 @@
+"""Declarative job spec -> JobArgs (the CRD-ingestion equivalent).
+
+Parity reference: dlrover/python/scheduler/job.py:79 (JobArgs) and
+kubernetes.py:314 (K8sJobArgs.initilize parsing the ElasticJob CR's
+replicaSpecs/resources). The TPU shape replaces pod templates with TPU-VM
+fleet parameters (accelerator type, runtime version, preemptible) and
+keeps the elastic knobs (min/max replicas, node_unit slice granularity,
+relaunch policy).
+
+Spec example (YAML or JSON)::
+
+    apiVersion: dlrover-tpu/v1
+    kind: ElasticTpuJob
+    metadata:
+      name: llama-pretrain
+    spec:
+      distributionStrategy: allreduce
+      nodeUnit: 4                 # hosts per ICI slice
+      relaunchStrategy: always
+      heartbeatTimeout: 30
+      worker:
+        replicas: 16
+        minReplicas: 8
+        acceleratorType: v5litepod-16
+        runtimeVersion: tpu-ubuntu2204-base
+        preemptible: true
+        maxRelaunchCount: 3
+        resource: {cpu: 96, memory: 180Gi}
+        env: {WANDB_MODE: offline}
+"""
+
+import dataclasses
+import json
+import re
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+
+_MEM_UNITS = {
+    "": 1 / (1024 * 1024), "k": 1 / 1024, "ki": 1 / 1024,
+    "m": 1, "mi": 1, "g": 1024, "gi": 1024, "t": 1024 * 1024,
+    "ti": 1024 * 1024,
+}
+
+
+def parse_memory_mb(value) -> int:
+    """'180Gi' / '512Mi' / 1073741824 (bytes) -> MB."""
+    if isinstance(value, (int, float)):
+        return int(value / (1024 * 1024))
+    m = re.fullmatch(r"\s*([0-9.]+)\s*([A-Za-z]*)\s*", str(value))
+    if not m:
+        raise ValueError(f"unparseable memory quantity: {value!r}")
+    num, unit = float(m.group(1)), m.group(2).lower().rstrip("b")
+    if unit not in _MEM_UNITS:
+        raise ValueError(f"unknown memory unit in {value!r}")
+    return int(num * _MEM_UNITS[unit])
+
+
+@dataclasses.dataclass
+class JobArgs:
+    """Everything the master needs to run one elastic TPU job."""
+
+    job_name: str = "job"
+    platform: str = "local"
+    namespace: str = "default"  # GCP: project/zone live here too
+    project: str = ""
+    zone: str = ""
+    distribution_strategy: str = "allreduce"
+    node_num: int = 1
+    min_node_num: int = 1
+    node_unit: int = 1
+    relaunch_always: bool = False
+    heartbeat_timeout: Optional[float] = None
+    # worker fleet parameters
+    node_resource: NodeResource = dataclasses.field(
+        default_factory=NodeResource
+    )
+    accelerator_type: str = ""
+    runtime_version: str = ""
+    preemptible: bool = False
+    max_relaunch_count: int = 3
+    worker_env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    worker_command: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def worker_group(self) -> NodeGroupResource:
+        return NodeGroupResource(self.node_num, self.node_resource)
+
+    @classmethod
+    def from_dict(cls, doc: Dict, platform: str = "tpu_vm") -> "JobArgs":
+        """Build JobArgs from a parsed ElasticTpuJob document."""
+        spec = doc.get("spec", doc)
+        meta = doc.get("metadata", {})
+        worker = spec.get("worker", {})
+        res = worker.get("resource", {})
+        args = cls(
+            job_name=meta.get("name", spec.get("jobName", "job")),
+            platform=platform,
+            namespace=meta.get("namespace", "default"),
+            project=spec.get("project", ""),
+            zone=spec.get("zone", ""),
+            distribution_strategy=spec.get(
+                "distributionStrategy", "allreduce"),
+            node_num=int(worker.get("replicas", 1)),
+            min_node_num=int(
+                worker.get("minReplicas", worker.get("replicas", 1))),
+            node_unit=int(spec.get("nodeUnit", 1)),
+            relaunch_always=spec.get("relaunchStrategy", "") == "always",
+            heartbeat_timeout=spec.get("heartbeatTimeout"),
+            node_resource=NodeResource(
+                cpu=float(res.get("cpu", 0)),
+                memory=parse_memory_mb(res.get("memory", 0)),
+                tpu_type=worker.get("acceleratorType", ""),
+                priority=worker.get("priority", ""),
+            ),
+            accelerator_type=worker.get("acceleratorType", ""),
+            runtime_version=worker.get("runtimeVersion", ""),
+            preemptible=bool(worker.get("preemptible", False)),
+            max_relaunch_count=int(worker.get("maxRelaunchCount", 3)),
+            worker_env=dict(worker.get("env", {})),
+            worker_command=list(worker.get("command", [])),
+        )
+        return args
+
+    @classmethod
+    def from_file(cls, path: str, platform: str = "tpu_vm") -> "JobArgs":
+        with open(path) as f:
+            text = f.read()
+        try:
+            doc = json.loads(text)
+        except ValueError:
+            import yaml
+
+            doc = yaml.safe_load(text)
+        return cls.from_dict(doc, platform=platform)

@@ -1,0 +1,232 @@
+"""TPU-VM fleet API: the platform client behind the scaler/watcher.
+
+Parity reference: dlrover/python/scheduler/kubernetes.py:84 (k8sClient
+wrapping the API server with retries) — here the "API server" is the
+Cloud TPU API (tpu.googleapis.com v2). The interface is the minimal verb
+set the platform layer needs; two implementations:
+
+- :class:`FakeTpuVmApi` — an in-memory fleet with explicit lifecycle
+  advancement (``tick``) and fault injection (``preempt``/``fail``), the
+  unit/system-test double (parity: the reference tests' mocked k8s
+  client, tests/test_pod_scaler.py:191).
+- :class:`RestTpuVmApi` — urllib against the real Cloud TPU REST API
+  using the VM metadata-server token; only constructed when explicitly
+  configured (real cluster), never in tests.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class TpuVmState:
+    """Cloud TPU API node states (tpu.googleapis.com v2 Node.State)."""
+
+    CREATING = "CREATING"
+    READY = "READY"
+    RESTARTING = "RESTARTING"
+    REIMAGING = "REIMAGING"
+    DELETING = "DELETING"
+    REPAIRING = "REPAIRING"
+    STOPPED = "STOPPED"
+    TERMINATED = "TERMINATED"
+    PREEMPTED = "PREEMPTED"
+    UNKNOWN = "UNKNOWN"
+
+
+class TpuVmRecord(dict):
+    """One fleet entry: name, state, labels, metadata, health."""
+
+    @property
+    def name(self) -> str:
+        return self["name"]
+
+    @property
+    def state(self) -> str:
+        return self.get("state", TpuVmState.UNKNOWN)
+
+
+class TpuVmApi(ABC):
+    """Minimal Cloud-TPU verb set used by the platform layer."""
+
+    @abstractmethod
+    def create_node(self, name: str, accelerator_type: str,
+                    runtime_version: str, labels: Dict[str, str],
+                    metadata: Dict[str, str],
+                    preemptible: bool = False) -> bool:
+        """Request a TPU VM (async: it appears as CREATING)."""
+
+    @abstractmethod
+    def delete_node(self, name: str) -> bool:
+        """Request deletion (async: DELETING then gone)."""
+
+    @abstractmethod
+    def list_nodes(self) -> List[TpuVmRecord]:
+        """Snapshot of the fleet."""
+
+    def get_node(self, name: str) -> Optional[TpuVmRecord]:
+        for rec in self.list_nodes():
+            if rec.name == name:
+                return rec
+        return None
+
+
+class FakeTpuVmApi(TpuVmApi):
+    """In-memory fleet for tests: lifecycle advances only via ``tick``
+    (CREATING -> READY, DELETING -> gone) so tests control timing, and
+    faults are injected with ``preempt``/``fail``."""
+
+    def __init__(self, auto_ready: bool = False):
+        self._lock = threading.Lock()
+        self._fleet: Dict[str, TpuVmRecord] = {}
+        self._auto_ready = auto_ready
+        self.create_calls: List[Dict] = []
+        self.delete_calls: List[str] = []
+
+    # -- TpuVmApi ---------------------------------------------------------
+
+    def create_node(self, name, accelerator_type, runtime_version,
+                    labels, metadata, preemptible=False) -> bool:
+        with self._lock:
+            self.create_calls.append({
+                "name": name, "accelerator_type": accelerator_type,
+                "runtime_version": runtime_version, "labels": dict(labels),
+                "metadata": dict(metadata), "preemptible": preemptible,
+            })
+            if name in self._fleet:
+                return False
+            self._fleet[name] = TpuVmRecord(
+                name=name,
+                state=(TpuVmState.READY if self._auto_ready
+                       else TpuVmState.CREATING),
+                labels=dict(labels), metadata=dict(metadata),
+                accelerator_type=accelerator_type,
+                preemptible=preemptible, health="HEALTHY",
+                create_time=time.time(),
+            )
+            return True
+
+    def delete_node(self, name) -> bool:
+        with self._lock:
+            self.delete_calls.append(name)
+            rec = self._fleet.get(name)
+            if rec is None:
+                return False
+            rec["state"] = TpuVmState.DELETING
+            return True
+
+    def list_nodes(self) -> List[TpuVmRecord]:
+        with self._lock:
+            return [TpuVmRecord(r) for r in self._fleet.values()]
+
+    # -- test controls ----------------------------------------------------
+
+    def tick(self):
+        """Advance async lifecycles one step."""
+        with self._lock:
+            for name in list(self._fleet):
+                rec = self._fleet[name]
+                if rec.state == TpuVmState.CREATING:
+                    rec["state"] = TpuVmState.READY
+                elif rec.state == TpuVmState.DELETING:
+                    del self._fleet[name]
+
+    def preempt(self, name: str):
+        with self._lock:
+            if name in self._fleet:
+                self._fleet[name]["state"] = TpuVmState.PREEMPTED
+
+    def fail(self, name: str, state: str = TpuVmState.REPAIRING,
+             health: str = "UNHEALTHY_TPU"):
+        with self._lock:
+            if name in self._fleet:
+                self._fleet[name]["state"] = state
+                self._fleet[name]["health"] = health
+
+
+class RestTpuVmApi(TpuVmApi):
+    """Real Cloud TPU v2 REST client (VM metadata-server auth).
+
+    Constructed only for platform=tpu_vm with project/zone configured;
+    every call degrades to a logged failure rather than an exception so
+    the master survives API blips (the scaler retries).
+    """
+
+    _BASE = "https://tpu.googleapis.com/v2"
+    _TOKEN_URL = (
+        "http://metadata.google.internal/computeMetadata/v1/"
+        "instance/service-accounts/default/token"
+    )
+
+    def __init__(self, project: str, zone: str, timeout: float = 30.0):
+        self._parent = f"projects/{project}/locations/{zone}"
+        self._timeout = timeout
+
+    def _token(self) -> str:
+        req = urllib.request.Request(
+            self._TOKEN_URL, headers={"Metadata-Flavor": "Google"}
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())["access_token"]
+
+    def _call(self, method: str, path: str, body=None):
+        req = urllib.request.Request(
+            f"{self._BASE}/{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={
+                "Authorization": f"Bearer {self._token()}",
+                "Content-Type": "application/json",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def create_node(self, name, accelerator_type, runtime_version,
+                    labels, metadata, preemptible=False) -> bool:
+        body = {
+            "acceleratorType": accelerator_type,
+            "runtimeVersion": runtime_version,
+            "labels": labels,
+            "metadata": metadata,
+            "schedulingConfig": {"preemptible": preemptible},
+        }
+        try:
+            self._call(
+                "POST", f"{self._parent}/nodes?nodeId={name}", body
+            )
+            return True
+        except Exception as e:
+            logger.error("TPU VM create %s failed: %s", name, e)
+            return False
+
+    def delete_node(self, name) -> bool:
+        try:
+            self._call("DELETE", f"{self._parent}/nodes/{name}")
+            return True
+        except Exception as e:
+            logger.error("TPU VM delete %s failed: %s", name, e)
+            return False
+
+    def list_nodes(self) -> List[TpuVmRecord]:
+        try:
+            resp = self._call("GET", f"{self._parent}/nodes")
+        except Exception as e:
+            logger.error("TPU VM list failed: %s", e)
+            return []
+        out = []
+        for node in resp.get("nodes", []):
+            out.append(TpuVmRecord(
+                name=node["name"].rsplit("/", 1)[-1],
+                state=node.get("state", TpuVmState.UNKNOWN),
+                labels=node.get("labels", {}),
+                metadata=node.get("metadata", {}),
+                health=node.get("health", ""),
+                accelerator_type=node.get("acceleratorType", ""),
+            ))
+        return out

@@ -1,0 +1,159 @@
+"""TPU-VM scaler: ScalePlan -> fleet mutations.
+
+Parity reference: dlrover/python/master/scaler/pod_scaler.py:71
+(PodScaler.scale:127, _scale_up_pods:238, _scale_down_pods:270,
+_periodic_create_pod:316, _create_pod env contract :343). The TPU shape
+creates TPU VMs instead of pods; the agent env contract travels in VM
+metadata (startup scripts read it into the environment), and failed
+creations go to a retry queue drained by a background thread exactly like
+the reference's pod-creation queue.
+"""
+
+import itertools
+import queue
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv, NodeStatus, NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.scheduler.tpu_vm import TpuVmApi, TpuVmState
+
+
+def vm_name(job_name: str, node_type: str, node_id: int) -> str:
+    return f"{job_name}-{node_type}-{node_id}"
+
+
+class TpuVmScaler(Scaler):
+    """Applies ScalePlans to a TPU-VM fleet through a TpuVmApi."""
+
+    def __init__(self, job_name: str, api: TpuVmApi, master_addr: str,
+                 accelerator_type: str = "", runtime_version: str = "",
+                 preemptible: bool = False,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 retry_interval: float = 15.0):
+        super().__init__(job_name)
+        self._api = api
+        self._master_addr = master_addr
+        self._accelerator_type = accelerator_type
+        self._runtime_version = runtime_version
+        self._preemptible = preemptible
+        self._worker_env = dict(worker_env or {})
+        self._retry_interval = retry_interval
+        self._create_queue: "queue.Queue[Node]" = queue.Queue()
+        self._stopped = threading.Event()
+        self._retry_thread: Optional[threading.Thread] = None
+
+    # -- Scaler -----------------------------------------------------------
+
+    def start(self):
+        self._retry_thread = threading.Thread(
+            target=self._drain_retries, daemon=True, name="vm-create-retry"
+        )
+        self._retry_thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def scale(self, plan: ScalePlan):
+        """parity: pod_scaler.py:127 — explicit mutations first, then
+        reconcile group targets against the live fleet."""
+        for node in plan.launch_nodes:
+            self._launch(node)
+        for node in plan.remove_nodes:
+            self._remove(node)
+        for node_type, group in plan.node_group_resources.items():
+            self._reconcile(node_type, group.count)
+
+    # -- internals --------------------------------------------------------
+
+    def _env_metadata(self, node: Node) -> Dict[str, str]:
+        """The agent env contract (parity: _create_pod:343 injecting
+        NodeEnv into the pod spec). TPU VMs surface it via instance
+        metadata; the VM startup script exports it before exec'ing the
+        agent."""
+        md = {
+            NodeEnv.MASTER_ADDR: self._master_addr,
+            NodeEnv.JOB_NAME: self._job_name,
+            NodeEnv.NODE_TYPE: node.type,
+            NodeEnv.NODE_ID: str(node.id),
+            NodeEnv.NODE_RANK: str(node.rank_index),
+            NodeEnv.RESTART_COUNT: str(node.relaunch_count),
+        }
+        md.update(self._worker_env)
+        return md
+
+    def _launch(self, node: Node):
+        name = vm_name(self._job_name, node.type, node.id)
+        node.name = name
+        ok = self._api.create_node(
+            name,
+            accelerator_type=(
+                node.config_resource.tpu_type
+                if node.config_resource and node.config_resource.tpu_type
+                else self._accelerator_type
+            ),
+            runtime_version=self._runtime_version,
+            labels={
+                "dlrover-job": self._job_name,
+                "dlrover-type": node.type,
+                "dlrover-id": str(node.id),
+                "dlrover-rank": str(node.rank_index),
+            },
+            metadata=self._env_metadata(node),
+            preemptible=self._preemptible,
+        )
+        if not ok:
+            logger.warning("create %s failed; queued for retry", name)
+            self._create_queue.put(node)
+
+    def _remove(self, node: Node):
+        # Node auto-names itself "{type}-{id}" without the job prefix, so
+        # only trust names that follow the fleet convention
+        name = node.name
+        if not (name and name.startswith(self._job_name + "-")):
+            name = vm_name(self._job_name, node.type, node.id)
+        self._api.delete_node(name)
+
+    def _reconcile(self, node_type: str, target: int):
+        """Diff the live fleet (this job, this type, not dying) against
+        the target count (parity: _update_job_pods + scale_up/down)."""
+        mine = [
+            rec for rec in self._api.list_nodes()
+            if rec.get("labels", {}).get("dlrover-job") == self._job_name
+            and rec.get("labels", {}).get("dlrover-type") == node_type
+            and str(rec.get("labels", {}).get("dlrover-id", "")).isdigit()
+        ]
+        live = [
+            rec for rec in mine
+            if rec.state not in (
+                TpuVmState.DELETING, TpuVmState.TERMINATED,
+                TpuVmState.PREEMPTED,
+            )
+        ]
+        ids = sorted(int(r["labels"]["dlrover-id"]) for r in live)
+        if len(ids) < target:
+            # fresh ids start past EVERY record of ours — a dead VM's name
+            # lingers in the fleet until deletion completes
+            all_ids = [int(r["labels"]["dlrover-id"]) for r in mine]
+            next_id = itertools.count(max(all_ids) + 1 if all_ids else 0)
+            for _ in range(target - len(ids)):
+                nid = next(next_id)
+                self._launch(Node(node_type, nid,
+                                  status=NodeStatus.INITIAL))
+        elif len(ids) > target:
+            # newest first, mirroring scale_down_nodes
+            for nid in sorted(ids, reverse=True)[: len(ids) - target]:
+                self._remove(Node(node_type, nid))
+
+    def _drain_retries(self):
+        while not self._stopped.wait(self._retry_interval):
+            pending: List[Node] = []
+            while True:
+                try:
+                    pending.append(self._create_queue.get_nowait())
+                except queue.Empty:
+                    break
+            for node in pending:
+                self._launch(node)

@@ -1,0 +1,144 @@
+"""TPU-VM watcher: fleet state stream -> NodeEvents.
+
+Parity reference: dlrover/python/master/watcher/k8s_watcher.py:49
+(PodWatcher) and its exit-reason mapping (_get_pod_exit_reason:130,
+_convert_pod_event_to_node_event:139). The Cloud TPU API has no watch
+verb, so this polls list_nodes() and diffs against the previous snapshot
+— state transitions become MODIFIED events, disappearances DELETED — and
+maps VM states to the node status/exit-reason model:
+
+  CREATING/RESTARTING/REIMAGING -> PENDING
+  READY                         -> RUNNING
+  PREEMPTED                     -> FAILED, exit PREEMPTED (relaunch)
+  REPAIRING / unhealthy         -> FAILED, exit HARDWARE_ERROR
+                                   (relaunch on a fresh VM)
+  TERMINATED/STOPPED            -> FAILED, exit KILLED
+  DELETING / gone               -> DELETED
+"""
+
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.watcher.base_watcher import NodeEvent, NodeWatcher
+from dlrover_tpu.scheduler.tpu_vm import TpuVmApi, TpuVmRecord, TpuVmState
+
+_STATE_MAP = {
+    TpuVmState.CREATING: (NodeStatus.PENDING, ""),
+    TpuVmState.RESTARTING: (NodeStatus.PENDING, ""),
+    TpuVmState.REIMAGING: (NodeStatus.PENDING, ""),
+    TpuVmState.READY: (NodeStatus.RUNNING, ""),
+    TpuVmState.PREEMPTED: (NodeStatus.FAILED, NodeExitReason.PREEMPTED),
+    TpuVmState.REPAIRING: (
+        NodeStatus.FAILED, NodeExitReason.HARDWARE_ERROR),
+    TpuVmState.TERMINATED: (NodeStatus.FAILED, NodeExitReason.KILLED),
+    TpuVmState.STOPPED: (NodeStatus.FAILED, NodeExitReason.KILLED),
+    TpuVmState.DELETING: (NodeStatus.DELETED, ""),
+}
+
+
+def vm_to_node(rec: TpuVmRecord) -> Optional[Node]:
+    """parity: _convert_pod_event_to_node_event (k8s_watcher.py:139)."""
+    labels = rec.get("labels", {})
+    node_id = labels.get("dlrover-id")
+    if node_id is None or not str(node_id).isdigit():
+        return None  # not one of ours
+    status, exit_reason = _STATE_MAP.get(
+        rec.state, (NodeStatus.UNKNOWN, "")
+    )
+    if status == NodeStatus.RUNNING and rec.get("health") not in (
+        None, "", "HEALTHY", "HEALTH_UNSPECIFIED",
+    ):
+        # chips up but unhealthy (e.g. UNHEALTHY_TPU / UNHEALTHY_MAINTENANCE)
+        status, exit_reason = (
+            NodeStatus.FAILED, NodeExitReason.HARDWARE_ERROR,
+        )
+    node = Node(
+        labels.get("dlrover-type", NodeType.WORKER),
+        int(node_id),
+        name=rec.name,
+        status=status,
+        rank_index=int(labels.get("dlrover-rank", node_id)),
+        start_time=rec.get("create_time"),
+    )
+    if exit_reason:
+        node.set_exit_reason(exit_reason)
+    return node
+
+
+class TpuVmWatcher(NodeWatcher):
+    """Polling diff watcher over a TpuVmApi fleet."""
+
+    def __init__(self, job_name: str, api: TpuVmApi,
+                 poll_interval: float = 5.0):
+        self._job_name = job_name
+        self._api = api
+        self._poll_interval = poll_interval
+        self._stopped = threading.Event()
+        self._known: Dict[str, Tuple[str, str]] = {}  # name -> (status, reason)
+
+    def _snapshot(self) -> Dict[str, Node]:
+        nodes = {}
+        for rec in self._api.list_nodes():
+            if rec.get("labels", {}).get("dlrover-job") != self._job_name:
+                continue
+            node = vm_to_node(rec)
+            if node is not None:
+                nodes[rec.name] = node
+        return nodes
+
+    def watch(self) -> Iterator[NodeEvent]:
+        while not self._stopped.is_set():
+            try:
+                yield from self.poll_once()
+            except Exception as e:
+                logger.error("TPU VM watch poll failed: %s", e)
+            if self._stopped.wait(self._poll_interval):
+                return
+
+    def poll_once(self) -> List[NodeEvent]:
+        """One diff cycle (separated out so tests drive it directly)."""
+        events: List[NodeEvent] = []
+        current = self._snapshot()
+        for name, node in current.items():
+            key = (node.status, node.exit_reason or "")
+            if name not in self._known:
+                events.append(NodeEvent(NodeEventType.ADDED, node))
+            elif self._known[name] != key:
+                events.append(NodeEvent(NodeEventType.MODIFIED, node))
+            self._known[name] = key
+        for name in set(self._known) - set(current):
+            node_type, node_id = _parse_name(self._job_name, name)
+            if node_id is not None:
+                events.append(NodeEvent(
+                    NodeEventType.DELETED,
+                    Node(node_type, node_id, name=name,
+                         status=NodeStatus.DELETED),
+                ))
+            del self._known[name]
+        return events
+
+    def list(self) -> List[Node]:
+        return list(self._snapshot().values())
+
+    def stop(self):
+        self._stopped.set()
+
+
+def _parse_name(job_name: str, name: str):
+    """'{job}-{type}-{id}' -> (type, id)."""
+    prefix = job_name + "-"
+    if not name.startswith(prefix):
+        return NodeType.WORKER, None
+    rest = name[len(prefix):]
+    node_type, _, nid = rest.rpartition("-")
+    if not nid.isdigit():
+        return NodeType.WORKER, None
+    return node_type or NodeType.WORKER, int(nid)
