@@ -99,6 +99,19 @@ def main():
     peak = peak_flops_per_chip(dev)
     mfu = 100.0 * achieved / peak if on_tpu else 0.0
 
+    # XLA-counted program stats (trainer/profiler.py). NOTE: the
+    # backend's flop counter excludes custom-call (Pallas) kernels, so
+    # these are reported raw, not as an HFU claim.
+    from dlrover_tpu.trainer import profiler
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (params, opt_state, mb),
+    )
+    prof = profiler.profile_step(
+        trainer.train_step, *abstract, params=params
+    )
+
     result = {
         "metric": "mfu_percent",
         "value": round(mfu, 2),
@@ -112,6 +125,9 @@ def main():
         "device": getattr(dev, "device_kind", dev.platform),
         "platform": dev.platform,
         "final_loss": round(loss_val, 4),
+        "xla_counted_flops_per_step": prof.flops,
+        "hbm_gb_per_step": round(prof.hbm_bytes / 2**30, 2),
+        "param_count": prof.param_count,
     }
     print(json.dumps(result))
 

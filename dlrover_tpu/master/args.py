@@ -14,8 +14,10 @@ def parse_master_args(argv=None):
     parser.add_argument("--port", type=int, default=0,
                         help="gRPC port; 0 picks a free port")
     parser.add_argument("--job_name", type=str, default="local-job")
-    parser.add_argument("--platform", type=str, default="local",
-                        choices=["local", "process", "tpu_vm"])
+    parser.add_argument("--platform", type=str, default=None,
+                        choices=["local", "process", "tpu_vm"],
+                        help="default: the job spec's platform, else "
+                             "local")
     parser.add_argument("--host", type=str, default="",
                         help="externally-reachable master host baked into "
                              "worker VM metadata (default: this host's "

@@ -27,6 +27,11 @@ from dlrover_tpu.master.node.job_auto_scaler import new_job_auto_scaler
 from dlrover_tpu.master.resource.local_optimizer import TPULocalOptimizer
 from dlrover_tpu.master.servicer import create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.stats import (
+    JobMetricCollector,
+    JobMeta,
+    LocalStatsReporter,
+)
 
 
 class DistributedJobMaster:
@@ -40,9 +45,19 @@ class DistributedJobMaster:
                  watcher=None, autoscale_interval: float = 60.0):
         self.speed_monitor = SpeedMonitor()
         self.error_monitor = ErrorMonitor()
+        job_meta = JobMeta(
+            uuid=getattr(job_args, "job_name", "") or "job",
+            name=getattr(job_args, "job_name", "") or "job",
+            namespace=getattr(job_args, "namespace", "default"),
+        )
+        self.stats_reporter = LocalStatsReporter(job_meta)
+        self.job_metric_collector = JobMetricCollector(
+            job_meta, reporter=self.stats_reporter
+        )
         self.job_optimizer = TPULocalOptimizer(
             job_args=job_args, speed_monitor=self.speed_monitor,
             node_unit=getattr(job_args, "node_unit", 1) if job_args else 1,
+            stats_reporter=self.stats_reporter,
         )
         self.job_manager = create_job_manager(
             job_args, self.speed_monitor, scaler=scaler, watcher=watcher,
@@ -67,6 +82,7 @@ class DistributedJobMaster:
             rdzv_managers=self.rdzv_managers,
             sync_service=self.sync_service,
             error_monitor=self.error_monitor,
+            job_metric_collector=self.job_metric_collector,
         )
         self.port = self._server.port
         self._exit_code = 0
@@ -137,6 +153,9 @@ class DistributedJobMaster:
             logger.info("Master interrupted")
         finally:
             self.stop()
+        self.job_metric_collector.collect_job_exit_reason(
+            self._exit_reason
+        )
         logger.info(
             "Job exits: code=%d reason=%s", self._exit_code,
             self._exit_reason,

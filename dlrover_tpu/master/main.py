@@ -18,6 +18,7 @@ from dlrover_tpu.scheduler.job_spec import JobArgs
 
 def build_job_args(args) -> JobArgs:
     if getattr(args, "job_spec", ""):
+        # --platform (when given) overrides the spec's own platform
         job_args = JobArgs.from_file(args.job_spec,
                                      platform=args.platform)
         # CLI overrides for the handful of flags that also exist here
@@ -25,11 +26,13 @@ def build_job_args(args) -> JobArgs:
             job_args.node_num = args.node_num
         if args.heartbeat_timeout is not None:
             job_args.heartbeat_timeout = args.heartbeat_timeout
-        job_args.platform = args.platform
+        if args.namespace != "default":
+            job_args.namespace = args.namespace
         return job_args
     return JobArgs(
         job_name=args.job_name,
-        platform=args.platform,
+        platform=args.platform or "local",
+        namespace=args.namespace,
         node_num=args.node_num if args.node_num is not None else 1,
         distribution_strategy=args.distribution_strategy,
         heartbeat_timeout=args.heartbeat_timeout,
@@ -37,13 +40,13 @@ def build_job_args(args) -> JobArgs:
     )
 
 
-def _master_host(args) -> str:
+def _master_host(args, platform: str) -> str:
     """The address workers dial: must be reachable from worker VMs, so
     default to this host's primary outbound IP (localhost only works for
     same-host platforms)."""
     if args.host:
         return args.host
-    if args.platform in ("local", "process"):
+    if platform in ("local", "process"):
         return "localhost"
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
@@ -55,7 +58,7 @@ def _master_host(args) -> str:
 
 def run(args) -> int:
     job_args = build_job_args(args)
-    if args.platform == "local":
+    if job_args.platform == "local":
         from dlrover_tpu.master.local_master import LocalJobMaster
 
         master = LocalJobMaster(port=args.port, job_args=job_args)
@@ -70,7 +73,8 @@ def run(args) -> int:
         for attempt in range(3):
             port = args.port or find_free_port()
             scaler, watcher = build_platform(
-                job_args, f"{_master_host(args)}:{port}"
+                job_args,
+                f"{_master_host(args, job_args.platform)}:{port}",
             )
             try:
                 master = DistributedJobMaster(

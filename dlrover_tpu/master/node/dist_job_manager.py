@@ -47,6 +47,10 @@ class DistributedJobManager:
         hang_seconds: float = 1800.0,
     ):
         self._job_args = job_args
+        self._max_relaunch_count = getattr(
+            job_args, "max_relaunch_count", None)
+        self._relaunch_always = bool(getattr(
+            job_args, "relaunch_always", False))
         self._speed_monitor = speed_monitor
         self._scaler = scaler
         self._watcher = watcher
@@ -75,7 +79,10 @@ class DistributedJobManager:
             self._scaler.start()
         if node_num and self._scaler:
             mgr = self._node_managers[NodeType.WORKER]
-            new_nodes = mgr.scale_up_nodes(node_num, resource)
+            new_nodes = mgr.scale_up_nodes(
+                node_num, resource,
+                max_relaunch_count=self._max_relaunch_count,
+            )
             self._scaler.scale(ScalePlan(launch_nodes=new_nodes))
         if self._watcher is not None:
             t = threading.Thread(
@@ -95,6 +102,8 @@ class DistributedJobManager:
         self._stopped.set()
         if self._watcher is not None:
             self._watcher.stop()
+        if self._scaler is not None:
+            self._scaler.stop()
 
     def add_callback(self, kind: str, fn: Callable):
         self._callbacks.setdefault(kind, []).append(fn)
@@ -167,16 +176,20 @@ class DistributedJobManager:
     # -- relaunch policy --------------------------------------------------
 
     def _should_relaunch(self, node: Node) -> bool:
-        """parity: dist_job_manager.py:468."""
+        """parity: dist_job_manager.py:468 (+ relaunch_always: the spec's
+        relaunchStrategy=always keeps relaunching through normally-fatal
+        exit reasons, bounded only by the relaunch budget)."""
         if node.is_released or not node.relaunchable:
-            return False
-        if node.exit_reason == NodeExitReason.FATAL_ERROR:
             return False
         if node.relaunch_count >= node.max_relaunch_count:
             logger.warning(
                 "%s exhausted %d relaunches", node.name,
                 node.max_relaunch_count,
             )
+            return False
+        if self._relaunch_always:
+            return True
+        if node.exit_reason == NodeExitReason.FATAL_ERROR:
             return False
         if node.is_unrecoverable_failure():
             return False

@@ -57,7 +57,10 @@ class AllreduceTrainingAutoScaler:
                 logger.error("auto-scale iteration failed: %s", e)
 
     def execute_job_optimization_plan(self, plan: ResourcePlan):
-        """Diff the plan against current bookkeeping and scale."""
+        """Diff the plan against current bookkeeping and scale. A plan
+        carrying ``remove_ranks`` (straggler shrink) removes exactly
+        those nodes before the generic count reconcile, so the newest-id
+        shrink never evicts healthy workers in a straggler's place."""
         scale_plan = ScalePlan()
         for node_type, group in plan.node_group_resources.items():
             if node_type != NodeType.WORKER:
@@ -65,6 +68,15 @@ class AllreduceTrainingAutoScaler:
             mgr = self._job_manager._node_managers.get(node_type)
             if mgr is None:
                 continue
+            if plan.remove_ranks:
+                targeted = [
+                    n for n in mgr.unfinished_nodes()
+                    if n.rank_index in plan.remove_ranks
+                ]
+                for node in targeted:
+                    node.is_released = True
+                    node.relaunchable = False
+                scale_plan.remove_nodes.extend(targeted)
             have = len(mgr.unfinished_nodes())
             want = group.count
             if want > have:

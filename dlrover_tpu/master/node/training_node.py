@@ -80,16 +80,21 @@ class TrainingNodeManager:
         node's status (parity: reference training_node.py:234-241)."""
         return not self.unfinished_nodes() and bool(self._nodes)
 
-    def scale_up_nodes(self, num: int, resource) -> List[Node]:
+    def scale_up_nodes(self, num: int, resource,
+                       max_relaunch_count: Optional[int] = None
+                       ) -> List[Node]:
         """Create bookkeeping entries for num new nodes; the scaler turns
         them into processes/VMs (parity: training_node.py:186)."""
         new_nodes = []
         with self._lock:
             for _ in range(num):
                 nid = self.next_node_id()
+                kwargs = {}
+                if max_relaunch_count is not None:
+                    kwargs["max_relaunch_count"] = max_relaunch_count
                 node = Node(
                     self._node_type, nid, config_resource=resource,
-                    status=NodeStatus.INITIAL,
+                    status=NodeStatus.INITIAL, **kwargs,
                 )
                 self._nodes[nid] = node
                 new_nodes.append(node)

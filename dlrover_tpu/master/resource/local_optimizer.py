@@ -1,21 +1,25 @@
 """Local heuristic resource optimizer for TPU jobs.
 
 Parity reference: dlrover/python/master/resource/local_optimizer.py:66
-(PSLocalOptimizer: stats-window heuristics) and resource/job.py:511
+(PSLocalOptimizer: stats-window heuristics, OptimizerParams
+min_worker_speed_ratio) and resource/job.py:511
 (AllreduceJobResourceOptimizer), adjust_oom_resource resource/job.py:301.
 
 TPU shape: the tunable resource is the WORKER (TPU host) count and host
-RAM. Heuristics:
- - throughput-based worker count: if the job runs below the target node
-   count and the speed samples show linear scaling headroom, ask the
-   platform to restore/grow capacity in node_unit multiples;
+RAM, and the decision input is the stats pipeline's RuntimeMetric speed
+window (master/stats). Heuristics:
+ - worker count: when running below the target, grow back in node_unit
+   multiples — UNLESS the speed window proves a throughput plateau
+   (samples at the higher count showed each extra worker keeping less
+   than ``MIN_WORKER_SPEED_RATIO`` of the per-worker throughput, i.e.
+   growing buys nothing but churn);
+ - straggler shrink: drop network-check-identified stragglers when the
+   remaining world still satisfies min_nodes and node_unit alignment;
  - OOM: grow host memory 1.5x up to a cap (the reference's
-   oom_memory_up_rate);
- - straggler-aware shrink is delegated to the network-check straggler
-   list (rdzv_manager.get_straggler_nodes).
+   oom_memory_up_rate).
 """
 
-from typing import Optional
+from typing import Dict, List
 
 from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import default_logger as logger
@@ -27,14 +31,21 @@ from dlrover_tpu.master.resource.optimizer import (
 
 OOM_MEMORY_UP_RATE = 1.5
 MAX_HOST_MEMORY_MB = 512 * 1024
+#: each extra worker must retain at least this fraction of per-worker
+#: throughput for growth to be worthwhile (parity: OptimizerParams
+#: min_worker_speed_ratio, local_optimizer.py:54)
+MIN_WORKER_SPEED_RATIO = 0.5
+#: samples needed at a worker count before trusting its speed estimate
+MIN_SPEED_SAMPLES = 2
 
 
 class TPULocalOptimizer(ResourceOptimizer):
     def __init__(self, job_args=None, speed_monitor=None,
-                 node_unit: int = 1):
+                 node_unit: int = 1, stats_reporter=None):
         self._job_args = job_args
         self._speed_monitor = speed_monitor
         self._node_unit = max(1, node_unit)
+        self._stats_reporter = stats_reporter
 
     def init_job_resource(self, job_resource=None) -> ResourcePlan:
         plan = ResourcePlan(comment="initial")
@@ -46,24 +57,94 @@ class TPULocalOptimizer(ResourceOptimizer):
             )
         return plan
 
+    # -- speed-window scaling --------------------------------------------
+
+    def _speed_per_worker(self) -> Dict[int, float]:
+        """worker_num -> mean steps/sec/worker from the runtime window."""
+        if self._stats_reporter is None:
+            return {}
+        samples = self._stats_reporter.speed_samples_by_worker_num()
+        return {
+            n: (sum(v) / len(v)) / n
+            for n, v in samples.items()
+            if len(v) >= MIN_SPEED_SAMPLES
+        }
+
+    def _growth_plateaued(self, current: int, proposed: int) -> bool:
+        """True when the speed window shows that running at (or beyond)
+        ``proposed`` workers kept less than MIN_WORKER_SPEED_RATIO of the
+        per-worker throughput measured at the CURRENT size — the extra
+        workers were not pulling their weight, so re-growing is churn
+        without speedup. Comparison uses the sample counts nearest to
+        current/proposed (a stale tiny-world startup sample must not veto
+        a healthy restore)."""
+        spw = self._speed_per_worker()
+        low_ns = [n for n in spw if n <= current]
+        high_ns = [n for n in spw if n >= proposed]
+        if not low_ns or not high_ns:
+            return False  # no evidence: default to restoring capacity
+        low = spw[max(low_ns)]  # closest to the current world size
+        high = spw[min(high_ns)]  # closest to the proposed size
+        return high < MIN_WORKER_SPEED_RATIO * low
+
     def generate_job_resource_plan(self) -> ResourcePlan:
         plan = ResourcePlan()
         if self._speed_monitor is None:
             return plan
         target = self._speed_monitor._target_worker_num
         running = len(self._speed_monitor.running_workers)
-        if target and running < target:
-            # restore to the node_unit-aligned target (a partial slice
-            # cannot run; never over-provision past the rounded target)
-            unit = self._node_unit
-            total = ((target + unit - 1) // unit) * unit
-            plan.node_group_resources[NodeType.WORKER] = (
-                NodeGroupResource(total, NodeResource())
+        if not target or running >= target:
+            return plan
+        # restore to the node_unit-aligned target (a partial slice
+        # cannot run; never over-provision past the rounded target)
+        unit = self._node_unit
+        total = ((target + unit - 1) // unit) * unit
+        if self._growth_plateaued(running, total):
+            logger.info(
+                "Not growing %d -> %d workers: speed window shows a "
+                "throughput plateau", running, total,
             )
-            plan.comment = (
-                f"restore to {total} workers ({running}/{target} running)"
+            return plan
+        plan.node_group_resources[NodeType.WORKER] = (
+            NodeGroupResource(total, NodeResource())
+        )
+        plan.comment = (
+            f"restore to {total} workers ({running}/{target} running)"
+        )
+        logger.info("Resource plan: %s", plan.comment)
+        return plan
+
+    def generate_straggler_shrink_plan(
+        self, straggler_ranks: List[int], running_num: int,
+        min_nodes: int = 0,
+    ) -> ResourcePlan:
+        """Shrink the world past stragglers when the remainder still
+        forms a valid node_unit-aligned world (parity role: the
+        reference's straggler handling off the network-check list,
+        rdzv_manager.py:368)."""
+        plan = ResourcePlan()
+        if not straggler_ranks:
+            return plan
+        if not min_nodes:
+            min_nodes = getattr(self._job_args, "min_node_num", 1) or 1
+        remaining = running_num - len(straggler_ranks)
+        unit = self._node_unit
+        aligned = (remaining // unit) * unit
+        if aligned < max(min_nodes, 1) or aligned == 0:
+            logger.info(
+                "Keeping %d stragglers: shrinking to %d breaks "
+                "min_nodes=%d/node_unit=%d", len(straggler_ranks),
+                aligned, min_nodes, unit,
             )
-            logger.info("Resource plan: %s", plan.comment)
+            return plan
+        plan.node_group_resources[NodeType.WORKER] = (
+            NodeGroupResource(aligned, NodeResource())
+        )
+        plan.remove_ranks = list(straggler_ranks)
+        plan.comment = (
+            f"shrink past stragglers {straggler_ranks} -> {aligned}"
+        )
+        logger.info("Resource plan: %s", plan.comment)
         return plan
 
     def adjust_oom_resource(self, node) -> None:

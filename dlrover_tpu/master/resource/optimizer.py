@@ -6,7 +6,7 @@ Parity reference: dlrover/python/master/resource/optimizer.py:48
 
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
-from typing import Dict
+from typing import Dict, List
 
 from dlrover_tpu.common.node import NodeGroupResource
 
@@ -19,6 +19,8 @@ class ResourcePlan:
         default_factory=dict
     )
     comment: str = ""
+    #: specific node ranks a shrink plan wants removed (stragglers)
+    remove_ranks: List[int] = field(default_factory=list)
 
     def empty(self) -> bool:
         return not self.node_group_resources

@@ -1,0 +1,27 @@
+"""Stats pipeline: collector -> reporters -> metric records (M13).
+
+Parity reference: dlrover/python/master/stats/ (job_collector.py,
+reporter.py, training_metrics.py).
+"""
+
+from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+from dlrover_tpu.master.stats.reporter import (
+    JobMeta,
+    LocalStatsReporter,
+    StatsReporter,
+)
+from dlrover_tpu.master.stats.training_metrics import (
+    CustomMetricKey,
+    DatasetMetric,
+    ModelMetric,
+    OpStats,
+    RuntimeMetric,
+    TensorStats,
+    TrainingHyperParams,
+)
+
+__all__ = [
+    "JobMetricCollector", "JobMeta", "LocalStatsReporter",
+    "StatsReporter", "CustomMetricKey", "DatasetMetric", "ModelMetric",
+    "OpStats", "RuntimeMetric", "TensorStats", "TrainingHyperParams",
+]

@@ -87,15 +87,18 @@ class JobArgs:
         return NodeGroupResource(self.node_num, self.node_resource)
 
     @classmethod
-    def from_dict(cls, doc: Dict, platform: str = "tpu_vm") -> "JobArgs":
-        """Build JobArgs from a parsed ElasticTpuJob document."""
+    def from_dict(cls, doc: Dict,
+                  platform: Optional[str] = None) -> "JobArgs":
+        """Build JobArgs from a parsed ElasticTpuJob document. The spec
+        may declare its own ``spec.platform``; an explicit ``platform``
+        argument (CLI flag) overrides it."""
         spec = doc.get("spec", doc)
         meta = doc.get("metadata", {})
         worker = spec.get("worker", {})
         res = worker.get("resource", {})
         args = cls(
             job_name=meta.get("name", spec.get("jobName", "job")),
-            platform=platform,
+            platform=platform or spec.get("platform", "tpu_vm"),
             namespace=meta.get("namespace", "default"),
             project=spec.get("project", ""),
             zone=spec.get("zone", ""),
@@ -123,7 +126,8 @@ class JobArgs:
         return args
 
     @classmethod
-    def from_file(cls, path: str, platform: str = "tpu_vm") -> "JobArgs":
+    def from_file(cls, path: str,
+                  platform: Optional[str] = None) -> "JobArgs":
         with open(path) as f:
             text = f.read()
         try:

@@ -21,6 +21,9 @@ from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
 from dlrover_tpu.scheduler.tpu_vm import TpuVmApi, TpuVmState
 
 
+MAX_CREATE_ATTEMPTS = 5
+
+
 def vm_name(job_name: str, node_type: str, node_id: int) -> str:
     return f"{job_name}-{node_type}-{node_id}"
 
@@ -105,8 +108,16 @@ class TpuVmScaler(Scaler):
             preemptible=self._preemptible,
         )
         if not ok:
-            logger.warning("create %s failed; queued for retry", name)
-            self._create_queue.put(node)
+            attempts = getattr(node, "_create_attempts", 0) + 1
+            node._create_attempts = attempts
+            if attempts > MAX_CREATE_ATTEMPTS:
+                logger.error(
+                    "giving up creating %s after %d attempts", name,
+                    attempts,
+                )
+            else:
+                logger.warning("create %s failed; queued for retry", name)
+                self._create_queue.put(node)
 
     def _remove(self, node: Node):
         # Node auto-names itself "{type}-{id}" without the job prefix, so
@@ -156,4 +167,12 @@ class TpuVmScaler(Scaler):
                 except queue.Empty:
                     break
             for node in pending:
+                name = node.name or vm_name(
+                    self._job_name, node.type, node.id
+                )
+                if self._api.get_node(name) is not None:
+                    # the earlier create actually landed (e.g. a
+                    # client-side timeout on a successful call)
+                    logger.info("%s exists; dropping retry", name)
+                    continue
                 self._launch(node)

@@ -131,6 +131,39 @@ class ElasticTrainer:
             batch,
         )
 
+    def report_model_profile(self, params, batch,
+                             batch_size: int = 0, seq_len: int = 0):
+        """Profile the current train step's compiled program and send
+        it to the master's stats pipeline (trainer/profiler.py). Call
+        once after the first step; failures never interrupt training."""
+        if self._master_client is None:
+            return None
+        from dlrover_tpu.trainer import profiler
+
+        try:
+            # abstract lowering: shapes only, nothing materialized
+            abs_params = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+            )
+            abs_opt = jax.eval_shape(self._optimizer.init, abs_params)
+            abs_batch = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    getattr(x, "shape", ()), getattr(x, "dtype", None)
+                ), batch,
+            )
+            prof = profiler.profile_step(
+                self.train_step, abs_params, abs_opt, abs_batch,
+                params=params,
+            )
+        except Exception as e:
+            logger.warning("model profiling failed: %s", e)
+            return None
+        profiler.report_profile(
+            self._master_client, prof, batch_size=batch_size,
+            seq_len=seq_len,
+        )
+        return prof
+
     def report_step(self, step: Optional[int] = None):
         self._global_step = step if step is not None else (
             self._global_step + 1

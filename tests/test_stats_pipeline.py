@@ -1,0 +1,195 @@
+"""Stats pipeline (M13) + resource optimizer decisions driven by it.
+
+Parity: the reference's stats tests (test_job_collector/test_reporter)
+and resource tests (test_local_optimizer: throughput plateau -> no grow,
+headroom -> grow in node_unit multiples).
+"""
+
+import time
+import types
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.resource.local_optimizer import TPULocalOptimizer
+from dlrover_tpu.master.stats import (
+    JobMetricCollector,
+    JobMeta,
+    LocalStatsReporter,
+    RuntimeMetric,
+)
+
+
+def _collector():
+    reporter = LocalStatsReporter(JobMeta(uuid="t", name="t"))
+    return JobMetricCollector(JobMeta(uuid="t"), reporter), reporter
+
+
+# ------------------------------------------------------------- collector
+
+def test_runtime_stats_sampled_on_step_advance():
+    collector, reporter = _collector()
+    sm = SpeedMonitor()
+    sm.add_running_worker(NodeType.WORKER, 0)
+    sm.add_running_worker(NodeType.WORKER, 1)
+    nodes = [Node(NodeType.WORKER, i, status="running") for i in (0, 1)]
+
+    t = time.time()
+    sm.collect_global_step(10, t)
+    sm.collect_global_step(20, t + 5)  # speed = 2 steps/s
+    collector.collect_runtime_stats(sm, nodes)
+    assert len(reporter.runtime_stats) == 1
+    rec = reporter.runtime_stats[0]
+    assert rec.global_step == 20
+    assert rec.worker_num == 2
+    assert abs(rec.speed - 2.0) < 1e-6
+    assert len(rec.running_nodes) == 2
+
+    # same step again: no duplicate sample
+    collector.collect_runtime_stats(sm, nodes)
+    assert len(reporter.runtime_stats) == 1
+    # step advances: new sample
+    sm.collect_global_step(30, t + 10)
+    collector.collect_runtime_stats(sm, nodes)
+    assert len(reporter.runtime_stats) == 2
+
+
+def test_model_and_dataset_metrics_stored():
+    collector, reporter = _collector()
+    info = types.SimpleNamespace(
+        param_count=1_100_000_000, flops_per_step=6.0e13,
+        batch_size=4, seq_len=2048,
+        extra={"hbm_bytes": 2.5e11, "peak_memory_bytes": 1.2e10,
+               "variable_count": 150},
+    )
+    collector.collect_model_metric(info)
+    mm = reporter.model_metric
+    assert mm.tensor_stats.total_variable_size == 1_100_000_000
+    assert mm.tensor_stats.variable_count == 150
+    assert mm.op_stats.flops == 6.0e13
+    assert mm.op_stats.hbm_bytes == 2.5e11
+    assert mm.batch_size == 4 and mm.seq_len == 2048
+
+    collector.collect_dataset_metric("corpus", 1_000_000)
+    assert reporter.dataset_metric.name == "corpus"
+    assert reporter.dataset_metric.size == 1_000_000
+
+    collector.collect_training_hyper_params(epoch=3, batch_size=32)
+    assert reporter.hyper_params.batch_size == 32
+
+
+def test_runtime_stats_flow_over_grpc():
+    """report_global_step RPC -> speed monitor + collector -> reporter."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.dist_master import DistributedJobMaster
+
+    job_args = types.SimpleNamespace(
+        job_name="statjob", node_num=1, node_unit=1,
+        distribution_strategy="allreduce",
+    )
+    master = DistributedJobMaster(port=0, job_args=job_args)
+    master._server.start()
+    try:
+        client = MasterClient(master.addr, node_id=0,
+                              node_type=NodeType.WORKER)
+        client.update_node_status("running")
+        t = time.time()
+        client.report_global_step(50, t)
+        client.report_global_step(100, t + 10)
+        client.report_model_info(
+            param_count=123, flops_per_step=4.5e9, batch_size=8,
+            seq_len=128, extra={"hbm_bytes": 1e9},
+        )
+        deadline = time.time() + 5
+        while (not master.stats_reporter.runtime_stats
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert master.stats_reporter.runtime_stats
+        rec = master.stats_reporter.runtime_stats[-1]
+        assert rec.global_step == 100
+        assert rec.speed > 0
+        assert master.stats_reporter.model_metric.op_stats.flops == 4.5e9
+        client.close()
+    finally:
+        master._server.stop(grace=0.5)
+
+
+# ------------------------------------------------------------- optimizer
+
+def _optimizer_with_samples(samples, node_unit=1, target=4, running=2):
+    reporter = LocalStatsReporter(JobMeta(uuid="o"))
+    for worker_num, speed in samples:
+        reporter.report_runtime_stats(RuntimeMetric(
+            worker_num=worker_num, speed=speed, global_step=1,
+            timestamp=time.time(),
+        ))
+    sm = SpeedMonitor()
+    sm.set_target_worker_num(target)
+    for i in range(running):
+        sm.add_running_worker(NodeType.WORKER, i)
+    return TPULocalOptimizer(
+        speed_monitor=sm, node_unit=node_unit, stats_reporter=reporter,
+    )
+
+
+def test_linear_headroom_grows_in_node_unit_multiples():
+    """Per-worker throughput held up at 4 workers -> grow back, rounded
+    to node_unit."""
+    opt = _optimizer_with_samples(
+        [(2, 10.0), (2, 10.0), (4, 19.0), (4, 19.0)],  # ~linear scaling
+        node_unit=3, target=4, running=2,
+    )
+    plan = opt.generate_job_resource_plan()
+    assert not plan.empty()
+    assert plan.node_group_resources[NodeType.WORKER].count == 6  # 4->6
+
+
+def test_throughput_plateau_blocks_growth():
+    """4 workers were barely faster than 2 -> growing again is churn."""
+    opt = _optimizer_with_samples(
+        [(2, 10.0), (2, 10.0), (4, 9.0), (4, 9.0)],  # spw 5.0 -> 2.25
+        target=4, running=2,
+    )
+    plan = opt.generate_job_resource_plan()
+    assert plan.empty()
+
+
+def test_no_samples_defaults_to_restoring_capacity():
+    opt = _optimizer_with_samples([], target=4, running=2)
+    plan = opt.generate_job_resource_plan()
+    assert plan.node_group_resources[NodeType.WORKER].count == 4
+
+
+def test_at_target_no_plan():
+    opt = _optimizer_with_samples([], target=2, running=2)
+    assert opt.generate_job_resource_plan().empty()
+
+
+def test_straggler_shrink_respects_alignment():
+    opt = _optimizer_with_samples([], node_unit=2, target=4, running=4)
+    plan = opt.generate_straggler_shrink_plan(
+        [3], running_num=4, min_nodes=1
+    )
+    # 4 - 1 = 3 -> aligned down to 2
+    assert plan.node_group_resources[NodeType.WORKER].count == 2
+    assert plan.remove_ranks == [3]
+
+    # shrinking below min_nodes is refused
+    plan = opt.generate_straggler_shrink_plan(
+        [1, 2, 3], running_num=4, min_nodes=2
+    )
+    assert plan.empty()
+
+
+def test_stale_small_world_sample_does_not_veto_restore():
+    """A startup sample at n=1 with high per-worker speed must not block
+    restoring 8 -> 16 when the n=16 samples held up vs n=8."""
+    opt = _optimizer_with_samples(
+        [(1, 1.0), (1, 1.0),          # startup: 1.0 spw
+         (8, 4.8), (8, 4.8),          # 0.6 spw at current
+         (16, 7.2), (16, 7.2)],       # 0.45 spw at proposed (> 0.5*0.6)
+        target=16, running=8,
+    )
+    plan = opt.generate_job_resource_plan()
+    assert not plan.empty()
+    assert plan.node_group_resources[NodeType.WORKER].count == 16

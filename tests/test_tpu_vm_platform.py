@@ -276,3 +276,62 @@ def test_master_build_job_args_from_spec(tmp_path):
         "--node_num", "6",
     ])
     assert build_job_args(args).node_num == 6
+
+
+def test_spec_platform_used_unless_cli_overrides(tmp_path):
+    spec = tmp_path / "j.json"
+    spec.write_text('{"metadata": {"name": "x"}, '
+                    '"spec": {"platform": "process", "worker": {}}}')
+    assert JobArgs.from_file(str(spec)).platform == "process"
+    assert JobArgs.from_file(str(spec), platform="tpu_vm").platform == \
+        "tpu_vm"
+
+
+def test_autoscaler_straggler_plan_removes_targeted_ranks():
+    """A remove_ranks plan must evict exactly the straggler nodes, not
+    the newest ids (which the generic shrink would pick)."""
+    import types
+
+    from dlrover_tpu.master.node.job_auto_scaler import (
+        AllreduceTrainingAutoScaler,
+    )
+    from dlrover_tpu.master.resource.optimizer import ResourcePlan
+
+    api = FakeTpuVmApi(auto_ready=True)
+    scaler = _scaler(api)
+    mgr = DistributedJobManager(
+        job_args=types.SimpleNamespace(node_num=4, node_resource=None),
+        scaler=scaler,
+    )
+    mgr.start()
+    try:
+        auto = AllreduceTrainingAutoScaler(mgr, None, scaler)
+        plan = ResourcePlan()
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            2, NodeResource()
+        )
+        plan.remove_ranks = [0, 2]
+        auto.execute_job_optimization_plan(plan)
+        # stragglers 0 and 2 were deleted; 1 and 3 survive
+        names = {r.name for r in api.list_nodes()
+                 if r.state not in ("DELETING",)}
+        assert names == {"job1-worker-1", "job1-worker-3"}
+    finally:
+        mgr.stop()
+
+
+def test_relaunch_always_overrides_fatal_exit(tmp_path):
+    import types
+
+    from dlrover_tpu.common.constants import NodeExitReason
+
+    mgr = DistributedJobManager(
+        job_args=types.SimpleNamespace(relaunch_always=True),
+    )
+    node = Node(NodeType.WORKER, 0)
+    node.set_exit_reason(NodeExitReason.FATAL_ERROR)
+    assert mgr._should_relaunch(node) is True
+    mgr2 = DistributedJobManager(job_args=types.SimpleNamespace())
+    node2 = Node(NodeType.WORKER, 0)
+    node2.set_exit_reason(NodeExitReason.FATAL_ERROR)
+    assert mgr2._should_relaunch(node2) is False
