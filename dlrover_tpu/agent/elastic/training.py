@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.constants import (
+    NodeAction,
     NodeEnv,
     NodeStatus,
     RendezvousConstant,
@@ -164,18 +165,33 @@ class ElasticTrainingAgent:
         self._stopped = False
         self._remaining_restarts = config.max_restarts
         self._heartbeat_thread: Optional[threading.Thread] = None
+        self._restart_requested = threading.Event()
 
     def _start_heartbeat(self, interval: float = 15.0):
-        """Feed the master's liveness watchdog (parity: the reference
-        agent's report_heartbeat loop; the master's heartbeat monitor
-        only arms for nodes that report)."""
+        """Feed the master's liveness watchdog and act on the directive
+        piggybacked on the response (parity: the reference agent's
+        report_heartbeat loop + DiagnosisAction handling). A ``restart``
+        action recycles the training process on the monitor loop without
+        charging the restart budget — the node stays RUNNING and this
+        thread keeps heartbeating throughout."""
 
         def loop():
             failures = 0
             while not self._stopped:
                 try:
-                    self._client.report_heartbeat()
+                    action = self._client.report_heartbeat()
                     failures = 0
+                    if action == NodeAction.RESTART_WORKER:
+                        logger.info(
+                            "Master heartbeat action: restart workers"
+                        )
+                        self._restart_requested.set()
+                    elif action == NodeAction.STOP:
+                        logger.info("Master heartbeat action: stop")
+                        # full stop: end the monitor loop AND kill the
+                        # training process (an orphaned trainer would
+                        # keep the TPU busy after the node "succeeded")
+                        self.stop()
                 except Exception as e:
                     failures += 1
                     if failures <= 2:  # quiet after the master goes away
@@ -215,6 +231,10 @@ class ElasticTrainingAgent:
         self._initialize_workers()
         while not self._stopped:
             time.sleep(self._config.monitor_interval)
+            if self._stopped:
+                # stop() raced in during the sleep (heartbeat STOP
+                # action): the worker it killed must NOT be relaunched
+                break
             result = self._monitor_workers()
             if result.state == WorkerState.SUCCEEDED:
                 logger.info("Training process succeeded")
@@ -230,6 +250,12 @@ class ElasticTrainingAgent:
                     self._restart_workers()
                 else:
                     return result
+            elif self._restart_requested.is_set():
+                self._restart_requested.clear()
+                logger.info(
+                    "Restarting workers on master action (hang recovery)"
+                )
+                self._restart_workers()
             elif self._membership_changed():
                 logger.info(
                     "Membership changed; re-rendezvous without job restart"

@@ -103,8 +103,18 @@ class TrainingExceptionLevel:
     RDZV_ERROR = "rdzv_error"
     PROCESS_ERROR = "process_error"
     NODE_ERROR = "node_error"
+    HANG = "hang"
     WARNING = "warning"
     INFO = "info"
+
+
+class NodeAction:
+    """Master -> agent directives carried on the heartbeat response
+    (parity: the reference's DiagnosisAction piggybacked on heartbeats,
+    dlrover/python/elastic_agent/master_client.py report_heart_beat)."""
+
+    RESTART_WORKER = "restart"
+    STOP = "stop"
 
 
 class NodeEnv:

@@ -1,0 +1,4 @@
+from dlrover_tpu.fault_tolerance.hanging_detector import HangingDetector
+from dlrover_tpu.fault_tolerance.injection import FaultInjector
+
+__all__ = ["HangingDetector", "FaultInjector"]

@@ -1,0 +1,176 @@
+"""First-class fault injection for failover drills and tests.
+
+Parity reference: the reference's chaos hooks are scattered (test-only
+kill paths, node-check scripts, `straggler` env toggles in
+dlrover/python/elastic_agent/diagnosis); SURVEY §5.3 calls for one
+explicit injection surface instead. This module is it: every e2e drill
+(crash-resume, hang-restart, preemption) speaks this one grammar rather
+than growing ad-hoc ``--crash-at-step``-style flags per workload.
+
+Two triggers:
+
+* env ``DLROVER_FAULT_INJECT`` — comma-separated ``kind@step[:arg]``:
+
+  - ``crash@15`` / ``crash@15:3``   os._exit at step 15 (default rc 17)
+  - ``hang@8`` / ``hang@8:120``     stop stepping after step 8 (sleep
+                                    forever / for 120 s)
+  - ``oom@5``                       raise MemoryError at step 5
+  - ``error@5:msg``                 raise RuntimeError(msg) at step 5
+  - ``preempt@5``                   SIGTERM own process group (spot-VM
+                                    reclaim shape: agent sees a signal
+                                    death, not a Python traceback)
+
+  Env injections fire only on the *first* incarnation (restart count 0
+  from ``NodeEnv.RESTART_COUNT``), so a drill hits once and the relaunch
+  runs clean — append ``!`` (``crash@15!``) to fire on every incarnation.
+
+* master KV store key ``fault_inject/<node_rank>`` — polled every
+  ``poll_every`` steps, so a live job can be injected over RPC
+  (``master_client.kv_store_set``) with the same grammar; ``now`` is
+  accepted as the step (``hang@now:30``). The key is consumed (reset)
+  when read, so one RPC injects exactly one fault.
+"""
+
+import os
+import signal
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+ENV_SPEC = "DLROVER_FAULT_INJECT"
+KV_PREFIX = "fault_inject"
+
+KINDS = ("crash", "hang", "oom", "error", "preempt")
+
+
+@dataclass
+class Fault:
+    kind: str
+    step: int  # -1 == "now"
+    arg: str = ""
+    every_incarnation: bool = False
+    fired: bool = False
+
+    def due(self, step: int) -> bool:
+        return not self.fired and (self.step < 0 or step >= self.step)
+
+
+def parse_spec(spec: str) -> List[Fault]:
+    faults = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        every = part.endswith("!")
+        if every:
+            part = part[:-1]
+        if "@" not in part:
+            raise ValueError(f"fault spec {part!r}: expected kind@step")
+        kind, rest = part.split("@", 1)
+        if kind not in KINDS:
+            raise ValueError(
+                f"fault kind {kind!r} not one of {KINDS}"
+            )
+        step_s, _, arg = rest.partition(":")
+        step = -1 if step_s == "now" else int(step_s)
+        faults.append(Fault(kind, step, arg, every_incarnation=every))
+    return faults
+
+
+class FaultInjector:
+    """Injects faults into a training loop at step boundaries."""
+
+    def __init__(
+        self,
+        spec: str = "",
+        master_client=None,
+        node_rank: int = 0,
+        restart_count: int = 0,
+        poll_every: int = 10,
+    ):
+        self._faults = parse_spec(spec) if spec else []
+        # first-incarnation gating for env faults
+        if restart_count > 0:
+            self._faults = [
+                f for f in self._faults if f.every_incarnation
+            ]
+        self._client = master_client
+        self._node_rank = node_rank
+        self._poll_every = max(1, poll_every)
+        self._step_seen = 0
+
+    @classmethod
+    def from_env(cls, master_client=None) -> Optional["FaultInjector"]:
+        """Build from the process env; None when nothing is configured
+        and there is no master to poll."""
+        spec = os.environ.get(ENV_SPEC, "")
+        if not spec and master_client is None:
+            return None
+        return cls(
+            spec,
+            master_client=master_client,
+            node_rank=int(os.environ.get(NodeEnv.NODE_RANK, "0")),
+            restart_count=int(
+                os.environ.get(NodeEnv.RESTART_COUNT, "0")
+            ),
+        )
+
+    # -- trigger -----------------------------------------------------------
+
+    def maybe_inject(self, step: int) -> None:
+        """Call once per completed step; executes any due fault."""
+        self._step_seen = step
+        if self._client is not None and step % self._poll_every == 0:
+            self._poll_remote()
+        for fault in self._faults:
+            if fault.due(step):
+                fault.fired = True
+                self._execute(fault, step)
+
+    def _poll_remote(self) -> None:
+        try:
+            raw = self._client.kv_store_get(
+                f"{KV_PREFIX}/{self._node_rank}"
+            )
+            if not raw:
+                return
+            # consume: one RPC == one injection
+            self._client.kv_store_set(
+                f"{KV_PREFIX}/{self._node_rank}", b""
+            )
+            self._faults.extend(parse_spec(raw.decode()))
+        except Exception as e:
+            logger.warning("fault-inject poll failed: %s", e)
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self, fault: Fault, step: int) -> None:
+        logger.warning(
+            "FAULT INJECTION: %s at step %d (arg=%r)",
+            fault.kind, step, fault.arg,
+        )
+        if fault.kind == "crash":
+            rc = int(fault.arg) if fault.arg else 17
+            print(f"INJECTED CRASH rc={rc} at step {step}", flush=True)
+            os._exit(rc)
+        elif fault.kind == "hang":
+            duration = float(fault.arg) if fault.arg else float("inf")
+            print(f"INJECTED HANG at step {step}", flush=True)
+            deadline = time.monotonic() + duration
+            while time.monotonic() < deadline:
+                time.sleep(min(1.0, deadline - time.monotonic()))
+        elif fault.kind == "oom":
+            raise MemoryError(
+                f"injected OOM at step {step} {fault.arg}"
+            )
+        elif fault.kind == "error":
+            raise RuntimeError(
+                fault.arg or f"injected error at step {step}"
+            )
+        elif fault.kind == "preempt":
+            print(f"INJECTED PREEMPTION at step {step}", flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(30)  # await delivery

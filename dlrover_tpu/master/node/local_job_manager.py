@@ -7,7 +7,7 @@ bookkeeping, no pod mutation; failures of the single host end the job.
 import time
 from typing import Dict, List, Optional
 
-from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.constants import NodeAction, NodeStatus, NodeType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import Node
 
@@ -21,6 +21,7 @@ class LocalJobManager:
         self._job_nodes: Dict[str, Dict[int, Node]] = {
             NodeType.WORKER: {}
         }
+        self._pending_actions: Dict[tuple, str] = {}
 
     def start(self):
         num_workers = 1
@@ -96,7 +97,25 @@ class LocalJobManager:
             self.add_node(node_type, node_id)
             node = self.get_node(node_type, node_id)
         node.heartbeat_time = timestamp
-        return ""
+        action = self._pending_actions.pop((node_type, node_id), "")
+        if action:
+            node.hang = False
+        return action
+
+    def handle_training_hang(self, node_type: str, node_id: int,
+                             message: str = ""):
+        """Same restart-over-heartbeat contract as the distributed
+        manager (dist_job_manager.handle_training_hang)."""
+        node = self.get_node(node_type, node_id)
+        logger.warning(
+            "Training hang reported by %s-%s (%s) -> restart action",
+            node_type, node_id, message,
+        )
+        if node is not None:
+            node.hang = True
+        self._pending_actions[(node_type, node_id)] = (
+            NodeAction.RESTART_WORKER
+        )
 
     def all_workers_exited(self) -> bool:
         workers = self._job_nodes.get(NodeType.WORKER, {})

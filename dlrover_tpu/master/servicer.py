@@ -14,6 +14,7 @@ from dlrover_tpu.common.constants import (
     NodeType,
     RendezvousName,
     TaskType,
+    TrainingExceptionLevel,
 )
 from dlrover_tpu.common.grpc_utils import GenericRpcServer
 from dlrover_tpu.common.log import default_logger as logger
@@ -256,6 +257,13 @@ class MasterServicer:
             self._error_monitor.process_error(
                 node or req.node_id, req.restart_count, req.error_data,
                 req.level,
+            )
+        if (
+            req.level == TrainingExceptionLevel.HANG
+            and self._job_manager is not None
+        ):
+            self._job_manager.handle_training_hang(
+                req.node_type, req.node_id, req.error_data
             )
         return comm.Response(success=True)
 

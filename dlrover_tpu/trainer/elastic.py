@@ -89,6 +89,7 @@ class ElasticTrainer:
         cur_nodes: int,
         master_client=None,
         report_interval: int = 10,
+        hang_detection: Optional[bool] = None,
     ):
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -97,7 +98,56 @@ class ElasticTrainer:
         self._report_interval = report_interval
         self._step_cache = {}
         self._global_step = 0
+        self._hang_detector = None
+        self._fault_injector = None
+        self._init_fault_tolerance(hang_detection)
         self.set_world(cur_nodes)
+
+    def _init_fault_tolerance(self, hang_detection: Optional[bool]):
+        """Step-progress hang detection (fault_tolerance/hanging_detector
+        .py) + the injection drill hook. Both are no-ops without a master
+        client; detection defaults on, DLROVER_HANG_DETECTION=0 disables,
+        DLROVER_HANG_MIN_TIMEOUT / _MULTIPLIER tune the threshold."""
+        import os
+
+        from dlrover_tpu.fault_tolerance import (
+            FaultInjector,
+            HangingDetector,
+        )
+
+        self._fault_injector = FaultInjector.from_env(self._master_client)
+        if self._master_client is None:
+            return
+        if hang_detection is None:
+            hang_detection = (
+                os.environ.get("DLROVER_HANG_DETECTION", "1") != "0"
+            )
+        if not hang_detection:
+            return
+
+        def report(elapsed: float):
+            from dlrover_tpu.common.constants import (
+                TrainingExceptionLevel,
+            )
+
+            try:
+                self._master_client.report_failure(
+                    f"no step progress for {elapsed:.1f}s "
+                    f"(last step {self._global_step})",
+                    TrainingExceptionLevel.HANG,
+                )
+            except Exception as e:
+                logger.warning("hang report failed: %s", e)
+
+        self._hang_detector = HangingDetector(
+            report_fn=report,
+            min_timeout=float(
+                os.environ.get("DLROVER_HANG_MIN_TIMEOUT", "300")
+            ),
+            multiplier=float(
+                os.environ.get("DLROVER_HANG_MULTIPLIER", "10")
+            ),
+        ).start()
 
     def set_world(self, cur_nodes: int):
         self._cur_nodes = cur_nodes
@@ -168,6 +218,10 @@ class ElasticTrainer:
         self._global_step = step if step is not None else (
             self._global_step + 1
         )
+        if self._hang_detector is not None:
+            self._hang_detector.record_step(self._global_step)
+        if self._fault_injector is not None:
+            self._fault_injector.maybe_inject(self._global_step)
         if (
             self._master_client is not None
             and self._global_step % self._report_interval == 0

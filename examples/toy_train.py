@@ -47,9 +47,6 @@ def main():
     parser.add_argument("--steps", type=int, default=50)
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--ckpt-dir", type=str, default="/tmp/toy_ckpt")
-    parser.add_argument("--crash-at-step", type=int, default=-1,
-                        help="simulate a failure at this step (first run "
-                        "only) to exercise restore")
     parser.add_argument("--out", type=str, default="")
     args = parser.parse_args()
 
@@ -113,9 +110,6 @@ def main():
                 {"params": params, "opt_state": opt_state,
                  "step": jnp.array(step)},
             )
-        if args.crash_at_step == step and start_step == 0:
-            print(f"SIMULATED CRASH at step {step}", flush=True)
-            os._exit(17)
 
     # loss stays None when the loop body never ran (e.g. restored checkpoint
     # already at/after --steps, or the dataset was exhausted immediately)

@@ -1,0 +1,173 @@
+"""Unit tests for the fault-tolerance package: step-progress hang
+detection and the fault-injection grammar (SURVEY §5.3)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.fault_tolerance.hanging_detector import HangingDetector
+from dlrover_tpu.fault_tolerance.injection import (
+    FaultInjector,
+    parse_spec,
+)
+
+
+class TestHangingDetector:
+    def test_not_armed_before_first_step(self):
+        det = HangingDetector(min_timeout=0.01)
+        time.sleep(0.05)
+        assert not det.is_hanged()  # compile phase never trips it
+
+    def test_detects_stall_and_reports_once(self):
+        reports = []
+        det = HangingDetector(
+            report_fn=reports.append, min_timeout=0.05,
+            check_interval=0.01,
+        )
+        det.start()
+        for s in range(5):
+            det.record_step(s)
+            time.sleep(0.005)
+        time.sleep(0.3)  # stall >> threshold
+        det.stop()
+        assert det.is_hanged()
+        assert len(reports) == 1  # latched: one report per stall
+        assert reports[0] > 0.05
+
+    def test_no_false_positive_while_stepping(self):
+        reports = []
+        det = HangingDetector(
+            report_fn=reports.append, min_timeout=0.2,
+            check_interval=0.01,
+        )
+        det.start()
+        for s in range(20):
+            det.record_step(s)
+            time.sleep(0.01)
+        det.stop()
+        assert not reports
+
+    def test_adaptive_threshold_tracks_step_time(self):
+        det = HangingDetector(min_timeout=0.01, multiplier=10.0)
+        det.record_step(0)
+        det._durations.extend([2.0, 2.0, 2.0])
+        assert det.timeout() == pytest.approx(20.0)
+
+    def test_rearms_after_progress_resumes(self):
+        reports = []
+        det = HangingDetector(
+            report_fn=reports.append, min_timeout=0.04,
+            check_interval=0.01,
+        )
+        det.start()
+        for s in range(5):  # establish a fast cadence
+            det.record_step(s)
+            time.sleep(0.003)
+        time.sleep(0.15)  # first stall
+        det.record_step(5)  # progress resumes (stall gap is rejected
+        time.sleep(0.15)  # from the cadence history); second stall
+        det.stop()
+        assert len(reports) == 2
+
+
+class TestFaultInjectionSpec:
+    def test_parse_grammar(self):
+        faults = parse_spec("crash@15:3, hang@8:120, oom@5, error@2:boom")
+        kinds = [(f.kind, f.step, f.arg) for f in faults]
+        assert kinds == [
+            ("crash", 15, "3"), ("hang", 8, "120"),
+            ("oom", 5, ""), ("error", 2, "boom"),
+        ]
+
+    def test_parse_now_and_every_incarnation(self):
+        (f,) = parse_spec("hang@now:30!")
+        assert f.step == -1 and f.every_incarnation
+
+    def test_parse_rejects_unknown_kind(self):
+        with pytest.raises(ValueError):
+            parse_spec("explode@3")
+
+    def test_restart_count_gates_env_faults(self):
+        inj = FaultInjector("error@1:boom", restart_count=1)
+        inj.maybe_inject(5)  # gated out: second incarnation runs clean
+        inj2 = FaultInjector("error@1:boom!", restart_count=1)
+        with pytest.raises(RuntimeError, match="boom"):
+            inj2.maybe_inject(5)
+
+    def test_error_fires_at_step(self):
+        inj = FaultInjector("error@3:kaput")
+        inj.maybe_inject(1)
+        inj.maybe_inject(2)
+        with pytest.raises(RuntimeError, match="kaput"):
+            inj.maybe_inject(3)
+        inj.maybe_inject(4)  # fired once, never again
+
+    def test_oom_raises_memory_error(self):
+        inj = FaultInjector("oom@1")
+        with pytest.raises(MemoryError):
+            inj.maybe_inject(1)
+
+    def test_hang_with_duration_sleeps(self):
+        inj = FaultInjector("hang@1:0.1")
+        t0 = time.monotonic()
+        inj.maybe_inject(1)
+        assert time.monotonic() - t0 >= 0.1
+
+    def test_remote_kv_injection_consumed(self):
+        class FakeClient:
+            def __init__(self):
+                self.kv = {"fault_inject/0": b"error@now:remote"}
+
+            def kv_store_get(self, key):
+                return self.kv.get(key, b"")
+
+            def kv_store_set(self, key, value):
+                self.kv[key] = value
+
+        client = FakeClient()
+        inj = FaultInjector(master_client=client, poll_every=1)
+        with pytest.raises(RuntimeError, match="remote"):
+            inj.maybe_inject(10)
+        assert client.kv["fault_inject/0"] == b""  # consumed
+        inj.maybe_inject(11)  # no re-fire
+
+
+class TestMasterHangFlow:
+    def test_hang_report_becomes_restart_action(self):
+        """report_failure(level=hang) -> pending restart action delivered
+        on the node's next heartbeat, exactly once."""
+        from dlrover_tpu.common.constants import NodeAction, NodeType
+        from dlrover_tpu.master.node.local_job_manager import (
+            LocalJobManager,
+        )
+
+        mgr = LocalJobManager()
+        mgr.start()
+        mgr.handle_training_hang(NodeType.WORKER, 0, "no progress")
+        node = mgr.get_node(NodeType.WORKER, 0)
+        assert node.hang
+        action = mgr.collect_node_heartbeat(NodeType.WORKER, 0, 1.0)
+        assert action == NodeAction.RESTART_WORKER
+        assert not node.hang
+        assert mgr.collect_node_heartbeat(NodeType.WORKER, 0, 2.0) == ""
+
+    def test_dist_manager_hang_flow(self):
+        from dlrover_tpu.common.constants import (
+            NodeAction,
+            NodeStatus,
+            NodeType,
+        )
+        from dlrover_tpu.master.node.dist_job_manager import (
+            DistributedJobManager,
+        )
+
+        mgr = DistributedJobManager()
+        mgr.update_node_status(NodeType.WORKER, 0, NodeStatus.RUNNING)
+        mgr.handle_training_hang(NodeType.WORKER, 0, "stalled")
+        action = mgr.collect_node_heartbeat(NodeType.WORKER, 0, 1.0)
+        assert action == NodeAction.RESTART_WORKER
+        # node is still RUNNING: recycled, not failed
+        assert (
+            mgr.get_node(NodeType.WORKER, 0).status == NodeStatus.RUNNING
+        )
+        assert mgr.collect_node_heartbeat(NodeType.WORKER, 0, 2.0) is None
