@@ -24,6 +24,11 @@ BYTES = {"bf16": 2, "fp32": 4}
 # hidden per token-layer; minimal keeps only layer inputs)
 ACT_FACTOR = {"off": 30.0, "dots": 12.0, "minimal": 2.5}
 
+# step-FLOPs multiplier from rematerialization: fwd+bwd ~ 3x fwd; full
+# recompute of the forward in the backward adds ~1 fwd (4/3); "dots"
+# saves matmul outputs so only the cheap elementwise work is redone
+REMAT_COMPUTE = {"off": 1.0, "dots": 1.08, "minimal": 4.0 / 3.0}
+
 
 @dataclasses.dataclass
 class ModelProfile:
@@ -84,6 +89,13 @@ def estimate_memory(
     params_bytes = profile.param_count * b / shard
     optimizer_bytes = 2 * params_bytes  # adam m+v in param dtype
     gradient_bytes = params_bytes
+    if strategy.sharding in ("zero1", "zero2"):
+        # params replicated; Adam m+v sharded over fsdp; zero2 also
+        # shards the grad accumulation buffer
+        zshard = max(strategy.axis("fsdp"), 1)
+        optimizer_bytes /= zshard
+        if strategy.sharding == "zero2":
+            gradient_bytes /= zshard
 
     dp = strategy.axis("data") * strategy.axis("fsdp")
     micro_tokens = (global_batch // max(dp, 1)) * seq_len
@@ -126,11 +138,13 @@ def estimate_step_time(
         tokens * profile.flops_per_token
         / max(dp * model_parallel, 1)
         / (peak_flops * mfu)
-    )
+    ) * REMAT_COMPUTE[strategy.remat]
 
     b = BYTES[strategy.precision]
     comm = 0.0
     if strategy.axis("fsdp") > 1:
+        # fsdp: all-gather(use)+reduce-scatter(grad); zero1/2: reduce-
+        # scatter(grad)+all-gather(update) — same ~2x param volume
         comm += 2 * profile.param_count * b / ici_bandwidth
     elif dp > 1:
         comm += 2 * profile.param_count * b / ici_bandwidth

@@ -99,15 +99,19 @@ def enumerate_strategies(
                 continue
             specs = [("data", data), ("fsdp", fsdp), ("tensor", tensor)]
             if tensor > 1:
-                name = "tp_fsdp" if fsdp > 1 else "tp"
+                names = ["tp_fsdp" if fsdp > 1 else "tp"]
             elif fsdp > 1:
-                name = "fsdp"
+                # same mesh, three layouts: full FSDP vs opt-state-only
+                # sharding (ZeRO-1) vs opt+grad sharding (ZeRO-2)
+                names = ["fsdp", "zero1", "zero2"]
             else:
-                name = "ddp"
-            for remat in ("dots", "minimal"):
-                out.append(Strategy(
-                    mesh_spec=tuple(specs), sharding=name, remat=remat,
-                ))
+                names = ["ddp"]
+            for name in names:
+                for remat in ("dots", "minimal"):
+                    out.append(Strategy(
+                        mesh_spec=tuple(specs), sharding=name,
+                        remat=remat,
+                    ))
     if context_lengths_long:
         for sp in _divisors(num_devices):
             if sp == 1:

@@ -106,14 +106,63 @@ def pipeline_rules() -> Rules:
     return r
 
 
+def zero1_rules() -> Rules:
+    """ZeRO-1 (parity: auto/opt_lib/zero_optimization.py:22): params and
+    grads replicated like DDP, but the OPTIMIZER STATE is sharded over
+    fsdp — see ``opt_state_rules``. The jitted step then reduce-scatters
+    grads into the sharded Adam update and all-gathers the delta, cutting
+    the dominant Adam m+v footprint by the fsdp factor while keeping
+    DDP's simple layout. Use when params fit in HBM but Adam state
+    doesn't."""
+    return {"batch": (DATA_AXIS, FSDP_AXIS)}
+
+
+def zero2_rules() -> Rules:
+    """ZeRO-2 (parity: zero_optimization.py:53): ZeRO-1 plus sharded
+    gradient accumulation — the grad buffer (and scan carry, under
+    accumulation) is constrained to the fsdp layout, so grads are
+    reduce-scattered once instead of held replicated."""
+    return {"batch": (DATA_AXIS, FSDP_AXIS)}
+
+
 STRATEGIES = {
     "ddp": ddp_rules,
+    "zero1": zero1_rules,
+    "zero2": zero2_rules,
     "fsdp": fsdp_rules,
     "tp": tp_rules,
     "tp_fsdp": tp_fsdp_rules,
     "sequence": sequence_rules,
     "pipeline": pipeline_rules,
 }
+
+# strategies whose optimizer state is sharded differently from params.
+# The rule table shards every param logical axis over fsdp — applied to
+# the param-shaped subtrees of the optax state (opt_state_shardings).
+_ZERO_OPT_RULES = {
+    "embed": FSDP_AXIS,
+    "vocab": FSDP_AXIS,
+    "mlp": FSDP_AXIS,
+    "heads": FSDP_AXIS,
+    "kv_heads": FSDP_AXIS,
+    "expert": FSDP_AXIS,
+}
+
+
+def opt_state_rules(strategy: str) -> Optional[Rules]:
+    """Rule table for optimizer-state sharding when it differs from the
+    param layout (ZeRO-1/2); None means "mirror the params"."""
+    if strategy in ("zero1", "zero2"):
+        return dict(_ZERO_OPT_RULES)
+    return None
+
+
+def grad_rules(strategy: str) -> Optional[Rules]:
+    """Rule table constraining gradient layout (ZeRO-2); None leaves
+    the layout to XLA."""
+    if strategy == "zero2":
+        return dict(_ZERO_OPT_RULES)
+    return None
 
 
 def get_rules(strategy: str) -> Rules:
@@ -184,6 +233,37 @@ def tree_shardings(
             isinstance(x, tuple)
             and all(a is None or isinstance(a, str) for a in x)
         ),
+    )
+
+
+def opt_state_shardings(
+    abs_opt_state: Any,
+    abs_params: Any,
+    param_shardings: Any,
+    mesh: Mesh,
+) -> Any:
+    """Shardings for an optax state whose param-shaped subtrees should
+    follow ``param_shardings`` (computed under e.g. the ZeRO opt rules)
+    and whose other leaves (step counts, scalars) are replicated.
+
+    Optax states embed zero or more subtrees with exactly the params'
+    treedef (adam: mu and nu); we match on treedef rather than leaf
+    shapes so wrapped/chained transforms keep working.
+    """
+    pdef = jax.tree.structure(abs_params)
+    replicated = NamedSharding(mesh, P())
+
+    def is_param_subtree(sub) -> bool:
+        try:
+            return jax.tree.structure(sub) == pdef
+        except Exception:
+            return False
+
+    return jax.tree.map(
+        lambda sub: param_shardings if is_param_subtree(sub)
+        else replicated,
+        abs_opt_state,
+        is_leaf=is_param_subtree,
     )
 
 

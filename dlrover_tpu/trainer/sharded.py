@@ -69,6 +69,25 @@ class ShardedTrainer:
         self.batch_sharding = shd.batch_sharding(
             mesh, self.rules, batch_extra_axes
         )
+        # ZeRO-1/2: optimizer state (and for zero2 the grad buffer) laid
+        # out under its own rule table while params stay replicated
+        self.opt_shardings = None
+        self._grad_shardings = None
+        opt_rules = shd.opt_state_rules(strategy)
+        if opt_rules is not None:
+            abs_params = jax.eval_shape(init_fn, jax.random.key(0))
+            abs_opt = jax.eval_shape(self.optimizer.init, abs_params)
+            opt_param_shards = shd.tree_shardings(
+                axes_tree, mesh, opt_rules
+            )
+            self.opt_shardings = shd.opt_state_shardings(
+                abs_opt, abs_params, opt_param_shards, mesh
+            )
+        g_rules = shd.grad_rules(strategy)
+        if g_rules is not None:
+            self._grad_shardings = shd.tree_shardings(
+                axes_tree, mesh, g_rules
+            )
         self._jit_init = None
         self._jit_step = None
 
@@ -88,7 +107,8 @@ class ShardedTrainer:
                 return params, opt_state
 
             self._jit_init = jax.jit(
-                _init, out_shardings=(self.param_shardings, None)
+                _init,
+                out_shardings=(self.param_shardings, self.opt_shardings),
             )
         with self.mesh:
             return self._jit_init(rng)
@@ -109,6 +129,14 @@ class ShardedTrainer:
             self._loss_fn
         )
         accum = self.accum_steps
+        gshard = self._grad_shardings
+
+        def constrain_grads(grads):
+            if gshard is None:
+                return grads
+            return jax.tree.map(
+                jax.lax.with_sharding_constraint, grads, gshard
+            )
 
         def step(params, opt_state, batch):
             batch = jax.tree.map(
@@ -125,19 +153,21 @@ class ShardedTrainer:
                 loss, grads = grad_fn(
                     params, jax.tree.map(lambda x: x[0], batch)
                 )
+                grads = constrain_grads(grads)
             else:
 
                 def micro(carry, mb):
                     loss_sum, grads_sum = carry
                     loss, grads = grad_fn(params, mb)
+                    grads = constrain_grads(grads)
                     return (
                         loss_sum + loss,
                         jax.tree.map(jnp.add, grads_sum, grads),
                     ), None
 
-                zeros = jax.tree.map(
+                zeros = constrain_grads(jax.tree.map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), params
-                )
+                ))
                 (loss_sum, grads_sum), _ = jax.lax.scan(
                     micro, (jnp.zeros(()), zeros), batch
                 )
@@ -152,11 +182,22 @@ class ShardedTrainer:
         self._jit_step = jax.jit(
             step,
             donate_argnums=(0, 1),
-            out_shardings=(self.param_shardings, None, None),
+            out_shardings=(
+                self.param_shardings, self.opt_shardings, None,
+            ),
         )
         return self._jit_step
 
     # -- data helpers ----------------------------------------------------
+    @property
+    def microbatch_sharding(self) -> NamedSharding:
+        """Sharding of a [accum, batch, ...] microbatched array — the
+        single source of truth for shard_batch and external loaders
+        (DevicePrefetch, bench --data shm)."""
+        return NamedSharding(
+            self.mesh, P(None, *self.batch_sharding.spec)
+        )
+
     def microbatch(self, batch):
         """[global_batch, ...] -> [accum, global_batch/accum, ...]."""
         a = self.accum_steps
@@ -166,8 +207,7 @@ class ShardedTrainer:
 
     def shard_batch(self, batch):
         """Device-put numpy microbatches with the strategy's layout."""
-        spec = P(None, *self.batch_sharding.spec)
-        sh = NamedSharding(self.mesh, spec)
+        sh = self.microbatch_sharding
         return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
 
 
