@@ -104,6 +104,40 @@ def dryrun_strategy(
     return (time.perf_counter() - t0) / steps
 
 
+def dryrun_abstract(
+    cfg, strategy: Strategy, global_batch: int, seq_len: int,
+    devices=None, optimizer=None,
+):
+    """Compile-only dry-run on ABSTRACT inputs (parity: the reference's
+    meta-model dryrun utilities, atorch/atorch/utils/meta_model_utils.py
+    — materialize nothing, ask the compiler).
+
+    Lowers + compiles the real train step from ShapeDtypeStructs via the
+    AOT path and returns XLA's own memory analysis — exact where the
+    analytic model (auto/analyser.py) is approximate, at compile cost
+    but zero HBM. Returns (argument_bytes, temp_bytes, output_bytes).
+    """
+    trainer = build_trainer(cfg, strategy, devices, optimizer)
+    abs_params = jax.eval_shape(trainer._init_fn, jax.random.key(0))
+    abs_opt = jax.eval_shape(trainer.optimizer.init, abs_params)
+    mb = global_batch // max(strategy.accum_steps, 1)
+    abs_batch = jax.tree.map(
+        lambda _: jax.ShapeDtypeStruct(
+            (strategy.accum_steps, mb, seq_len), np.int32
+        ),
+        (0, 0),
+    )
+    compiled = (
+        trainer.train_step.lower(abs_params, abs_opt, abs_batch)
+        .compile()
+    )
+    mem = compiled.memory_analysis()
+    arg_bytes = getattr(mem, "argument_size_in_bytes", 0)
+    temp_bytes = getattr(mem, "temp_size_in_bytes", 0)
+    out_bytes = getattr(mem, "output_size_in_bytes", 0)
+    return arg_bytes, temp_bytes, out_bytes
+
+
 def auto_accelerate(
     cfg,
     global_batch: int,
@@ -111,6 +145,7 @@ def auto_accelerate(
     devices: Optional[Sequence] = None,
     strategies: Optional[List[Strategy]] = None,
     dryrun_top_k: int = 0,
+    bo_iters: int = 0,
     load_strategy_path: Optional[str] = None,
     optimizer=None,
     hbm_bytes: Optional[float] = None,
@@ -155,6 +190,33 @@ def auto_accelerate(
                 f"no strategy candidates for {len(devices)} devices"
             )
     fitting.sort(key=lambda r: r.est_step_seconds)
+
+    if bo_iters > 0:
+        # BO refinement (parity: auto/engine/sg_algo/bo_sg.py): GP+EI
+        # over the fitting candidates, seeded by the analytic ranking
+        from dlrover_tpu.auto.bo import bo_search
+
+        by_strategy = {r.strategy: r for r in fitting}
+        best_s, measured = bo_search(
+            [r.strategy for r in fitting],
+            lambda s: dryrun_strategy(
+                cfg, s, global_batch, seq_len, devices,
+                optimizer=optimizer,
+            ),
+            seed_order=[r.strategy for r in fitting],
+            n_init=max(dryrun_top_k, 2),
+            n_iters=bo_iters,
+        )
+        for s, t in measured.items():
+            by_strategy[s].measured_step_seconds = t
+        best = by_strategy[best_s]
+        logger.info(
+            "auto_accelerate (BO, %d measured) picked %s (%.1f ms/step)",
+            len(measured), best.strategy,
+            best.measured_step_seconds * 1e3,
+        )
+        trainer = build_trainer(cfg, best.strategy, devices, optimizer)
+        return AccelerateResult(trainer, best.strategy, reports)
 
     if dryrun_top_k > 0:
         for r in fitting[:dryrun_top_k]:

@@ -132,6 +132,152 @@ def test_load_strategy_path_fast_path():
     assert result.trainer is not None
 
 
+def test_enumerate_includes_zero_variants():
+    cands = enumerate_strategies(8, global_batch=8)
+    names = {c.sharding for c in cands}
+    assert {"zero1", "zero2"} <= names
+    z = next(c for c in cands if c.sharding == "zero1")
+    assert z.axis("fsdp") > 1  # zero needs a shard axis
+
+
+def test_zero_memory_between_ddp_and_fsdp():
+    """ZeRO-1 keeps params replicated but shards Adam state; its
+    footprint must land strictly between DDP and full FSDP, and ZeRO-2
+    at or below ZeRO-1 (sharded grads)."""
+    cfg = llama.llama2_7b()
+    profile = ModelProfile.from_llama(cfg, 2048)
+    mesh = (("data", 1), ("fsdp", 8))
+    mems = {
+        name: estimate_memory(
+            profile,
+            Strategy(mesh_spec=mesh, sharding=name), 8, 2048,
+        ).total
+        for name in ("ddp", "zero1", "zero2", "fsdp")
+    }
+    assert mems["fsdp"] < mems["zero2"] <= mems["zero1"] < mems["ddp"]
+
+
+def test_time_model_remat_ordering():
+    """Recompute costs FLOPs: minimal > dots > off at fixed layout."""
+    cfg = llama.llama2_7b()
+    profile = ModelProfile.from_llama(cfg, 2048)
+    times = [
+        estimate_step_time(
+            profile,
+            Strategy(mesh_spec=(("fsdp", 8),), sharding="fsdp",
+                     remat=remat),
+            8, 2048,
+        )
+        for remat in ("off", "dots", "minimal")
+    ]
+    assert times[0] < times[1] < times[2]
+
+
+def test_analyser_ordering_matches_measured_dryruns():
+    """VERDICT #7(a): the analytic ranking must agree with measured
+    dryruns on the cost dimension that survives the TPU->CPU constant
+    swap — remat recompute FLOPs — across three real strategies of a
+    replicated-param (compute-bound on CPU) family. Collective-cost
+    constants do NOT transfer to the CPU backend (full FSDP gathers
+    measure ~10x slower than replicated params there); that gap is what
+    the dryrun/BO refinement stage exists to correct, covered by
+    test_auto_accelerate_bo_path."""
+    from dlrover_tpu.auto.accelerate import dryrun_strategy
+
+    cfg = llama.llama_tiny()
+    profile = ModelProfile.from_llama(cfg, 64)
+    mesh = (("data", 2), ("fsdp", 4))
+    cands = [
+        Strategy(mesh_spec=mesh, sharding="zero1", remat="off"),
+        Strategy(mesh_spec=mesh, sharding="zero1", remat="dots"),
+        Strategy(mesh_spec=mesh, sharding="zero1", remat="minimal"),
+    ]
+    est = [estimate_step_time(profile, s, 16, 64) for s in cands]
+    meas = [
+        dryrun_strategy(cfg, s, 16, 64, steps=10) for s in cands
+    ]
+    # predicted: off < dots < minimal (REMAT_COMPUTE ordering)
+    assert est[0] < est[1] < est[2]
+    # measured: full recompute is the slowest of the family, and the
+    # analyser's top-1 (off) is measured-competitive with the best
+    assert meas[2] > min(meas)
+    assert meas[0] <= 1.25 * min(meas)
+
+
+def test_bo_search_finds_optimum_with_few_measurements():
+    """The GP+EI loop locates the best strategy while measuring only a
+    fraction of the candidate set (parity: bo_sg.py's role)."""
+    from dlrover_tpu.auto.bo import bo_search
+
+    cands = enumerate_strategies(8, global_batch=8)
+
+    # synthetic ground truth: tensor axes hurt, minimal remat hurts,
+    # fsdp helps a bit — a deterministic landscape with a unique best
+    def true_time(s):
+        t = 1.0
+        t += 0.5 * (s.axis("tensor") - 1)
+        t += 0.4 * (s.remat == "minimal")
+        t -= 0.1 * (s.axis("fsdp") > 1)
+        t += 0.05 * s.axis("data")
+        return t
+
+    calls = []
+
+    def measure(s):
+        calls.append(s)
+        return true_time(s)
+
+    best, measured = bo_search(
+        cands, measure, n_init=3, n_iters=6,
+    )
+    assert len(calls) <= 9 < len(cands)
+    true_best = min(cands, key=true_time)
+    assert true_time(best) <= true_time(true_best) * 1.1
+
+
+def test_bo_skips_failing_candidates():
+    from dlrover_tpu.auto.bo import bo_search
+
+    cands = enumerate_strategies(8, global_batch=8)[:6]
+
+    def measure(s):
+        if s.remat == "minimal":
+            raise RuntimeError("compile OOM")
+        return 1.0 + 0.1 * s.axis("tensor")
+
+    best, measured = bo_search(cands, measure, n_init=2, n_iters=8)
+    assert best.remat != "minimal"
+    assert all(s.remat != "minimal" for s in measured)
+
+
+def test_auto_accelerate_bo_path():
+    cfg = llama.llama_tiny()
+    result = auto_accelerate(
+        cfg, global_batch=8, seq_len=32, hbm_bytes=16e9,
+        dryrun_top_k=2, bo_iters=2,
+    )
+    measured = [
+        r for r in result.reports if r.measured_step_seconds is not None
+    ]
+    assert len(measured) >= 2
+    # the winner was actually measured, not just predicted
+    assert any(r.strategy == result.strategy for r in measured)
+
+
+def test_dryrun_abstract_measures_memory_without_materializing():
+    """U2: the abstract (eval_shape + AOT) dryrun returns XLA's real
+    memory analysis with zero arrays allocated."""
+    from dlrover_tpu.auto.accelerate import dryrun_abstract
+
+    cfg = llama.llama_tiny()
+    s = Strategy(mesh_spec=(("data", 2), ("fsdp", 4)), sharding="fsdp")
+    args_b, temp_b, out_b = dryrun_abstract(cfg, s, 8, 32)
+    # params + opt state + batch dominate argument bytes; must be the
+    # right order of magnitude for the tiny model (~0.5M params, fsdp/4)
+    assert args_b > 1e4
+    assert out_b > 0
+
+
 def test_build_trainer_context_parallel():
     cfg = llama.llama_tiny()
     s = Strategy(
