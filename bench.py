@@ -41,12 +41,42 @@ def peak_flops_per_chip(device) -> float:
     return 459.0 * 1e12  # assume v5p (the BASELINE.json target platform)
 
 
+class _BenchProducer:
+    """Module-level (spawn-picklable) synthetic batch stream for the
+    --data shm path."""
+
+    def __init__(self, n_batches, batch, seq, vocab):
+        self.n_batches = n_batches
+        self.batch = batch
+        self.seq = seq
+        self.vocab = vocab
+
+    def __call__(self):
+        rng = np.random.default_rng(0)
+        for _ in range(self.n_batches):
+            t = rng.integers(
+                0, self.vocab, (self.batch, self.seq), dtype=np.int32
+            )
+            yield t, t
+
+
 def main():
+    import argparse
+
     import optax
 
     from dlrover_tpu.models import llama
     from dlrover_tpu.parallel.mesh import create_mesh
     from dlrover_tpu.trainer.sharded import make_trainer_for_llama
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--data", choices=["inmem", "shm"], default="inmem",
+        help="shm: feed every step from coworker processes over the "
+        "C++ shm ring + DevicePrefetch (the production data plane) "
+        "instead of reusing one in-memory batch",
+    )
+    args = ap.parse_args()
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -74,9 +104,31 @@ def main():
     )
     mb = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
 
+    batches = None
+    if args.data == "shm":
+        from dlrover_tpu.data.shm_dataloader import (
+            DevicePrefetch,
+            ShmDataLoader,
+        )
+
+        loader = ShmDataLoader(
+            _BenchProducer(
+                warmup + steps + 1, batch, seq, cfg.vocab_size
+            ),
+            num_workers=2,
+            slot_bytes=max(1 << 20, 4 * batch * seq * 2 + 4096),
+        )
+        batches = iter(DevicePrefetch(
+            (trainer.microbatch(b) for b in loader),
+            depth=2, sharding=trainer.microbatch_sharding,
+        ))
+
+    def next_mb():
+        return mb if batches is None else next(batches)
+
     for _ in range(warmup):
         params, opt_state, loss = trainer.train_step(
-            params, opt_state, mb
+            params, opt_state, next_mb()
         )
     float(loss)  # host transfer = hard sync (the axon tunnel does not
     # honor block_until_ready)
@@ -84,7 +136,7 @@ def main():
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = trainer.train_step(
-            params, opt_state, mb
+            params, opt_state, next_mb()
         )
     # one sync at the end: the final loss depends on the whole step chain,
     # so this waits for all 20 steps without a per-step host round-trip
@@ -128,6 +180,7 @@ def main():
         "xla_counted_flops_per_step": prof.flops,
         "hbm_gb_per_step": round(prof.hbm_bytes / 2**30, 2),
         "param_count": prof.param_count,
+        "data_path": args.data,
     }
     print(json.dumps(result))
 

@@ -23,12 +23,16 @@ from dlrover_tpu.data.shm_ring import RingClosed, ShmRing
 
 
 def _producer_main(ring_name: str, dataset_fn, worker_id: int,
-                   num_workers: int):
-    """Runs in a coworker process: iterate dataset_fn(), push batches."""
+                   num_workers: int, pre_sharded: bool):
+    """Runs in a coworker process: iterate dataset_fn(), push batches.
+
+    With ``pre_sharded`` each worker's dataset_fn already yields a
+    disjoint stream (e.g. master-coordinated shards via ShardingClient)
+    and the round-robin filter is skipped."""
     ring = ShmRing.attach(ring_name)
     try:
         for i, batch in enumerate(dataset_fn()):
-            if i % num_workers != worker_id:
+            if not pre_sharded and i % num_workers != worker_id:
                 continue
             ring.push(batch)
     except RingClosed:
@@ -51,6 +55,7 @@ class ShmDataLoader:
         slot_bytes: int = 64 << 20,
         num_slots: int = 8,
         name: Optional[str] = None,
+        pre_sharded: bool = False,
     ):
         # pid + random suffix: id(self) repeats across processes, and
         # create() unlinks same-named stale segments — two jobs on one
@@ -66,7 +71,8 @@ class ShmDataLoader:
         self._procs = [
             ctx.Process(
                 target=_producer_main,
-                args=(self._ring.name, dataset_fn, w, num_workers),
+                args=(self._ring.name, dataset_fn, w, num_workers,
+                      pre_sharded),
                 daemon=True,
             )
             for w in range(num_workers)

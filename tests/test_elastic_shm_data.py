@@ -1,0 +1,105 @@
+"""The shm data plane wired into the flagship trainer (VERDICT #9):
+master-coordinated shards -> coworker producers -> C++ ring ->
+DevicePrefetch -> ShardedTrainer."""
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from dlrover_tpu.data.elastic_shm import ElasticShmDataLoader
+from dlrover_tpu.master.local_master import LocalJobMaster
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.mesh import create_mesh
+from dlrover_tpu.trainer.sharded import make_trainer_for_llama
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(port=0)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+class MarkerBatchFn:
+    """Picklable batch_fn whose output encodes the shard range, so the
+    consumer can verify exactly-once coverage."""
+
+    def __init__(self, seq_len=16, vocab=128):
+        self.seq_len = seq_len
+        self.vocab = vocab
+
+    def __call__(self, start, end):
+        idx = np.arange(start, end, dtype=np.int32)
+        tokens = (
+            idx[:, None] + np.arange(self.seq_len, dtype=np.int32)
+        ) % self.vocab
+        return idx, tokens
+
+
+def test_elastic_shm_covers_dataset_exactly_once(master):
+    n, batch = 64, 8
+    loader = ElasticShmDataLoader(
+        MarkerBatchFn(),
+        dataset_name="cov",
+        batch_size=batch,
+        dataset_size=n,
+        num_epochs=1,
+        num_workers=2,
+        master_addr=master.addr,
+        slot_bytes=1 << 20,
+        sharding=None,
+    )
+    seen = []
+    for idx, tokens in loader:
+        seen.extend(np.asarray(idx).tolist())
+        # batch content derives from the shard range
+        assert tokens.shape == (len(np.asarray(idx)), 16)
+    loader.shutdown()
+    # both coworkers pulled disjoint shards covering every sample once
+    assert sorted(seen) == list(range(n))
+
+
+class TokenBatchFn:
+    """Module-level (spawn-picklable) synthetic token producer."""
+
+    def __call__(self, start, end):
+        rng = np.random.default_rng(start)
+        t = rng.integers(0, 128, (end - start, 16), dtype=np.int32)
+        return t, t
+
+
+def test_llama_trains_from_shm_ring(master):
+    """Llama + ShardedTrainer consuming ring batches end-to-end: the
+    done-criterion workload of VERDICT #9 in-process."""
+    cfg = llama.llama_tiny()
+    mesh = create_mesh([("data", 1), ("fsdp", 8)])
+    trainer = make_trainer_for_llama(
+        cfg, mesh, strategy="fsdp", optimizer=optax.adamw(1e-3),
+    )
+    params, opt_state = trainer.init(jax.random.key(0))
+
+    n, batch = 32, 8
+    loader = ElasticShmDataLoader(
+        TokenBatchFn(),
+        dataset_name="llama-shm",
+        batch_size=batch,
+        dataset_size=n,
+        num_epochs=1,
+        num_workers=2,
+        master_addr=master.addr,
+        slot_bytes=1 << 20,
+        sharding=trainer.batch_sharding,
+    )
+    steps = 0
+    for batch_data in loader:
+        mb = jax.tree.map(lambda x: x[None], batch_data)
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, mb
+        )
+        assert np.isfinite(float(loss))
+        steps += 1
+    loader.shutdown()
+    assert steps == n // batch
