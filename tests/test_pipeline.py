@@ -1,4 +1,5 @@
-"""GPipe pipeline-parallel tests on the 8-device CPU mesh."""
+"""GPipe + interleaved pipeline-parallel tests on the 8-device CPU
+mesh."""
 
 import jax
 import jax.numpy as jnp
@@ -9,7 +10,9 @@ import pytest
 from dlrover_tpu.models import llama
 from dlrover_tpu.parallel.mesh import create_mesh
 from dlrover_tpu.parallel.pipeline import (
+    bubble_fraction,
     gpipe_apply,
+    interleaved_pipeline_apply,
     pipeline_llama_forward,
 )
 
@@ -77,6 +80,106 @@ def test_pipeline_rejects_indivisible_layers():
     with pytest.raises(ValueError):
         pipeline_llama_forward(params, tokens, cfg, mesh,
                                num_microbatches=2)
+
+
+def test_bubble_fraction_shrinks_with_chunks():
+    assert bubble_fraction(1, 4) == 0.0
+    g = bubble_fraction(4, 8, num_chunks=1)
+    i2 = bubble_fraction(4, 8, num_chunks=2)
+    i4 = bubble_fraction(4, 8, num_chunks=4)
+    assert g == pytest.approx(3 / 11)
+    assert i4 < i2 < g
+    assert i2 == pytest.approx(3 / 19)
+
+
+def test_interleaved_forward_matches_dense():
+    """The circular schedule routes every microbatch through all V*P
+    chunks in global layer order — logits must equal the dense model."""
+    cfg = llama.llama_tiny(num_layers=8, remat="off")
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0,
+                                cfg.vocab_size)
+    mesh = create_mesh([("pipe", 4)], devices=jax.devices()[:4])
+    logits_pp = jax.jit(
+        lambda p, t: pipeline_llama_forward(
+            p, t, cfg, mesh, num_microbatches=4, num_chunks=2
+        )
+    )(params, tokens)
+    dense = llama.forward(params, tokens, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits_pp), np.asarray(dense), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_interleaved_matches_gpipe_and_aux():
+    """Same math as GPipe on the same partitioning (V=2, 8 layers)."""
+    cfg = llama.llama_tiny(num_layers=8, remat="off")
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(2), (8, 16), 0,
+                                cfg.vocab_size)
+    mesh = create_mesh([("pipe", 2)], devices=jax.devices()[:2])
+    y_g, aux_g = jax.jit(
+        lambda p, t: pipeline_llama_forward(
+            p, t, cfg, mesh, num_microbatches=4, return_aux=True
+        )
+    )(params, tokens)
+    y_i, aux_i = jax.jit(
+        lambda p, t: pipeline_llama_forward(
+            p, t, cfg, mesh, num_microbatches=4, num_chunks=2,
+            return_aux=True,
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(y_i), np.asarray(y_g), rtol=2e-2, atol=2e-2
+    )
+    np.testing.assert_allclose(
+        float(aux_i), float(aux_g), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_interleaved_rejects_bad_shapes():
+    cfg = llama.llama_tiny(num_layers=8, remat="off")
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((8, 16), jnp.int32)
+    mesh = create_mesh([("pipe", 4)], devices=jax.devices()[:4])
+    with pytest.raises(ValueError):  # 8 layers, pp*chunks = 12
+        pipeline_llama_forward(params, tokens, cfg, mesh,
+                               num_microbatches=4, num_chunks=3)
+    with pytest.raises(ValueError):  # microbatches not multiple of pp
+        pipeline_llama_forward(params, tokens, cfg, mesh,
+                               num_microbatches=2, num_chunks=2)
+
+
+def test_interleaved_training_learns():
+    """Grads flow backward through the wrapped-ring ppermute chain."""
+    cfg = llama.llama_tiny(num_layers=8, remat="off")
+    mesh = create_mesh([("pipe", 2)], devices=jax.devices()[:2])
+    params = llama.init_params(jax.random.key(0), cfg)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0,
+                                cfg.vocab_size)
+
+    def loss_fn(p):
+        logits = pipeline_llama_forward(
+            p, tokens, cfg, mesh, num_microbatches=2, num_chunks=2
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, tokens[..., None], axis=-1)
+        )
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        updates, s2 = opt.update(g, s, p)
+        return loss, optax.apply_updates(p, updates), s2
+
+    losses = []
+    for _ in range(8):
+        loss, params, opt_state = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
 
 
 def test_pipeline_training_learns():
