@@ -1,0 +1,3 @@
+from dlrover_tpu.brain.client import BrainClient, BrainReporter
+
+__all__ = ["BrainClient", "BrainReporter"]

@@ -1,0 +1,168 @@
+"""Brain-shaped persistent stats archive + cross-job optimizer (M23/L5).
+
+Parity reference: dlrover/python/brain/client.py:63 (BrainClient —
+report_training_hyper_params/report_metrics RPCs into the Go Brain
+service, get_optimization_plan back out) and dlrover/go/brain/ (the
+MySQL-backed service itself).
+
+TPU-native redesign: the Brain's two jobs — persist job metrics beyond
+one master's lifetime, and answer "how should the NEXT run of this job
+be configured" — need a durable store and a query, not a standalone
+gRPC deployment. Both ride the pluggable state store (util/state_store
+.py): with the file backend the archive survives master restarts and is
+shared by every job on the reservation; the optimize query replays the
+archived speed-vs-worker-num samples of previous runs of the same job
+name and recommends the historically best worker count. The reporter
+seam (master/stats/reporter.py new_stats_reporter) keeps the reference's
+shape: reporter="brain" swaps persistence in without touching the
+collector.
+"""
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.stats.reporter import JobMeta, StatsReporter
+from dlrover_tpu.master.stats.training_metrics import (
+    DatasetMetric,
+    ModelMetric,
+    RuntimeMetric,
+    TrainingHyperParams,
+)
+from dlrover_tpu.util.state_store import StateBackend, build_state_store
+
+
+@dataclasses.dataclass
+class OptimizePlan:
+    """parity: the resource piece of brain_pb2.JobOptimizePlan."""
+
+    worker_num: int = 0
+    speed: float = 0.0  # expected steps/sec at that worker count
+    source_job: str = ""  # which archived run the plan came from
+
+
+class BrainClient:
+    """Durable job-metrics archive + history-driven optimization."""
+
+    def __init__(self, store: Optional[StateBackend] = None):
+        self._store = store or build_state_store()
+
+    # -- persist (parity: report_metrics RPCs) ---------------------------
+
+    def _key(self, job: JobMeta, kind: str) -> str:
+        return f"brain/{job.name or job.uuid}/{job.uuid}/{kind}"
+
+    def report_job_meta(self, job: JobMeta) -> None:
+        self._store.set(
+            self._key(job, "meta"),
+            {**dataclasses.asdict(job), "updated_at": time.time()},
+        )
+
+    def report_hyper_params(self, job: JobMeta,
+                            params: TrainingHyperParams) -> None:
+        self._store.set(
+            self._key(job, "hyper_params"), dataclasses.asdict(params)
+        )
+
+    def report_model_metric(self, job: JobMeta,
+                            metric: ModelMetric) -> None:
+        self._store.set(
+            self._key(job, "model"), dataclasses.asdict(metric)
+        )
+
+    def report_runtime_stats(self, job: JobMeta,
+                             stats: RuntimeMetric) -> None:
+        key = self._key(job, "runtime")
+        samples: List[Dict] = self._store.get(key, [])
+        samples.append({
+            "worker_num": stats.worker_num,
+            "global_step": stats.global_step,
+            "speed": stats.speed,
+            "timestamp": stats.timestamp,
+        })
+        self._store.set(key, samples[-500:])
+
+    def report_exit_reason(self, job: JobMeta, reason: str) -> None:
+        self._store.set(self._key(job, "exit"), {
+            "reason": reason, "timestamp": time.time(),
+        })
+
+    # -- query (parity: get_job_metrics / get_optimization_plan) ---------
+
+    def get_job_runs(self, job_name: str) -> List[str]:
+        """Archived run uuids of a job name, oldest first."""
+        runs = set()
+        for key in self._store.keys(f"brain/{job_name}/"):
+            parts = key.split("/")
+            if len(parts) >= 3:
+                runs.add(parts[2])
+        return sorted(runs)
+
+    def get_runtime_stats(self, job_name: str,
+                          uuid: str) -> List[Dict]:
+        return self._store.get(
+            f"brain/{job_name}/{uuid}/runtime", []
+        )
+
+    def get_optimization_plan(self, job_name: str) -> Optional[
+            OptimizePlan]:
+        """Recommend the historically fastest worker count across every
+        archived run of ``job_name`` (parity role: the Brain's
+        running-job optimize processor — reduced to the query our
+        speed-window optimizer needs for a warm start)."""
+        best: Optional[OptimizePlan] = None
+        for uuid in self.get_job_runs(job_name):
+            by_workers: Dict[int, List[float]] = {}
+            for s in self.get_runtime_stats(job_name, uuid):
+                if s.get("speed", 0) > 0 and s.get("worker_num", 0) > 0:
+                    by_workers.setdefault(
+                        s["worker_num"], []
+                    ).append(s["speed"])
+            for n, speeds in by_workers.items():
+                avg = sum(speeds) / len(speeds)
+                if best is None or avg > best.speed:
+                    best = OptimizePlan(
+                        worker_num=n, speed=avg, source_job=uuid
+                    )
+        if best:
+            logger.info(
+                "Brain plan for %s: %d workers (%.2f steps/s from %s)",
+                job_name, best.worker_num, best.speed, best.source_job,
+            )
+        return best
+
+
+class BrainReporter(StatsReporter):
+    """StatsReporter writing through the BrainClient archive (parity:
+    reporter.py's BrainReporter), so master restarts and future runs see
+    this job's history."""
+
+    def __init__(self, job_meta: JobMeta,
+                 client: Optional[BrainClient] = None):
+        super().__init__(job_meta)
+        self._client = client or BrainClient()
+        self._client.report_job_meta(job_meta)
+
+    def report_dataset_metric(self, metric: DatasetMetric):
+        self._client._store.set(
+            self._client._key(self._job_meta, "dataset"),
+            dataclasses.asdict(metric),
+        )
+
+    def report_training_hyper_params(self, params: TrainingHyperParams):
+        self._client.report_hyper_params(self._job_meta, params)
+
+    def report_model_metrics(self, metric: ModelMetric):
+        self._client.report_model_metric(self._job_meta, metric)
+
+    def report_runtime_stats(self, stats: RuntimeMetric):
+        self._client.report_runtime_stats(self._job_meta, stats)
+
+    def report_job_exit_reason(self, reason: str):
+        self._client.report_exit_reason(self._job_meta, reason)
+
+    def report_customized_data(self, data):
+        self._client._store.set(
+            self._client._key(self._job_meta, "custom"), data
+        )

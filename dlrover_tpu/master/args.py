@@ -37,4 +37,8 @@ def parse_master_args(argv=None):
     parser.add_argument("--job_spec", type=str, default="",
                         help="path to a declarative ElasticTpuJob "
                              "YAML/JSON spec (scheduler/job_spec.py)")
+    parser.add_argument("--brain_store_path", type=str, default="",
+                        help="directory for the durable cross-run "
+                             "stats archive (brain/client.py); enables "
+                             "warm-started resource plans")
     return parser.parse_args(argv)

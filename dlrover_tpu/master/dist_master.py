@@ -45,19 +45,39 @@ class DistributedJobMaster:
                  watcher=None, autoscale_interval: float = 60.0):
         self.speed_monitor = SpeedMonitor()
         self.error_monitor = ErrorMonitor()
+        job_name = getattr(job_args, "job_name", "") or "job"
         job_meta = JobMeta(
-            uuid=getattr(job_args, "job_name", "") or "job",
-            name=getattr(job_args, "job_name", "") or "job",
+            # unique per run: the brain archive groups runs by name and
+            # distinguishes them by uuid (brain/client.py _key)
+            uuid=f"{job_name}-{int(time.time())}",
+            name=job_name,
             namespace=getattr(job_args, "namespace", "default"),
         )
         self.stats_reporter = LocalStatsReporter(job_meta)
+        collector_reporter = self.stats_reporter
+        brain_client = None
+        if getattr(job_args, "brain_store_path", None):
+            # durable archive: collected stats tee into the brain store
+            # so future runs of this job warm-start from history
+            from dlrover_tpu.brain.client import BrainClient, BrainReporter
+            from dlrover_tpu.master.stats.reporter import TeeStatsReporter
+            from dlrover_tpu.util.state_store import build_state_store
+
+            brain_client = BrainClient(build_state_store(
+                "file", job_args.brain_store_path
+            ))
+            collector_reporter = TeeStatsReporter(job_meta, [
+                self.stats_reporter,
+                BrainReporter(job_meta, client=brain_client),
+            ])
         self.job_metric_collector = JobMetricCollector(
-            job_meta, reporter=self.stats_reporter
+            job_meta, reporter=collector_reporter
         )
         self.job_optimizer = TPULocalOptimizer(
             job_args=job_args, speed_monitor=self.speed_monitor,
             node_unit=getattr(job_args, "node_unit", 1) if job_args else 1,
             stats_reporter=self.stats_reporter,
+            brain_client=brain_client,
         )
         self.job_manager = create_job_manager(
             job_args, self.speed_monitor, scaler=scaler, watcher=watcher,
@@ -73,6 +93,11 @@ class DistributedJobMaster:
         self.auto_scaler = new_job_auto_scaler(
             self.job_manager, self.job_optimizer, scaler,
             interval=autoscale_interval,
+            # straggler shrink reads the network-check pairing verdicts
+            straggler_fn=self.rdzv_managers[
+                RendezvousName.NETWORK_CHECK
+            ].get_straggler_nodes,
+            min_nodes=getattr(job_args, "min_node_num", 0) or 0,
         )
         self._server, self.servicer = create_master_service(
             port,
@@ -142,11 +167,13 @@ class DistributedJobMaster:
                 if self.task_manager.finished():
                     logger.info("All data tasks done; stopping master")
                     self._exit_reason = JobExitReason.SUCCEEDED
+                    self._broadcast_stop(check_interval)
                     break
                 if self.job_manager.all_running_node_hanged():
                     logger.error("All nodes hang; failing the job")
                     self._exit_code = 1
                     self._exit_reason = JobExitReason.HANG_ERROR
+                    self._broadcast_stop(check_interval)
                     break
                 time.sleep(check_interval)
         except KeyboardInterrupt:
@@ -161,6 +188,16 @@ class DistributedJobMaster:
             self._exit_reason,
         )
         return self._exit_code
+
+    def _broadcast_stop(self, grace: float):
+        """Queue STOP heartbeat actions for live agents and hold the
+        servicer open one beat so they can collect them (best effort —
+        an agent between heartbeats just sees the channel drop)."""
+        try:
+            self.job_manager.request_stop_all()
+            time.sleep(grace)
+        except Exception as e:
+            logger.warning("stop broadcast failed: %s", e)
 
     def stop(self):
         self.auto_scaler.stop()

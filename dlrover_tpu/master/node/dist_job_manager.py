@@ -18,6 +18,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.constants import (
+    NodeAction,
     NodeEventType,
     NodeExitReason,
     NodeStatus,
@@ -67,6 +68,8 @@ class DistributedJobManager:
         # on_node_deleted, each f(node) (parity: event_callback.py)
         self._callbacks: Dict[str, List[Callable]] = {}
         self._threads: List[threading.Thread] = []
+        # (node_type, node_id) -> NodeAction, delivered on next heartbeat
+        self._pending_actions: Dict[tuple, str] = {}
 
     # -- lifecycle --------------------------------------------------------
 
@@ -232,7 +235,31 @@ class DistributedJobManager:
         node = self.get_node(node_type, node_id)
         if node is not None:
             node.heartbeat_time = ts or time.time()
-        return None
+        with self._lock:
+            action = self._pending_actions.pop((node_type, node_id), None)
+        if action and node is not None:
+            node.hang = False  # recovery is now in the agent's hands
+        return action
+
+    def handle_training_hang(self, node_type: str, node_id: int,
+                             message: str = ""):
+        """A worker's step-progress detector reported a hang: recycle the
+        training process via the agent, keeping the node RUNNING (parity
+        role: dist_job_manager.py:662 + diagnosis restart action).
+        The agent picks the action up on its next heartbeat — no
+        heartbeat loss, no relaunch-budget charge."""
+        node = self.get_node(node_type, node_id)
+        name = node.name if node else f"{node_type}-{node_id}"
+        logger.warning(
+            "Training hang reported by %s (%s) -> restart action",
+            name, message,
+        )
+        if node is not None:
+            node.hang = True
+        with self._lock:
+            self._pending_actions[(node_type, node_id)] = (
+                NodeAction.RESTART_WORKER
+            )
 
     def _monitor_heartbeats(self):
         """The watchdog only arms for nodes that have reported at least
@@ -271,6 +298,17 @@ class DistributedJobManager:
             self._maybe_relaunch(node)
         elif self._scaler:
             self._scaler.scale(ScalePlan(remove_nodes=[node]))
+
+    def request_stop_all(self):
+        """Queue a STOP action for every running node — delivered on
+        each agent's next heartbeat (best effort; used when the job
+        ends while workers are still alive, e.g. data exhausted or a
+        job-level hang verdict)."""
+        with self._lock:
+            for node in self.get_running_nodes():
+                self._pending_actions[(node.type, node.id)] = (
+                    NodeAction.STOP
+                )
 
     def all_running_node_hanged(self) -> bool:
         """Resource-stagnation hang signal (parity:

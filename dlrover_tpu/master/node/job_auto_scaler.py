@@ -28,11 +28,17 @@ class AllreduceTrainingAutoScaler:
         job_optimizer: ResourceOptimizer,
         scaler: Optional[Scaler] = None,
         interval: float = 60.0,
+        straggler_fn=None,
+        min_nodes: int = 0,
     ):
         self._job_manager = job_manager
         self._job_optimizer = job_optimizer
         self._scaler = scaler
         self._interval = interval
+        #: zero-arg callable -> straggler rank list (wired to the
+        #: network-check rendezvous manager by the master)
+        self._straggler_fn = straggler_fn
+        self._min_nodes = min_nodes
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -53,8 +59,45 @@ class AllreduceTrainingAutoScaler:
                 plan = self._job_optimizer.generate_job_resource_plan()
                 if plan and not plan.empty():
                     self.execute_job_optimization_plan(plan)
+                self._maybe_shrink_stragglers()
             except Exception as e:
                 logger.error("auto-scale iteration failed: %s", e)
+
+    def _maybe_shrink_stragglers(self):
+        """Straggler shrink off the network-check list (local_optimizer
+        generate_straggler_shrink_plan), evicting exactly the slow
+        ranks when the remaining world stays valid. Verdicts are
+        filtered against the LIVE world first — an already-evicted
+        straggler's stale verdict must not shrink healthy capacity —
+        and a executed shrink lowers the speed monitor's target so the
+        restore heuristic doesn't immediately re-grow the world
+        (shrink/regrow churn)."""
+        if self._straggler_fn is None or not hasattr(
+            self._job_optimizer, "generate_straggler_shrink_plan"
+        ):
+            return
+        mgr = self._job_manager._node_managers.get(NodeType.WORKER)
+        if mgr is None:
+            return
+        live = mgr.unfinished_nodes()
+        live_ranks = {n.rank_index for n in live}
+        stragglers = [
+            r for r in (self._straggler_fn() or []) if r in live_ranks
+        ]
+        if not stragglers:
+            return
+        plan = self._job_optimizer.generate_straggler_shrink_plan(
+            stragglers, len(live), min_nodes=self._min_nodes,
+        )
+        if plan and not plan.empty():
+            executed = self.execute_job_optimization_plan(plan)
+            monitor = getattr(
+                self._job_optimizer, "_speed_monitor", None
+            )
+            if executed.remove_nodes and monitor is not None:
+                monitor.reduce_target_worker_num(
+                    [(n.type, n.id) for n in executed.remove_nodes]
+                )
 
     def execute_job_optimization_plan(self, plan: ResourcePlan):
         """Diff the plan against current bookkeeping and scale. A plan
@@ -81,7 +124,12 @@ class AllreduceTrainingAutoScaler:
             want = group.count
             if want > have:
                 new_nodes = mgr.scale_up_nodes(
-                    want - have, group.node_resource
+                    want - have, group.node_resource,
+                    # replacements inherit the job's relaunch budget,
+                    # same as the initial fleet (dist_job_manager.start)
+                    max_relaunch_count=getattr(
+                        self._job_manager, "_max_relaunch_count", None
+                    ),
                 )
                 scale_plan.launch_nodes.extend(new_nodes)
             elif want < have:
@@ -99,8 +147,10 @@ class AllreduceTrainingAutoScaler:
 
 
 def new_job_auto_scaler(job_manager, job_optimizer, scaler=None,
-                        interval: float = 60.0):
+                        interval: float = 60.0, straggler_fn=None,
+                        min_nodes: int = 0):
     """parity: job_auto_scaler.py:40."""
     return AllreduceTrainingAutoScaler(
-        job_manager, job_optimizer, scaler, interval
+        job_manager, job_optimizer, scaler, interval,
+        straggler_fn=straggler_fn, min_nodes=min_nodes,
     )

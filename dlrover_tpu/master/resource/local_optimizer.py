@@ -41,21 +41,59 @@ MIN_SPEED_SAMPLES = 2
 
 class TPULocalOptimizer(ResourceOptimizer):
     def __init__(self, job_args=None, speed_monitor=None,
-                 node_unit: int = 1, stats_reporter=None):
+                 node_unit: int = 1, stats_reporter=None,
+                 brain_client=None):
         self._job_args = job_args
         self._speed_monitor = speed_monitor
         self._node_unit = max(1, node_unit)
         self._stats_reporter = stats_reporter
+        #: optional archive of previous runs (brain/client.py) for a
+        #: warm-started initial plan
+        self._brain_client = brain_client
 
     def init_job_resource(self, job_resource=None) -> ResourcePlan:
         plan = ResourcePlan(comment="initial")
         node_num = getattr(self._job_args, "node_num", 0) or 0
         resource = getattr(self._job_args, "node_resource", None)
+        node_num = self._brain_warm_start(node_num)
         if node_num:
             plan.node_group_resources[NodeType.WORKER] = (
                 NodeGroupResource(node_num, resource or NodeResource())
             )
         return plan
+
+    def _brain_warm_start(self, node_num: int) -> int:
+        """Start at the historically fastest worker count of previous
+        runs of this job when the archive knows better (parity role:
+        brain/client.py get_optimization_plan at job creation), bounded
+        by [min_nodes, max_nodes] and node_unit-aligned."""
+        if self._brain_client is None:
+            return node_num
+        job_name = getattr(self._job_args, "job_name", "") or ""
+        if not job_name:
+            return node_num
+        try:
+            hist = self._brain_client.get_optimization_plan(job_name)
+        except Exception as e:
+            logger.warning("brain warm start failed: %s", e)
+            return node_num
+        if hist is None or hist.worker_num <= 0:
+            return node_num
+        n = (hist.worker_num // self._node_unit) * self._node_unit
+        # JobArgs fields (scheduler/job_spec.py): min_node_num is the
+        # declared floor; node_num is the provisioned count and acts as
+        # the ceiling (warm start shrinks toward history, never grows
+        # past what the spec asked for)
+        lo = getattr(self._job_args, "min_node_num", 0) or 0
+        hi = node_num or n
+        n = max(lo, min(n, hi))
+        if n and n != node_num:
+            logger.info(
+                "Brain warm start: %d -> %d workers (history %s)",
+                node_num, n, hist.source_job,
+            )
+            return n
+        return node_num
 
     # -- speed-window scaling --------------------------------------------
 

@@ -59,11 +59,18 @@ class StatsReporter(ABC):
     @classmethod
     def new_stats_reporter(cls, job_meta: JobMeta,
                            reporter: str = "local") -> "StatsReporter":
-        """One reporter per job uuid (parity: new_stats_reporter:87)."""
+        """One reporter per job uuid (parity: new_stats_reporter:87).
+        ``local`` keeps stats in master memory; ``brain`` persists them
+        through the durable archive (brain/client.py BrainReporter)."""
         key = f"{reporter}/{job_meta.uuid}"
         with cls._lock:
             if key not in cls._reporters:
-                cls._reporters[key] = LocalStatsReporter(job_meta)
+                if reporter == "brain":
+                    from dlrover_tpu.brain.client import BrainReporter
+
+                    cls._reporters[key] = BrainReporter(job_meta)
+                else:
+                    cls._reporters[key] = LocalStatsReporter(job_meta)
             return cls._reporters[key]
 
 
@@ -113,3 +120,38 @@ class LocalStatsReporter(StatsReporter):
                 if rec.speed > 0 and rec.worker_num > 0:
                     out.setdefault(rec.worker_num, []).append(rec.speed)
         return out
+
+
+class TeeStatsReporter(StatsReporter):
+    """Fan one collector's reports out to several reporters (e.g. the
+    in-memory window the resource optimizer reads AND the durable brain
+    archive). A failing secondary never breaks the primary path."""
+
+    def __init__(self, job_meta: JobMeta, reporters: List[StatsReporter]):
+        super().__init__(job_meta)
+        self._targets = list(reporters)
+
+    def _fan(self, method: str, *args):
+        for r in self._targets:
+            try:
+                getattr(r, method)(*args)
+            except Exception:  # archive outage must not stop stats
+                pass
+
+    def report_dataset_metric(self, metric: DatasetMetric):
+        self._fan("report_dataset_metric", metric)
+
+    def report_training_hyper_params(self, params: TrainingHyperParams):
+        self._fan("report_training_hyper_params", params)
+
+    def report_model_metrics(self, metric: ModelMetric):
+        self._fan("report_model_metrics", metric)
+
+    def report_runtime_stats(self, stats: RuntimeMetric):
+        self._fan("report_runtime_stats", stats)
+
+    def report_job_exit_reason(self, reason: str):
+        self._fan("report_job_exit_reason", reason)
+
+    def report_customized_data(self, data):
+        self._fan("report_customized_data", data)

@@ -14,7 +14,12 @@ import queue
 import threading
 from typing import Dict, List, Optional
 
-from dlrover_tpu.common.constants import NodeEnv, NodeStatus, NodeType
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import Node
 from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
@@ -111,10 +116,17 @@ class TpuVmScaler(Scaler):
             attempts = getattr(node, "_create_attempts", 0) + 1
             node._create_attempts = attempts
             if attempts > MAX_CREATE_ATTEMPTS:
+                # surface the exhausted budget instead of leaving the
+                # node parked in INITIAL (which unfinished_nodes() would
+                # count as in-flight forever, masking the capacity gap
+                # from the reconcile loop and the resource optimizer)
                 logger.error(
                     "giving up creating %s after %d attempts", name,
                     attempts,
                 )
+                node.set_exit_reason(NodeExitReason.HARDWARE_ERROR)
+                node.update_status(NodeStatus.FAILED)
+                node.is_released = True
             else:
                 logger.warning("create %s failed; queued for retry", name)
                 self._create_queue.put(node)

@@ -1,0 +1,137 @@
+"""Pluggable job-state stores (M24).
+
+Parity reference: dlrover/python/util/state/store_mananger.py (StoreManager
++ MemoryStoreManager singletons), memory_store.py, stats_backend.py.
+
+Two backends: in-memory (tests / single master) and an atomic-rename
+file store (one JSON file per key) that survives master restarts — the
+persistence layer under the brain-shaped stats archive (brain/client.py)
+without requiring the reference's MySQL-backed Brain deployment.
+"""
+
+import json
+import os
+import threading
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+ENV_BACKEND = "DLROVER_STATE_BACKEND"
+
+
+class StateBackend(ABC):
+    """parity: the KV surface of memory_store.py / stats_backend.py."""
+
+    @abstractmethod
+    def set(self, key: str, value: Any) -> None: ...
+
+    @abstractmethod
+    def get(self, key: str, default: Any = None) -> Any: ...
+
+    @abstractmethod
+    def delete(self, key: str) -> None: ...
+
+    @abstractmethod
+    def keys(self, prefix: str = "") -> List[str]: ...
+
+
+class MemoryStore(StateBackend):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, Any] = {}
+
+    def set(self, key, value):
+        with self._lock:
+            self._data[key] = value
+
+    def get(self, key, default=None):
+        with self._lock:
+            return self._data.get(key, default)
+
+    def delete(self, key):
+        with self._lock:
+            self._data.pop(key, None)
+
+    def keys(self, prefix=""):
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+
+class FileStore(StateBackend):
+    """One JSON file per key under ``root``; writes are atomic
+    (tmp + rename) so a killed master never leaves a torn value.
+    Keys may contain '/' (mapped to subdirectories)."""
+
+    def __init__(self, root: str):
+        self._root = root
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = key.strip("/")
+        if ".." in safe.split("/"):
+            raise ValueError(f"invalid key {key!r}")
+        return os.path.join(self._root, safe + ".json")
+
+    def set(self, key, value):
+        path = self._path(key)
+        with self._lock:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(value, f)
+            os.replace(tmp, path)
+
+    def get(self, key, default=None):
+        try:
+            with open(self._path(key)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return default
+
+    def delete(self, key):
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+    def keys(self, prefix=""):
+        out = []
+        for dirpath, _, files in os.walk(self._root):
+            for name in files:
+                if not name.endswith(".json"):
+                    continue
+                rel = os.path.relpath(
+                    os.path.join(dirpath, name), self._root
+                )
+                key = rel[: -len(".json")].replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+
+_singletons: Dict[str, StateBackend] = {}
+_singleton_lock = threading.Lock()
+
+
+def build_state_store(
+    backend: Optional[str] = None, path: Optional[str] = None
+) -> StateBackend:
+    """Factory + per-(backend, path) singleton (parity:
+    StoreManager.build_store_manager / singleton_instance)."""
+    backend = backend or os.getenv(ENV_BACKEND, "memory")
+    key = f"{backend}:{path or ''}"
+    with _singleton_lock:
+        if key not in _singletons:
+            if backend == "memory":
+                _singletons[key] = MemoryStore()
+            elif backend == "file":
+                root = path or os.path.join(
+                    os.path.expanduser("~"), ".dlrover_tpu", "state"
+                )
+                _singletons[key] = FileStore(root)
+            else:
+                raise ValueError(f"unknown state backend {backend!r}")
+            logger.info("State store: %s", key)
+        return _singletons[key]

@@ -1,0 +1,186 @@
+"""M24 state store / event queue + M23 brain archive tests."""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.brain.client import BrainClient, BrainReporter
+from dlrover_tpu.master.stats.reporter import JobMeta, StatsReporter
+from dlrover_tpu.master.stats.training_metrics import (
+    RuntimeMetric,
+    TrainingHyperParams,
+)
+from dlrover_tpu.util.event_queue import EventQueue
+from dlrover_tpu.util.state_store import (
+    FileStore,
+    MemoryStore,
+    build_state_store,
+)
+
+
+class TestStateStore:
+    def test_memory_roundtrip(self):
+        s = MemoryStore()
+        s.set("a/b", {"x": 1})
+        assert s.get("a/b") == {"x": 1}
+        assert s.get("missing", 42) == 42
+        s.set("a/c", 2)
+        assert s.keys("a/") == ["a/b", "a/c"]
+        s.delete("a/b")
+        assert s.keys("a/") == ["a/c"]
+
+    def test_file_store_survives_reopen(self, tmp_path):
+        root = str(tmp_path / "state")
+        s = FileStore(root)
+        s.set("brain/job/run1/runtime", [{"speed": 2.5}])
+        s.set("brain/job/run2/runtime", [{"speed": 3.5}])
+        # a new instance (fresh master) sees the same data
+        s2 = FileStore(root)
+        assert s2.get("brain/job/run1/runtime") == [{"speed": 2.5}]
+        assert s2.keys("brain/job/") == [
+            "brain/job/run1/runtime", "brain/job/run2/runtime",
+        ]
+
+    def test_file_store_rejects_traversal(self, tmp_path):
+        s = FileStore(str(tmp_path))
+        with pytest.raises(ValueError):
+            s.set("../escape", 1)
+
+    def test_factory_singleton_and_env(self, tmp_path, monkeypatch):
+        a = build_state_store("memory")
+        b = build_state_store("memory")
+        assert a is b
+        f = build_state_store("file", str(tmp_path / "s"))
+        assert isinstance(f, FileStore)
+        with pytest.raises(ValueError):
+            build_state_store("mysql")
+
+
+class TestEventQueue:
+    def test_fifo_and_timeout(self):
+        q = EventQueue(max_size=3)
+        q.put(1)
+        q.put(2)
+        assert q.get(timeout=0.1) == 1
+        assert q.get(timeout=0.1) == 2
+        t0 = time.monotonic()
+        assert q.get(timeout=0.1) is None
+        assert time.monotonic() - t0 >= 0.1
+
+    def test_overflow_drops_oldest(self):
+        q = EventQueue(max_size=2)
+        for i in range(5):
+            q.put(i)
+        assert q.get(timeout=0.1) == 3
+        assert q.get(timeout=0.1) == 4
+
+    def test_blocking_get_wakes_on_put(self):
+        q = EventQueue()
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(q.get(timeout=5)), daemon=True
+        )
+        t.start()
+        time.sleep(0.05)
+        q.put("evt")
+        t.join(timeout=2)
+        assert got == ["evt"]
+
+
+class TestBrain:
+    def _meta(self, uuid, name="llama-job"):
+        return JobMeta(uuid=uuid, name=name, user="ci")
+
+    def test_archive_and_optimize_across_runs(self, tmp_path):
+        store = FileStore(str(tmp_path / "brain"))
+        client = BrainClient(store)
+        # run 1: 4 workers, slow; run 2: 8 workers, faster
+        for uuid, workers, speed in [
+            ("run1", 4, 1.5), ("run2", 8, 2.8),
+        ]:
+            meta = self._meta(uuid)
+            client.report_job_meta(meta)
+            for step in range(5):
+                client.report_runtime_stats(meta, RuntimeMetric(
+                    worker_num=workers, global_step=step,
+                    speed=speed, timestamp=float(step),
+                ))
+            client.report_exit_reason(meta, "Succeeded")
+        assert client.get_job_runs("llama-job") == ["run1", "run2"]
+        plan = client.get_optimization_plan("llama-job")
+        assert plan is not None
+        assert plan.worker_num == 8
+        assert plan.source_job == "run2"
+        # a fresh client over the same files (new master) agrees
+        plan2 = BrainClient(
+            FileStore(str(tmp_path / "brain"))
+        ).get_optimization_plan("llama-job")
+        assert plan2.worker_num == 8
+
+    def test_optimizer_warm_starts_from_archive(self, tmp_path):
+        """A new run of an archived job starts at the historically
+        fastest worker count (bounded + node_unit aligned)."""
+        from types import SimpleNamespace
+
+        from dlrover_tpu.common.constants import NodeType
+        from dlrover_tpu.master.resource.local_optimizer import (
+            TPULocalOptimizer,
+        )
+
+        store = FileStore(str(tmp_path / "brain"))
+        client = BrainClient(store)
+        meta = self._meta("old-run", name="warm-job")
+        for speed, workers in [(1.0, 2), (3.0, 6)]:
+            for step in range(3):
+                client.report_runtime_stats(meta, RuntimeMetric(
+                    worker_num=workers, global_step=step, speed=speed,
+                    timestamp=float(step),
+                ))
+        # configured 8; history says 6 was fastest -> shrink to 6
+        args = SimpleNamespace(
+            job_name="warm-job", node_num=8, min_node_num=2,
+        )
+        opt = TPULocalOptimizer(
+            job_args=args, node_unit=2, brain_client=client,
+        )
+        plan = opt.init_job_resource()
+        assert plan.node_group_resources[NodeType.WORKER].count == 6
+        # the declared floor wins over a smaller historical best
+        client2 = BrainClient(FileStore(str(tmp_path / "brain2")))
+        meta2 = self._meta("tiny-run", name="floor-job")
+        client2.report_runtime_stats(meta2, RuntimeMetric(
+            worker_num=2, global_step=1, speed=9.0, timestamp=1.0,
+        ))
+        args_floor = SimpleNamespace(
+            job_name="floor-job", node_num=8, min_node_num=4,
+        )
+        plan_f = TPULocalOptimizer(
+            job_args=args_floor, node_unit=2, brain_client=client2,
+        ).init_job_resource()
+        assert plan_f.node_group_resources[NodeType.WORKER].count == 4
+        # unknown job: config stands
+        args2 = SimpleNamespace(
+            job_name="never-seen", node_num=2, min_node_num=1,
+        )
+        opt2 = TPULocalOptimizer(
+            job_args=args2, node_unit=2, brain_client=client,
+        )
+        plan2 = opt2.init_job_resource()
+        assert plan2.node_group_resources[NodeType.WORKER].count == 2
+
+    def test_brain_reporter_via_seam(self, tmp_path):
+        """reporter='brain' plugs persistence in through the standard
+        new_stats_reporter seam."""
+        meta = self._meta("run-x", name="seam-job")
+        rep = StatsReporter.new_stats_reporter(meta, reporter="brain")
+        assert isinstance(rep, BrainReporter)
+        rep.report_training_hyper_params(
+            TrainingHyperParams(batch_size=8)
+        )
+        rep.report_runtime_stats(RuntimeMetric(
+            worker_num=2, global_step=10, speed=1.0, timestamp=1.0,
+        ))
+        client = BrainClient()  # same default (memory) store singleton
+        stats = client.get_runtime_stats("seam-job", "run-x")
+        assert stats and stats[0]["worker_num"] == 2
