@@ -1,0 +1,262 @@
+"""ElasticJob operator: the job-level reconcile loop (L0/G1).
+
+Parity reference: dlrover/go/operator/pkg/controllers/
+elasticjob_controller.go:85 (Reconcile — watch ElasticJob CRs, create
+the master pod, track job phase) and master.go (master pod template,
+relaunch on master failure).
+
+TPU-native redesign: there is no kube-apiserver between the operator
+and the fleet — the operator IS the control loop. It owns a registry of
+submitted ElasticTpuJob specs and reconciles each toward its desired
+state: ensure a live master (the master then runs the whole elastic
+job: rendezvous, fleet scaling, data sharding), relaunch a crashed
+master up to a budget (master HA — the reference gets this from the
+job controller recreating the master pod), track phase transitions
+Pending -> Running -> Succeeded/Failed, and honor suspend/resume/delete
+(suspend tears the master down but keeps the spec for resume). Master
+launching is pluggable: the default spawns ``dlrover_tpu.master.main
+--job_spec`` as a local subprocess; a TPU-VM launcher can provision a
+dedicated coordinator VM through the same seam.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.scheduler.job_spec import JobArgs
+
+
+class JobPhase:
+    """parity: ElasticJob.Status.Phase."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    SUSPENDED = "Suspended"
+    DELETED = "Deleted"
+
+
+class MasterHandle:
+    """What the operator needs from a running master process."""
+
+    def poll(self) -> Optional[int]:  # None while alive, else exit rc
+        raise NotImplementedError
+
+    def terminate(self) -> None:
+        raise NotImplementedError
+
+
+class SubprocessMasterHandle(MasterHandle):
+    def __init__(self, proc: subprocess.Popen, spec_path: str):
+        self._proc = proc
+        self._spec_path = spec_path
+
+    def poll(self):
+        return self._proc.poll()
+
+    def terminate(self, grace: float = 10.0):
+        if self._proc.poll() is not None:
+            return
+        self._proc.terminate()
+        try:
+            self._proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait()
+
+
+def launch_master_subprocess(spec_doc: Dict, job_name: str,
+                             extra_args=None) -> MasterHandle:
+    """Default master launcher: ``python -m dlrover_tpu.master.main
+    --job_spec <spec>`` (parity role: the master pod template,
+    master.go NewMasterTemplateToJob)."""
+    fd, path = tempfile.mkstemp(
+        prefix=f"dlrover-{job_name}-", suffix=".json"
+    )
+    with os.fdopen(fd, "w") as f:
+        json.dump(spec_doc, f)
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.master.main",
+        "--job_spec", path, "--job_name", job_name,
+    ] + list(extra_args or [])
+    proc = subprocess.Popen(cmd)
+    return SubprocessMasterHandle(proc, path)
+
+
+@dataclass
+class JobRecord:
+    name: str
+    spec_doc: Dict
+    phase: str = JobPhase.PENDING
+    master: Optional[MasterHandle] = None
+    master_restarts: int = 0
+    message: str = ""
+    updated_at: float = field(default_factory=time.time)
+
+    def set_phase(self, phase: str, message: str = ""):
+        if phase != self.phase:
+            logger.info(
+                "Job %s: %s -> %s %s", self.name, self.phase, phase,
+                message,
+            )
+        self.phase = phase
+        self.message = message
+        self.updated_at = time.time()
+
+
+class ElasticJobOperator:
+    """Reconciles submitted job specs toward running elastic jobs."""
+
+    def __init__(
+        self,
+        master_launcher: Callable[..., MasterHandle] =
+        launch_master_subprocess,
+        master_max_restarts: int = 3,
+        reconcile_interval: float = 2.0,
+    ):
+        self._launch = master_launcher
+        self._master_max_restarts = master_max_restarts
+        self._interval = reconcile_interval
+        self._jobs: Dict[str, JobRecord] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- API (the kubectl surface) ---------------------------------------
+
+    def submit(self, spec_doc: Dict, name: Optional[str] = None) -> str:
+        """Register a job (parity: creating the ElasticJob CR).
+        ``spec_doc`` is the declarative document job_spec.py parses."""
+        JobArgs.from_dict(spec_doc)  # validate early
+        name = name or spec_doc.get("metadata", {}).get("name") or (
+            f"job-{len(self._jobs)}"
+        )
+        with self._lock:
+            if name in self._jobs and self._jobs[name].phase not in (
+                JobPhase.DELETED,
+            ):
+                raise ValueError(f"job {name!r} already exists")
+            self._jobs[name] = JobRecord(name, spec_doc)
+        return name
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            job = self._jobs.get(name)
+        if job is None:
+            return
+        self._teardown(job)
+        job.set_phase(JobPhase.DELETED)
+
+    def suspend(self, name: str) -> None:
+        """parity: ElasticJob spec.suspend — stop the master (which
+        releases the fleet) but keep the spec for resume."""
+        job = self._jobs.get(name)
+        if job and job.phase == JobPhase.RUNNING:
+            self._teardown(job)
+            job.set_phase(JobPhase.SUSPENDED)
+
+    def resume(self, name: str) -> None:
+        job = self._jobs.get(name)
+        if job and job.phase == JobPhase.SUSPENDED:
+            job.master_restarts = 0
+            job.set_phase(JobPhase.PENDING)
+
+    def phase(self, name: str) -> Optional[str]:
+        job = self._jobs.get(name)
+        return job.phase if job else None
+
+    def status(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                name: {
+                    "phase": j.phase,
+                    "master_restarts": j.master_restarts,
+                    "message": j.message,
+                }
+                for name, j in self._jobs.items()
+            }
+
+    # -- control loop ----------------------------------------------------
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="elasticjob-operator"
+            )
+            self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for job in jobs:
+            self._teardown(job)
+
+    def _run(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                self.reconcile_once()
+            except Exception as e:
+                logger.error("operator reconcile failed: %s", e)
+
+    def reconcile_once(self):
+        """One pass over every job (parity: Reconcile per CR event —
+        polling replaces the apiserver watch)."""
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for job in jobs:
+            self._reconcile_job(job)
+
+    def _reconcile_job(self, job: JobRecord):
+        if job.phase == JobPhase.PENDING:
+            try:
+                job.master = self._launch(job.spec_doc, job.name)
+            except Exception as e:
+                job.set_phase(JobPhase.FAILED, f"master launch: {e}")
+                return
+            job.set_phase(JobPhase.RUNNING)
+            return
+        if job.phase != JobPhase.RUNNING or job.master is None:
+            return
+        rc = job.master.poll()
+        if rc is None:
+            return
+        if rc == 0:
+            job.set_phase(JobPhase.SUCCEEDED)
+        elif job.master_restarts < self._master_max_restarts:
+            # master HA: the job survives its coordinator crashing
+            # (workers keep training; agents reconnect with their
+            # retry loop once the new master is up)
+            job.master_restarts += 1
+            logger.warning(
+                "Job %s master exited rc=%d; relaunching (%d/%d)",
+                job.name, rc, job.master_restarts,
+                self._master_max_restarts,
+            )
+            try:
+                job.master = self._launch(job.spec_doc, job.name)
+            except Exception as e:
+                job.set_phase(JobPhase.FAILED, f"master relaunch: {e}")
+        else:
+            job.set_phase(
+                JobPhase.FAILED,
+                f"master exited rc={rc}; restart budget exhausted",
+            )
+
+    def _teardown(self, job: JobRecord):
+        if job.master is not None:
+            try:
+                job.master.terminate()
+            except Exception as e:
+                logger.warning(
+                    "terminating %s master failed: %s", job.name, e
+                )
+            job.master = None
