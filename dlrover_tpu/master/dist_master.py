@@ -175,6 +175,16 @@ class DistributedJobMaster:
                     self._exit_reason = JobExitReason.HANG_ERROR
                     self._broadcast_stop(check_interval)
                     break
+                if self.job_manager.is_job_failed():
+                    # critical-node fast-fail (dist_job_manager
+                    # mark_job_failed): don't limp at reduced capacity
+                    logger.error(
+                        "Job failed: %s", self.job_manager.failed_reason
+                    )
+                    self._exit_code = 1
+                    self._exit_reason = JobExitReason.UNKNOWN_ERROR
+                    self._broadcast_stop(check_interval)
+                    break
                 time.sleep(check_interval)
         except KeyboardInterrupt:
             logger.info("Master interrupted")

@@ -95,10 +95,18 @@ def run(args) -> int:
     return master.run()
 
 
+#: deliberate job failure (workers failed / critical node lost / hang
+#: verdict) — distinct from a master CRASH (python traceback rc=1,
+#: signals <0) so the operator fails the job instead of "HA"-relaunching
+#: a doomed run (scheduler/operator.py)
+JOB_FAILED_EXIT_CODE = 3
+
+
 def main(argv=None) -> int:
     args = parse_master_args(argv)
     logger.info("Starting master: %s", vars(args))
-    return run(args)
+    rc = run(args)
+    return JOB_FAILED_EXIT_CODE if rc else 0
 
 
 if __name__ == "__main__":

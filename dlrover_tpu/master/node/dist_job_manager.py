@@ -70,6 +70,13 @@ class DistributedJobManager:
         self._threads: List[threading.Thread] = []
         # (node_type, node_id) -> NodeAction, delivered on next heartbeat
         self._pending_actions: Dict[tuple, str] = {}
+        # critical-node fast-fail (parity: training_node.py:40-104
+        # critical marking + the job-failure path): set when a critical
+        # node is permanently lost; the master run loop fails the job
+        # instead of limping at reduced capacity
+        self._critical_worker_index: Dict[int, int] = dict(getattr(
+            job_args, "critical_worker_index", None) or {})
+        self._failed_reason: str = ""
 
     # -- lifecycle --------------------------------------------------------
 
@@ -86,6 +93,7 @@ class DistributedJobManager:
                 node_num, resource,
                 max_relaunch_count=self._max_relaunch_count,
             )
+            self._mark_critical_nodes(new_nodes)
             self._scaler.scale(ScalePlan(launch_nodes=new_nodes))
         if self._watcher is not None:
             t = threading.Thread(
@@ -198,8 +206,38 @@ class DistributedJobManager:
             return False
         return True
 
+    def _mark_critical_nodes(self, nodes: List[Node]):
+        for node in nodes:
+            budget = self._critical_worker_index.get(node.rank_index)
+            if budget is not None:
+                node.critical = True
+                node.max_relaunch_count = min(
+                    node.max_relaunch_count, budget
+                )
+
+    def mark_job_failed(self, reason: str):
+        if not self._failed_reason:
+            logger.error("Job failure: %s", reason)
+            self._failed_reason = reason
+
+    def is_job_failed(self) -> bool:
+        return bool(self._failed_reason)
+
+    @property
+    def failed_reason(self) -> str:
+        return self._failed_reason
+
     def _maybe_relaunch(self, node: Node):
         if not self._should_relaunch(node):
+            if node.critical and not node.is_released:
+                # a critical node that will not come back: fail fast
+                # instead of waiting out the remaining fleet
+                self.mark_job_failed(
+                    f"critical node {node.name} lost permanently "
+                    f"(reason {node.exit_reason}, "
+                    f"relaunches {node.relaunch_count}/"
+                    f"{node.max_relaunch_count})"
+                )
             return
         if (
             node.exit_reason == NodeExitReason.OOM
@@ -294,9 +332,10 @@ class DistributedJobManager:
         if self._speed_monitor:
             self._speed_monitor.remove_running_worker(node.type, node.id)
         self._fire("on_node_failed", node)
-        if relaunchable:
-            self._maybe_relaunch(node)
-        elif self._scaler:
+        # _maybe_relaunch re-checks; a declined CRITICAL node marks the
+        # job failed (fast-fail) inside it
+        self._maybe_relaunch(node)
+        if not relaunchable and self._scaler:
             self._scaler.scale(ScalePlan(remove_nodes=[node]))
 
     def request_stop_all(self):

@@ -56,6 +56,25 @@ def parse_memory_mb(value) -> int:
     return int(num * _MEM_UNITS[unit])
 
 
+def parse_critical_worker_index(value, max_relaunch: int,
+                                replicas: int) -> Dict[int, int]:
+    """parity: get_critical_worker_index (dlrover common/global_context
+    usage). ``"default"`` -> {0: max_relaunch}; ``"all"`` -> every rank;
+    ``"none"``/'' -> {}; else "rank:budget,rank:budget"."""
+    # YAML users naturally write true/false; honor both spellings
+    if value in ("", "none", None, False):
+        return {}
+    if value in ("default", True):
+        return {0: max_relaunch}
+    if value == "all":
+        return {i: max_relaunch for i in range(replicas)}
+    out: Dict[int, int] = {}
+    for part in str(value).split(","):
+        rank, _, budget = part.strip().partition(":")
+        out[int(rank)] = int(budget) if budget else max_relaunch
+    return out
+
+
 @dataclasses.dataclass
 class JobArgs:
     """Everything the master needs to run one elastic TPU job."""
@@ -81,6 +100,13 @@ class JobArgs:
     max_relaunch_count: int = 3
     worker_env: Dict[str, str] = dataclasses.field(default_factory=dict)
     worker_command: List[str] = dataclasses.field(default_factory=list)
+    # rank -> relaunch budget for nodes whose permanent loss fails the
+    # job fast (parity: critical_worker_index, training_node.py:40-104);
+    # rank 0 is critical by default for allreduce jobs (it owns
+    # checkpoint writes and the jax coordinator)
+    critical_worker_index: Dict[int, int] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def worker_group(self) -> NodeGroupResource:
@@ -122,6 +148,11 @@ class JobArgs:
             max_relaunch_count=int(worker.get("maxRelaunchCount", 3)),
             worker_env=dict(worker.get("env", {})),
             worker_command=list(worker.get("command", [])),
+            critical_worker_index=parse_critical_worker_index(
+                worker.get("criticalWorkerIndex", "default"),
+                int(worker.get("maxRelaunchCount", 3)),
+                int(worker.get("replicas", 1)),
+            ),
         )
         return args
 

@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.main import JOB_FAILED_EXIT_CODE
 from dlrover_tpu.scheduler.job_spec import JobArgs
 
 
@@ -59,18 +60,32 @@ class SubprocessMasterHandle(MasterHandle):
         self._proc = proc
         self._spec_path = spec_path
 
+    def _cleanup_spec(self):
+        if self._spec_path:
+            try:
+                os.unlink(self._spec_path)
+            except OSError:
+                pass
+            self._spec_path = ""
+
     def poll(self):
-        return self._proc.poll()
+        rc = self._proc.poll()
+        if rc is not None:
+            self._cleanup_spec()
+        return rc
 
     def terminate(self, grace: float = 10.0):
-        if self._proc.poll() is not None:
-            return
-        self._proc.terminate()
         try:
-            self._proc.wait(timeout=grace)
-        except subprocess.TimeoutExpired:
-            self._proc.kill()
-            self._proc.wait()
+            if self._proc.poll() is not None:
+                return
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait()
+        finally:
+            self._cleanup_spec()
 
 
 def launch_master_subprocess(spec_doc: Dict, job_name: str,
@@ -148,26 +163,31 @@ class ElasticJobOperator:
         return name
 
     def delete(self, name: str) -> None:
+        # the operator lock serializes phase transitions against the
+        # reconcile thread: without it, reconcile could observe the
+        # terminated master's rc and "HA"-relaunch a deleted job
         with self._lock:
             job = self._jobs.get(name)
-        if job is None:
-            return
-        self._teardown(job)
-        job.set_phase(JobPhase.DELETED)
+            if job is None:
+                return
+            self._teardown(job)
+            job.set_phase(JobPhase.DELETED)
 
     def suspend(self, name: str) -> None:
         """parity: ElasticJob spec.suspend — stop the master (which
         releases the fleet) but keep the spec for resume."""
-        job = self._jobs.get(name)
-        if job and job.phase == JobPhase.RUNNING:
-            self._teardown(job)
-            job.set_phase(JobPhase.SUSPENDED)
+        with self._lock:
+            job = self._jobs.get(name)
+            if job and job.phase == JobPhase.RUNNING:
+                self._teardown(job)
+                job.set_phase(JobPhase.SUSPENDED)
 
     def resume(self, name: str) -> None:
-        job = self._jobs.get(name)
-        if job and job.phase == JobPhase.SUSPENDED:
-            job.master_restarts = 0
-            job.set_phase(JobPhase.PENDING)
+        with self._lock:
+            job = self._jobs.get(name)
+            if job and job.phase == JobPhase.SUSPENDED:
+                job.master_restarts = 0
+                job.set_phase(JobPhase.PENDING)
 
     def phase(self, name: str) -> Optional[str]:
         job = self._jobs.get(name)
@@ -195,10 +215,12 @@ class ElasticJobOperator:
 
     def stop(self):
         self._stopped.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2 * self._interval + 5)
         with self._lock:
-            jobs = list(self._jobs.values())
-        for job in jobs:
-            self._teardown(job)
+            for job in self._jobs.values():
+                self._teardown(job)
 
     def _run(self):
         while not self._stopped.wait(self._interval):
@@ -209,11 +231,12 @@ class ElasticJobOperator:
 
     def reconcile_once(self):
         """One pass over every job (parity: Reconcile per CR event —
-        polling replaces the apiserver watch)."""
+        polling replaces the apiserver watch). Runs under the operator
+        lock so suspend/delete/stop cannot interleave with a relaunch
+        decision."""
         with self._lock:
-            jobs = list(self._jobs.values())
-        for job in jobs:
-            self._reconcile_job(job)
+            for job in list(self._jobs.values()):
+                self._reconcile_job(job)
 
     def _reconcile_job(self, job: JobRecord):
         if job.phase == JobPhase.PENDING:
@@ -231,6 +254,13 @@ class ElasticJobOperator:
             return
         if rc == 0:
             job.set_phase(JobPhase.SUCCEEDED)
+        elif rc == JOB_FAILED_EXIT_CODE:
+            # the master DELIBERATELY failed the job (workers failed,
+            # critical node lost, hang verdict): terminal — relaunching
+            # would rerun a doomed job (master HA is for crashes only)
+            job.set_phase(
+                JobPhase.FAILED, f"job failed (master rc={rc})"
+            )
         elif job.master_restarts < self._master_max_restarts:
             # master HA: the job survives its coordinator crashing
             # (workers keep training; agents reconnect with their

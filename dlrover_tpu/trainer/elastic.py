@@ -116,6 +116,11 @@ class ElasticTrainer:
         )
 
         self._fault_injector = FaultInjector.from_env(self._master_client)
+        # zero-code timeline capture (DLROVER_TRACE_DIR): see
+        # trainer/profiler.py TraceCapture
+        from dlrover_tpu.trainer.profiler import TraceCapture
+
+        self._trace_capture = TraceCapture.from_env()
         if self._master_client is None:
             return
         if hang_detection is None:
@@ -220,6 +225,8 @@ class ElasticTrainer:
         )
         if self._hang_detector is not None:
             self._hang_detector.record_step(self._global_step)
+        if self._trace_capture is not None:
+            self._trace_capture.step(self._global_step)
         if self._fault_injector is not None:
             self._fault_injector.maybe_inject(self._global_step)
         if (

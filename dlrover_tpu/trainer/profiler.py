@@ -139,3 +139,73 @@ def report_profile(master_client, prof: StepProfile,
     except Exception as e:
         logger.warning("report_model_info failed: %s", e)
         return False
+
+
+class TraceCapture:
+    """Timeline capture around training steps (parity role: AProfiler's
+    timeline export, atorch/atorch/utils/prof.py, and the reference's
+    torch-profiler trace dumps): wraps ``jax.profiler`` so a window of
+    steps lands in a TensorBoard-loadable trace directory.
+
+    Usage::
+
+        with TraceCapture("/tmp/trace", start_step=10, num_steps=3) as tc:
+            for step in range(100):
+                run_step()
+                tc.step(step)
+
+    Or drive it manually with start()/stop(). Env trigger for zero-code
+    capture: DLROVER_TRACE_DIR [+ DLROVER_TRACE_START/_STEPS].
+    """
+
+    def __init__(self, trace_dir: str, start_step: int = 1,
+                 num_steps: int = 3):
+        self._dir = trace_dir
+        self._start = start_step
+        self._stop_after = start_step + num_steps
+        self._active = False
+
+    @classmethod
+    def from_env(cls) -> "TraceCapture | None":
+        import os
+
+        trace_dir = os.environ.get("DLROVER_TRACE_DIR", "")
+        if not trace_dir:
+            return None
+        return cls(
+            trace_dir,
+            start_step=int(os.environ.get("DLROVER_TRACE_START", "1")),
+            num_steps=int(os.environ.get("DLROVER_TRACE_STEPS", "3")),
+        )
+
+    def start(self):
+        if not self._active:
+            import atexit
+
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+            # a window still open when the process ends (short run,
+            # restart action mid-window) must still flush the trace
+            atexit.register(self.stop)
+            logger.info("Trace capture started -> %s", self._dir)
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            logger.info("Trace capture written to %s", self._dir)
+
+    def step(self, step: int):
+        """Call once per completed step; starts/stops the window."""
+        if step >= self._start and not self._active and (
+                step < self._stop_after):
+            self.start()
+        elif step >= self._stop_after and self._active:
+            self.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,123 @@
+"""Critical-node marking + fast job-fail (M6 parity:
+training_node.py:40-104 + the job-failure path)."""
+
+from types import SimpleNamespace
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.node.dist_job_manager import (
+    DistributedJobManager,
+)
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.scheduler.job_spec import (
+    JobArgs,
+    parse_critical_worker_index,
+)
+
+
+class RecordingScaler(Scaler):
+    def __init__(self):
+        self.plans = []
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def scale(self, plan: ScalePlan):
+        self.plans.append(plan)
+
+
+def test_parse_critical_worker_index():
+    assert parse_critical_worker_index("default", 3, 4) == {0: 3}
+    assert parse_critical_worker_index("all", 2, 3) == {
+        0: 2, 1: 2, 2: 2,
+    }
+    assert parse_critical_worker_index("none", 3, 4) == {}
+    assert parse_critical_worker_index("0:1,2:5", 3, 4) == {0: 1, 2: 5}
+    assert parse_critical_worker_index("1", 3, 4) == {1: 3}
+
+
+def test_spec_parses_critical_index():
+    args = JobArgs.from_dict({
+        "spec": {"worker": {
+            "replicas": 4, "maxRelaunchCount": 2,
+            "criticalWorkerIndex": "0:1",
+        }},
+    })
+    assert args.critical_worker_index == {0: 1}
+    # default: rank 0 critical with the full budget
+    args2 = JobArgs.from_dict({"spec": {"worker": {"replicas": 2}}})
+    assert args2.critical_worker_index == {0: 3}
+
+
+def _manager(critical_index):
+    scaler = RecordingScaler()
+    args = SimpleNamespace(
+        node_num=2, node_resource=NodeResource(),
+        max_relaunch_count=1, relaunch_always=False,
+        critical_worker_index=critical_index,
+    )
+    mgr = DistributedJobManager(job_args=args, scaler=scaler)
+    # start() without threads: do the scale-up part inline
+    nodes = mgr._node_managers[NodeType.WORKER].scale_up_nodes(
+        2, NodeResource(), max_relaunch_count=1,
+    )
+    mgr._mark_critical_nodes(nodes)
+    return mgr, scaler, nodes
+
+
+def _fail_node(mgr, node, reason=NodeExitReason.FATAL_ERROR):
+    from dlrover_tpu.master.watcher.base_watcher import NodeEvent
+
+    failed = Node(node.type, node.id, status=NodeStatus.FAILED,
+                  name=node.name)
+    failed.exit_reason = reason
+    # drive through the status flow: INITIAL -> RUNNING -> FAILED
+    mgr.process_event(NodeEvent(
+        NodeEventType.MODIFIED,
+        Node(node.type, node.id, status=NodeStatus.RUNNING,
+             name=node.name),
+    ))
+    mgr.process_event(NodeEvent(NodeEventType.MODIFIED, failed))
+
+
+def test_critical_node_fatal_error_fails_job():
+    mgr, _, nodes = _manager({0: 1})
+    assert nodes[0].critical and not nodes[1].critical
+    _fail_node(mgr, nodes[0], NodeExitReason.FATAL_ERROR)
+    assert mgr.is_job_failed()
+    assert "critical" in mgr.failed_reason
+
+
+def test_non_critical_node_loss_does_not_fail_job():
+    mgr, _, nodes = _manager({0: 1})
+    _fail_node(mgr, nodes[1], NodeExitReason.FATAL_ERROR)
+    assert not mgr.is_job_failed()
+
+
+def test_critical_node_relaunchable_failure_relaunches_not_fails():
+    """A recoverable failure of a critical node relaunches it (with
+    criticality carried to the replacement), job keeps running."""
+    mgr, scaler, nodes = _manager({0: 1})
+    _fail_node(mgr, nodes[0], NodeExitReason.KILLED)
+    assert not mgr.is_job_failed()
+    launched = [
+        n for p in scaler.plans for n in p.launch_nodes
+        if n.rank_index == 0
+    ]
+    assert launched and launched[-1].critical
+    # the replacement's permanent loss now fails the job
+    _fail_node(mgr, launched[-1], NodeExitReason.FATAL_ERROR)
+    assert mgr.is_job_failed()
+
+
+def test_parse_critical_worker_index_yaml_booleans():
+    assert parse_critical_worker_index(False, 3, 4) == {}
+    assert parse_critical_worker_index(True, 3, 4) == {0: 3}

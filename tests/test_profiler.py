@@ -89,3 +89,37 @@ def test_elastic_trainer_reports_profile_to_master():
         client.close()
     finally:
         master._server.stop(grace=0.5)
+
+
+def test_trace_capture_writes_timeline(tmp_path):
+    """TraceCapture (trainer/profiler.py) wraps jax.profiler into a
+    step-windowed TensorBoard trace (parity role: AProfiler timeline
+    export)."""
+    import glob
+    import os
+
+    import jax.numpy as jnp
+
+    from dlrover_tpu.trainer.profiler import TraceCapture
+
+    trace_dir = str(tmp_path / "trace")
+    with TraceCapture(trace_dir, start_step=2, num_steps=2) as tc:
+        x = jnp.ones((8, 8))
+        for step in range(1, 6):
+            x = (x @ x).block_until_ready()
+            tc.step(step)
+    files = glob.glob(
+        os.path.join(trace_dir, "**", "*"), recursive=True
+    )
+    assert any(os.path.isfile(f) for f in files), files
+
+
+def test_trace_capture_from_env(monkeypatch, tmp_path):
+    from dlrover_tpu.trainer.profiler import TraceCapture
+
+    monkeypatch.delenv("DLROVER_TRACE_DIR", raising=False)
+    assert TraceCapture.from_env() is None
+    monkeypatch.setenv("DLROVER_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("DLROVER_TRACE_STEPS", "5")
+    tc = TraceCapture.from_env()
+    assert tc is not None and tc._stop_after == 6
