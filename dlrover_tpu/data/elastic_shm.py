@@ -122,4 +122,11 @@ class ElasticShmDataLoader:
         return iter(self._prefetch)
 
     def shutdown(self):
+        # order matters: EOF the ring so the prefetch thread's pop()
+        # returns, JOIN it, and only then unmap/destroy the ring — the
+        # thread shares this process's mapping and unmapping under a
+        # live pop() is a SIGSEGV (observed in the llama system e2e
+        # with never-ending producers)
+        self._loader.close()
+        self._prefetch.join()
         self._loader.shutdown()

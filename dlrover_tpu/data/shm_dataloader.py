@@ -96,6 +96,10 @@ class ShmDataLoader:
             except RingClosed:
                 return
 
+    def close(self):
+        """EOF the ring: blocked consumers drain and see RingClosed."""
+        self._ring.close()
+
     def shutdown(self):
         self._ring.close()
         for p in self._procs:
@@ -138,3 +142,23 @@ class DevicePrefetch:
             if item is self._done:
                 return
             yield item
+
+    def join(self, timeout: float = 10.0) -> None:
+        """Wait for the fill thread to exit (it does once the source
+        iterator ends, e.g. after the shm ring is closed). MUST be
+        called before destroying a ring this prefetcher reads: pop()
+        runs in this thread against the ring's mapping, and unmapping
+        under it is a native crash, not an exception. Drains the queue
+        while waiting so a fill thread blocked in put() (consumer
+        stopped early) can reach the source's EOF."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while self._thread.is_alive():
+            if _time.monotonic() > deadline:
+                return
+            try:
+                self._queue.get_nowait()
+            except Exception:
+                pass
+            self._thread.join(timeout=0.05)

@@ -89,3 +89,33 @@ def test_hang_detected_and_worker_restarted():
         combined = proc.stdout + proc.stderr
         assert "heartbeat lost" not in combined
         assert "restart" in combined.lower()
+
+
+def test_llama_system_e2e_with_shm_data_plane():
+    """SURVEY §4(c): the single-host system test on a REAL model — the
+    flagship workload (examples/llama_train.py: rendezvous -> master
+    dataset sharding -> coworker shm producers -> DevicePrefetch ->
+    ShardedTrainer -> flash checkpoint) under the elastic launcher."""
+    with tempfile.TemporaryDirectory() as tmp:
+        out_file = os.path.join(tmp, "result.txt")
+        cmd = [
+            sys.executable, "-m", "dlrover_tpu.trainer.elastic_run",
+            "--standalone", "--nnodes", "1:1",
+            "--monitor_interval", "0.3",
+            os.path.join(REPO, "examples", "llama_train.py"), "--",
+            "--steps", "6", "--batch-size", "8", "--seq-len", "32",
+            "--num-workers", "2",
+            "--ckpt-dir", os.path.join(tmp, "ckpt"),
+            "--out", out_file,
+        ]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            cmd, cwd=REPO, env=env, timeout=300,
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        step, loss, start = open(out_file).read().split(",")
+        assert int(step) == 6
+        assert float(loss) > 0 and float(loss) < 50
+        assert int(start) == 0
