@@ -22,24 +22,6 @@ import numpy as np
 
 BASELINE_HFU_PERCENT = 49.6
 
-# peak dense bf16 TFLOP/s per chip by TPU generation
-PEAK_TFLOPS = {
-    "v4": 275.0,
-    "v5e": 197.0,
-    "v5lite": 197.0,  # device_kind "TPU v5 lite"
-    "v5p": 459.0,
-    "v6e": 918.0,
-    "v6": 918.0,
-}
-
-
-def peak_flops_per_chip(device) -> float:
-    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    for key, tf in PEAK_TFLOPS.items():
-        if key in kind:
-            return tf * 1e12
-    return 459.0 * 1e12  # assume v5p (the BASELINE.json target platform)
-
 
 class _BenchProducer:
     """Module-level (spawn-picklable) synthetic batch stream for the
@@ -65,6 +47,7 @@ def main():
 
     import optax
 
+    from dlrover_tpu.auto.device_context import peak_flops_per_chip
     from dlrover_tpu.models import llama
     from dlrover_tpu.parallel.mesh import create_mesh
     from dlrover_tpu.trainer.sharded import make_trainer_for_llama

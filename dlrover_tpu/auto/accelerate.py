@@ -49,12 +49,9 @@ class AccelerateResult:
 
 
 def _device_hbm_bytes(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    if "lite" in kind or "v5e" in kind:
-        return 16e9
-    if "v4" in kind:
-        return 32e9
-    return 95e9  # v5p/v6e class
+    from dlrover_tpu.auto.device_context import hbm_bytes_per_chip
+
+    return hbm_bytes_per_chip(device)
 
 
 def build_trainer(cfg, strategy: Strategy, devices=None,

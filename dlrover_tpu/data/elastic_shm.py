@@ -126,7 +126,16 @@ class ElasticShmDataLoader:
         # returns, JOIN it, and only then unmap/destroy the ring — the
         # thread shares this process's mapping and unmapping under a
         # live pop() is a SIGSEGV (observed in the llama system e2e
-        # with never-ending producers)
+        # with never-ending producers). Idempotent; if the fill thread
+        # won't die in time, leak the segment rather than crash.
+        if getattr(self, "_shut", False):
+            return
+        self._shut = True
         self._loader.close()
-        self._prefetch.join()
-        self._loader.shutdown()
+        joined = self._prefetch.join()
+        if not joined:
+            logger.error(
+                "prefetch thread still alive at shutdown; leaking the "
+                "shm ring instead of unmapping under it"
+            )
+        self._loader.shutdown(destroy=joined)

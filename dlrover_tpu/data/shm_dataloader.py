@@ -100,12 +100,24 @@ class ShmDataLoader:
         """EOF the ring: blocked consumers drain and see RingClosed."""
         self._ring.close()
 
-    def shutdown(self):
+    def shutdown(self, destroy: bool = True):
         self._ring.close()
         for p in self._procs:
             if p.is_alive():
                 p.terminate()
-        self._ring.destroy()
+        for p in self._procs:
+            p.join(timeout=5.0)
+        # the watcher thread calls ring.close() after the producers
+        # exit; let it finish before unmapping the ring under it
+        self._watcher.join(timeout=10.0)
+        if destroy:
+            if self._watcher.is_alive():
+                logger.error(
+                    "shm watcher still alive; leaking ring %s instead "
+                    "of unmapping under a live thread", self._ring.name,
+                )
+                return
+            self._ring.destroy()
 
 
 class DevicePrefetch:
@@ -137,28 +149,40 @@ class DevicePrefetch:
             self._queue.put(self._done)
 
     def __iter__(self):
+        from queue import Empty
+
         while True:
-            item = self._queue.get()
+            try:
+                item = self._queue.get(timeout=0.5)
+            except Empty:
+                # resilient to a swallowed _done sentinel (join()'s
+                # drain) — a dead fill thread means the stream is over
+                if not self._thread.is_alive():
+                    return
+                continue
             if item is self._done:
                 return
             yield item
 
-    def join(self, timeout: float = 10.0) -> None:
+    def join(self, timeout: float = 10.0) -> bool:
         """Wait for the fill thread to exit (it does once the source
         iterator ends, e.g. after the shm ring is closed). MUST be
         called before destroying a ring this prefetcher reads: pop()
         runs in this thread against the ring's mapping, and unmapping
         under it is a native crash, not an exception. Drains the queue
         while waiting so a fill thread blocked in put() (consumer
-        stopped early) can reach the source's EOF."""
+        stopped early) can reach the source's EOF. Returns False if the
+        thread is still alive at the deadline — the caller must then
+        NOT unmap the source."""
         import time as _time
 
         deadline = _time.monotonic() + timeout
         while self._thread.is_alive():
             if _time.monotonic() > deadline:
-                return
+                return False
             try:
                 self._queue.get_nowait()
             except Exception:
                 pass
             self._thread.join(timeout=0.05)
+        return True

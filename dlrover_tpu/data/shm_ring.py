@@ -165,8 +165,10 @@ class ShmRing:
         return max(0, self._lib.shm_ring_size(self._handle))
 
     def close(self):
-        """Signal EOF to consumers (drain then RingClosed)."""
-        self._lib.shm_ring_close(self._handle)
+        """Signal EOF to consumers (drain then RingClosed). No-op after
+        destroy: shm_ring_close(NULL) would be a native NULL deref."""
+        if self._handle:
+            self._lib.shm_ring_close(self._handle)
 
     def destroy(self):
         if self._handle:

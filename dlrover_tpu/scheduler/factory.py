@@ -53,6 +53,31 @@ def build_platform(
         )
         watcher = TpuVmWatcher(job_name, api)
         return scaler, watcher
+    if platform == "gke":
+        from dlrover_tpu.scheduler.gke import (
+            FakeK8sApi,
+            GkePodScaler,
+            GkePodWatcher,
+        )
+
+        if os.getenv("DLROVER_TPU_FAKE_PLATFORM") == "1":
+            logger.info("gke platform using FAKE pod API")
+            api = FakeK8sApi(auto_running=True)
+        else:
+            # the K8sApi seam is where a kubernetes-client implementation
+            # plugs in; this image ships none, so fleet automation is
+            # fake-only (agents on real clusters start via the operator
+            # pod template instead)
+            logger.warning(
+                "gke platform requires a kubernetes client "
+                "(set DLROVER_TPU_FAKE_PLATFORM=1 for the fake fleet)"
+            )
+            return None, None
+        scaler = GkePodScaler(
+            job_name, api, master_addr,
+            worker_env=dict(getattr(job_args, "worker_env", {}) or {}),
+        )
+        return scaler, GkePodWatcher(job_name, api)
     if platform == "process":
         from dlrover_tpu.master.scaler.process_scaler import ProcessScaler
 

@@ -1,0 +1,378 @@
+"""GKE pod platform: k8s pod scaler + watcher behind the Scaler/Watcher
+ABCs (the reference's primary platform shape).
+
+Parity reference: dlrover/python/master/scaler/pod_scaler.py:71
+(PodScaler, _create_pod:343 — pod spec with NodeEnv injected, retry
+creation thread), dlrover/python/master/watcher/k8s_watcher.py:49,130
+(PodWatcher + _get_pod_exit_reason mapping OOMKilled/exit codes), and
+dlrover/python/scheduler/kubernetes.py:84 (k8sClient).
+
+TPU shape: on GKE a worker is a pod bound to a TPU node pool
+(`google.com/tpu` resources + nodeSelector for the slice topology).
+The master mutates pods through a minimal ``K8sApi`` seam —
+``FakeK8sApi`` for tests (the reference's mocked-client pattern) and a
+REST-backed client for real clusters; pod phases and container exit
+codes map onto the Node status/exit-reason model:
+
+  Pending                      -> PENDING
+  Running                      -> RUNNING
+  Succeeded                    -> SUCCEEDED
+  Failed + exit 137 / OOMKilled -> FAILED, exit OOM (grow memory)
+  Failed + preemption/eviction  -> FAILED, exit PREEMPTED (relaunch)
+  Failed + exit 1              -> FAILED, exit FATAL_ERROR (no relaunch)
+  Failed otherwise             -> FAILED, exit KILLED (relaunch)
+  deleted                      -> DELETED
+"""
+
+import queue
+import threading
+from abc import ABC, abstractmethod
+from typing import Dict, Iterator, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.master.watcher.base_watcher import NodeEvent, NodeWatcher
+
+MAX_CREATE_ATTEMPTS = 5
+
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class PodRecord(dict):
+    """Minimal pod view: name, phase, labels, env, exit_code, reason."""
+
+    @property
+    def name(self) -> str:
+        return self["name"]
+
+    @property
+    def phase(self) -> str:
+        return self.get("phase", PodPhase.PENDING)
+
+
+class K8sApi(ABC):
+    """parity: scheduler/kubernetes.py:84 k8sClient (pods subset)."""
+
+    @abstractmethod
+    def create_pod(self, name: str, labels: Dict[str, str],
+                   env: Dict[str, str], resource) -> bool: ...
+
+    @abstractmethod
+    def delete_pod(self, name: str) -> bool: ...
+
+    @abstractmethod
+    def list_pods(self) -> List[PodRecord]: ...
+
+    def get_pod(self, name: str) -> Optional[PodRecord]:
+        for rec in self.list_pods():
+            if rec.name == name:
+                return rec
+        return None
+
+
+class FakeK8sApi(K8sApi):
+    """In-memory pod fleet with explicit lifecycle + fault helpers
+    (parity: the reference's mocked k8s client, test_pod_scaler.py)."""
+
+    def __init__(self, auto_running: bool = False):
+        self._pods: Dict[str, PodRecord] = {}
+        self._lock = threading.Lock()
+        self._auto_running = auto_running
+        self.create_calls = 0
+        self.fail_creates = 0  # fail the next N create calls
+
+    def create_pod(self, name, labels, env, resource) -> bool:
+        with self._lock:
+            self.create_calls += 1
+            if self.fail_creates > 0:
+                self.fail_creates -= 1
+                return False
+            self._pods[name] = PodRecord(
+                name=name,
+                phase=(
+                    PodPhase.RUNNING if self._auto_running
+                    else PodPhase.PENDING
+                ),
+                labels=dict(labels), env=dict(env),
+            )
+            return True
+
+    def delete_pod(self, name) -> bool:
+        with self._lock:
+            return self._pods.pop(name, None) is not None
+
+    def list_pods(self) -> List[PodRecord]:
+        with self._lock:
+            return [PodRecord(p) for p in self._pods.values()]
+
+    # -- test levers ------------------------------------------------------
+
+    def tick(self):
+        """Pending pods get scheduled and start Running."""
+        with self._lock:
+            for p in self._pods.values():
+                if p.phase == PodPhase.PENDING:
+                    p["phase"] = PodPhase.RUNNING
+
+    def oom_kill(self, name: str):
+        with self._lock:
+            p = self._pods[name]
+            p["phase"] = PodPhase.FAILED
+            p["exit_code"] = 137
+            p["reason"] = "OOMKilled"
+
+    def evict(self, name: str):
+        """Node-pressure / spot preemption eviction."""
+        with self._lock:
+            p = self._pods[name]
+            p["phase"] = PodPhase.FAILED
+            p["exit_code"] = 143
+            p["reason"] = "Preempting"
+
+    def crash(self, name: str, exit_code: int = 1):
+        with self._lock:
+            p = self._pods[name]
+            p["phase"] = PodPhase.FAILED
+            p["exit_code"] = exit_code
+
+    def succeed(self, name: str):
+        with self._lock:
+            self._pods[name]["phase"] = PodPhase.SUCCEEDED
+
+
+def pod_name(job_name: str, node_type: str, node_id: int) -> str:
+    return f"{job_name}-{node_type}-{node_id}"
+
+
+class GkePodScaler(Scaler):
+    """ScalePlan -> pod mutations (parity: pod_scaler.py:71, with the
+    same shape as TpuVmScaler: direct mutations + count reconcile +
+    bounded create retries)."""
+
+    def __init__(self, job_name: str, api: K8sApi, master_addr: str,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 retry_interval: float = 15.0):
+        super().__init__(job_name)
+        self._api = api
+        self._master_addr = master_addr
+        self._worker_env = dict(worker_env or {})
+        self._retry_interval = retry_interval
+        self._create_queue: "queue.Queue[Node]" = queue.Queue()
+        self._stopped = threading.Event()
+        self._retry_thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._retry_thread = threading.Thread(
+            target=self._drain_retries, daemon=True,
+            name="pod-create-retry",
+        )
+        self._retry_thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def scale(self, plan: ScalePlan):
+        for node in plan.launch_nodes:
+            self._launch(node)
+        for node in plan.remove_nodes:
+            self._remove(node)
+        for node_type, group in plan.node_group_resources.items():
+            self._reconcile(node_type, group.count)
+
+    # -- internals --------------------------------------------------------
+
+    def _env(self, node: Node) -> Dict[str, str]:
+        env = {
+            NodeEnv.MASTER_ADDR: self._master_addr,
+            NodeEnv.JOB_NAME: self._job_name,
+            NodeEnv.NODE_TYPE: node.type,
+            NodeEnv.NODE_ID: str(node.id),
+            NodeEnv.NODE_RANK: str(node.rank_index),
+            NodeEnv.RESTART_COUNT: str(node.relaunch_count),
+        }
+        env.update(self._worker_env)
+        return env
+
+    def _labels(self, node: Node) -> Dict[str, str]:
+        return {
+            "dlrover-job": self._job_name,
+            "dlrover-type": node.type,
+            "dlrover-id": str(node.id),
+            "dlrover-rank": str(node.rank_index),
+        }
+
+    def _launch(self, node: Node):
+        name = pod_name(self._job_name, node.type, node.id)
+        node.name = name
+        ok = self._api.create_pod(
+            name, self._labels(node), self._env(node),
+            node.config_resource,
+        )
+        if not ok:
+            attempts = getattr(node, "_create_attempts", 0) + 1
+            node._create_attempts = attempts
+            if attempts > MAX_CREATE_ATTEMPTS:
+                logger.error(
+                    "giving up creating pod %s after %d attempts",
+                    name, attempts,
+                )
+                node.set_exit_reason(NodeExitReason.HARDWARE_ERROR)
+                node.update_status(NodeStatus.FAILED)
+                node.is_released = True
+            else:
+                logger.warning(
+                    "create pod %s failed; queued for retry", name
+                )
+                self._create_queue.put(node)
+
+    def _remove(self, node: Node):
+        name = node.name
+        if not (name and name.startswith(self._job_name + "-")):
+            name = pod_name(self._job_name, node.type, node.id)
+        self._api.delete_pod(name)
+
+    def _reconcile(self, node_type: str, target: int):
+        mine = [
+            rec for rec in self._api.list_pods()
+            if rec.get("labels", {}).get("dlrover-job") == self._job_name
+            and rec.get("labels", {}).get("dlrover-type") == node_type
+            and rec.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+        ]
+        excess = len(mine) - target
+        if excess > 0:
+            # remove the newest ids first (parity: scale_down order)
+            mine.sort(
+                key=lambda rec: int(
+                    rec.get("labels", {}).get("dlrover-id", 0)
+                )
+            )
+            for rec in mine[target:]:
+                self._api.delete_pod(rec.name)
+
+    def _drain_retries(self):
+        while not self._stopped.wait(self._retry_interval):
+            pending: List[Node] = []
+            while True:
+                try:
+                    pending.append(self._create_queue.get_nowait())
+                except queue.Empty:
+                    break
+            for node in pending:
+                if node.is_released:
+                    continue
+                self._launch(node)
+
+
+def pod_to_node(rec: PodRecord) -> Optional[Node]:
+    """parity: k8s_watcher.py:139 _convert_pod_event_to_node_event +
+    :130 _get_pod_exit_reason."""
+    labels = rec.get("labels", {})
+    node_id = labels.get("dlrover-id")
+    if node_id is None or not str(node_id).isdigit():
+        return None
+    phase = rec.phase
+    exit_reason = ""
+    if phase == PodPhase.PENDING:
+        status = NodeStatus.PENDING
+    elif phase == PodPhase.RUNNING:
+        status = NodeStatus.RUNNING
+    elif phase == PodPhase.SUCCEEDED:
+        status = NodeStatus.SUCCEEDED
+    elif phase == PodPhase.FAILED:
+        status = NodeStatus.FAILED
+        code = int(rec.get("exit_code", 0) or 0)
+        reason = str(rec.get("reason", ""))
+        if code == 137 or reason == "OOMKilled":
+            exit_reason = NodeExitReason.OOM
+        elif "preempt" in reason.lower() or "evict" in reason.lower():
+            exit_reason = NodeExitReason.PREEMPTED
+        elif code == 1:
+            exit_reason = NodeExitReason.FATAL_ERROR
+        else:
+            exit_reason = NodeExitReason.KILLED
+    else:
+        status = NodeStatus.UNKNOWN
+    node = Node(
+        labels.get("dlrover-type", NodeType.WORKER),
+        int(node_id),
+        name=rec.name,
+        status=status,
+        rank_index=int(labels.get("dlrover-rank", node_id)),
+    )
+    if exit_reason:
+        node.set_exit_reason(exit_reason)
+    return node
+
+
+class GkePodWatcher(NodeWatcher):
+    """Polling diff watcher over the pod fleet (parity: PodWatcher —
+    the apiserver watch verb becomes a poll against the same seam the
+    scaler mutates, so fake-API tests drive both ends)."""
+
+    def __init__(self, job_name: str, api: K8sApi,
+                 poll_interval: float = 5.0):
+        self._job_name = job_name
+        self._api = api
+        self._poll = poll_interval
+        self._stopped = threading.Event()
+        self._last: Dict[str, str] = {}  # name -> phase fingerprint
+
+    def _mine(self) -> List[PodRecord]:
+        return [
+            rec for rec in self._api.list_pods()
+            if rec.get("labels", {}).get("dlrover-job") == self._job_name
+        ]
+
+    def _fingerprint(self, rec: PodRecord) -> str:
+        return f"{rec.phase}/{rec.get('exit_code')}/{rec.get('reason')}"
+
+    def poll_events(self) -> List[NodeEvent]:
+        events: List[NodeEvent] = []
+        seen: Dict[str, str] = {}
+        for rec in self._mine():
+            fp = self._fingerprint(rec)
+            seen[rec.name] = fp
+            if self._last.get(rec.name) != fp:
+                node = pod_to_node(rec)
+                if node is not None:
+                    events.append(
+                        NodeEvent(NodeEventType.MODIFIED, node)
+                    )
+        for name in set(self._last) - set(seen):
+            parts = name.rsplit("-", 2)
+            if len(parts) == 3 and parts[2].isdigit():
+                gone = Node(parts[1], int(parts[2]), name=name,
+                            status=NodeStatus.DELETED)
+                events.append(NodeEvent(NodeEventType.DELETED, gone))
+        self._last = seen
+        return events
+
+    def watch(self) -> Iterator[NodeEvent]:
+        while not self._stopped.is_set():
+            for event in self.poll_events():
+                yield event
+            self._stopped.wait(self._poll)
+
+    def list(self) -> List[Node]:
+        out = []
+        for rec in self._mine():
+            node = pod_to_node(rec)
+            if node is not None:
+                out.append(node)
+        return out
+
+    def stop(self):
+        self._stopped.set()

@@ -1,0 +1,163 @@
+"""GKE pod platform tests (M12/M14 parity: the reference's
+test_pod_scaler.py / test_k8s_watcher.py pattern over a fake API)."""
+
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+from dlrover_tpu.scheduler.gke import (
+    FakeK8sApi,
+    GkePodScaler,
+    GkePodWatcher,
+    pod_to_node,
+)
+
+
+def _scaler(api=None):
+    api = api or FakeK8sApi()
+    return GkePodScaler(
+        "job", api, "10.0.0.1:5000", worker_env={"EXTRA": "1"},
+    ), api
+
+
+def _worker(i, relaunch=0):
+    n = Node(NodeType.WORKER, i, config_resource=NodeResource(),
+             relaunch_count=relaunch)
+    return n
+
+
+def test_launch_creates_pod_with_env_contract():
+    scaler, api = _scaler()
+    scaler.scale(ScalePlan(launch_nodes=[_worker(0)]))
+    (rec,) = api.list_pods()
+    assert rec.name == "job-worker-0"
+    assert rec["labels"]["dlrover-job"] == "job"
+    env = rec["env"]
+    assert env[NodeEnv.MASTER_ADDR] == "10.0.0.1:5000"
+    assert env[NodeEnv.NODE_ID] == "0"
+    assert env["EXTRA"] == "1"
+
+
+def test_remove_and_reconcile_round_trip():
+    scaler, api = _scaler()
+    nodes = [_worker(i) for i in range(4)]
+    scaler.scale(ScalePlan(launch_nodes=nodes))
+    assert len(api.list_pods()) == 4
+    # explicit removal
+    scaler.scale(ScalePlan(remove_nodes=[nodes[1]]))
+    names = {r.name for r in api.list_pods()}
+    assert "job-worker-1" not in names and len(names) == 3
+    # reconcile down to 2: newest ids go first
+    from dlrover_tpu.common.node import NodeGroupResource
+
+    scaler.scale(ScalePlan(node_group_resources={
+        NodeType.WORKER: NodeGroupResource(2, NodeResource()),
+    }))
+    names = {r.name for r in api.list_pods()}
+    assert names == {"job-worker-0", "job-worker-2"}
+
+
+def test_create_retry_then_give_up_marks_failed():
+    scaler, api = _scaler()
+    api.fail_creates = 1
+    node = _worker(0)
+    scaler.scale(ScalePlan(launch_nodes=[node]))
+    assert not api.list_pods()  # first create failed, queued
+    # drain the retry queue inline
+    pending = scaler._create_queue.get_nowait()
+    scaler._launch(pending)
+    assert len(api.list_pods()) == 1  # retry succeeded
+    # exhausting the budget surfaces a failure instead of a phantom
+    api.fail_creates = 10**6
+    node2 = _worker(1)
+    for _ in range(10):
+        scaler._launch(node2)
+    assert node2.status == NodeStatus.FAILED
+    assert node2.exit_reason == NodeExitReason.HARDWARE_ERROR
+
+
+def test_pod_exit_reason_mapping():
+    scaler, api = _scaler()
+    scaler.scale(ScalePlan(launch_nodes=[_worker(i) for i in range(4)]))
+    api.tick()
+    api.oom_kill("job-worker-0")
+    api.evict("job-worker-1")
+    api.crash("job-worker-2", exit_code=1)
+    api.crash("job-worker-3", exit_code=99)
+    by_id = {
+        n.id: n for n in map(pod_to_node, api.list_pods()) if n
+    }
+    assert by_id[0].exit_reason == NodeExitReason.OOM
+    assert by_id[1].exit_reason == NodeExitReason.PREEMPTED
+    assert by_id[2].exit_reason == NodeExitReason.FATAL_ERROR
+    assert by_id[3].exit_reason == NodeExitReason.KILLED
+    assert all(n.status == NodeStatus.FAILED for n in by_id.values())
+
+
+def test_watcher_diffs_phases_and_deletions():
+    scaler, api = _scaler()
+    watcher = GkePodWatcher("job", api, poll_interval=0.01)
+    scaler.scale(ScalePlan(launch_nodes=[_worker(0), _worker(1)]))
+    events = watcher.poll_events()
+    assert {e.node.status for e in events} == {NodeStatus.PENDING}
+    api.tick()
+    events = watcher.poll_events()
+    assert {e.node.status for e in events} == {NodeStatus.RUNNING}
+    assert watcher.poll_events() == []  # no changes, no events
+    api.delete_pod("job-worker-1")
+    events = watcher.poll_events()
+    assert len(events) == 1
+    assert events[0].node.status == NodeStatus.DELETED
+    assert events[0].node.id == 1
+    # list() reflects the live fleet
+    assert [n.id for n in watcher.list()] == [0]
+
+
+def test_scale_plan_drives_job_manager_via_watcher():
+    """ScalePlan -> fake-pod mutations -> watcher events -> job manager
+    bookkeeping: the round trip the reference's pod tests prove."""
+    from dlrover_tpu.master.node.dist_job_manager import (
+        DistributedJobManager,
+    )
+
+    scaler, api = _scaler()
+    watcher = GkePodWatcher("job", api, poll_interval=0.01)
+    mgr = DistributedJobManager(scaler=scaler)
+    nodes = mgr._node_managers[NodeType.WORKER].scale_up_nodes(
+        2, NodeResource()
+    )
+    scaler.scale(ScalePlan(launch_nodes=nodes))
+    api.tick()
+    for event in watcher.poll_events():
+        mgr.process_event(event)
+    running = mgr.get_running_nodes()
+    assert {n.id for n in running} == {0, 1}
+    # an OOM kill flows back as a relaunch with the OOM exit reason
+    api.oom_kill("job-worker-0")
+    for event in watcher.poll_events():
+        mgr.process_event(event)
+    node0 = mgr.get_node(NodeType.WORKER, 0)
+    assert node0.status == NodeStatus.FAILED
+    assert node0.exit_reason == NodeExitReason.OOM
+    # relaunch created a replacement pod through the scaler
+    assert any(
+        r.name == "job-worker-2" for r in api.list_pods()
+    )
+
+
+def test_factory_builds_gke_platform(monkeypatch):
+    from types import SimpleNamespace
+
+    from dlrover_tpu.scheduler.factory import build_platform
+
+    monkeypatch.setenv("DLROVER_TPU_FAKE_PLATFORM", "1")
+    scaler, watcher = build_platform(
+        SimpleNamespace(platform="gke", job_name="j", worker_env={}),
+        "localhost:1",
+    )
+    assert isinstance(scaler, GkePodScaler)
+    assert isinstance(watcher, GkePodWatcher)
