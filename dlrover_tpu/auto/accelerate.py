@@ -56,9 +56,8 @@ def _device_hbm_bytes(device) -> float:
 
 def build_trainer(cfg, strategy: Strategy, devices=None,
                   optimizer=None):
-    """Materialize a ShardedTrainer for one strategy."""
-    from dlrover_tpu.trainer.sharded import make_trainer_for_llama
-
+    """Materialize a ShardedTrainer for one strategy (any model family
+    with the models/ contract — dispatched by config type)."""
     mesh = create_mesh(list(strategy.mesh_spec), devices)
     attn_fn = None
     if strategy.context_parallel:
@@ -70,7 +69,9 @@ def build_trainer(cfg, strategy: Strategy, devices=None,
             mesh, kind=strategy.context_parallel
         )
     cfg = dataclasses.replace(cfg, remat=strategy.remat)
-    return make_trainer_for_llama(
+    from dlrover_tpu.models import make_trainer_for
+
+    return make_trainer_for(
         cfg, mesh, strategy=strategy.sharding,
         accum_steps=strategy.accum_steps, optimizer=optimizer,
         attn_fn=attn_fn,
@@ -160,7 +161,7 @@ def auto_accelerate(
         trainer = build_trainer(cfg, strategy, devices, optimizer)
         return AccelerateResult(trainer, strategy, [])
 
-    profile = ModelProfile.from_llama(cfg, seq_len)
+    profile = ModelProfile.from_config(cfg, seq_len)
     hbm = hbm_bytes or _device_hbm_bytes(devices[0])
     candidates = strategies or enumerate_strategies(
         len(devices), global_batch,

@@ -52,6 +52,21 @@ class ModelProfile:
             vocab_size=cfg.vocab_size,
         )
 
+    @classmethod
+    def from_config(cls, cfg, seq_len: int) -> "ModelProfile":
+        """Dispatch over the model families (models/llama, models/gpt):
+        any config whose module exposes param_count/flops_per_token."""
+        from dlrover_tpu.models import model_module_for
+
+        mod = model_module_for(cfg)
+        return cls(
+            param_count=mod.param_count(cfg),
+            flops_per_token=mod.flops_per_token(cfg, seq_len),
+            hidden_size=cfg.hidden_size,
+            num_layers=cfg.num_layers,
+            vocab_size=cfg.vocab_size,
+        )
+
 
 @dataclasses.dataclass
 class MemoryEstimate:

@@ -1,0 +1,42 @@
+"""Model families. Each module exposes the same contract: Config
+dataclass, init_params, param_axes, param_count, forward,
+next_token_loss, flops_per_token — so the trainer/auto layers dispatch
+by config type (model_module_for)."""
+
+
+def model_module_for(cfg):
+    """The family module owning ``cfg`` (LlamaConfig -> models.llama,
+    GPTConfig -> models.gpt); raises on unknown config types rather
+    than misrouting them."""
+    name = type(cfg).__name__
+    if name == "GPTConfig":
+        from dlrover_tpu.models import gpt
+
+        return gpt
+    if name == "LlamaConfig":
+        from dlrover_tpu.models import llama
+
+        return llama
+    raise TypeError(
+        f"unknown model family config {type(cfg).__name__!r}; register "
+        "it in models.model_module_for"
+    )
+
+
+def make_trainer_for(cfg, mesh=None, strategy: str = "fsdp",
+                     accum_steps: int = 1, optimizer=None,
+                     attn_fn=None):
+    """Family-dispatched ShardedTrainer constructor — the single seam
+    the auto layer builds trainers through."""
+    mod = model_module_for(cfg)
+    if hasattr(mod, "make_trainer"):
+        return mod.make_trainer(
+            cfg, mesh, strategy=strategy, accum_steps=accum_steps,
+            optimizer=optimizer, attn_fn=attn_fn,
+        )
+    from dlrover_tpu.trainer.sharded import make_trainer_for_llama
+
+    return make_trainer_for_llama(
+        cfg, mesh, strategy=strategy, accum_steps=accum_steps,
+        optimizer=optimizer, attn_fn=attn_fn,
+    )

@@ -103,6 +103,76 @@ def create_mesh(
     return mesh
 
 
+def create_hybrid_mesh(
+    ici_spec: Sequence[Tuple[str, int]],
+    dcn_spec: Sequence[Tuple[str, int]],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Multi-pod mesh: DCN axes over slice granularity, ICI axes within
+    a slice (the scaling-book recipe — put data/pipeline parallelism on
+    the slow inter-slice network, tensor/fsdp inside the slice where
+    collectives ride ICI).
+
+    ``create_hybrid_mesh([("fsdp", 4), ("tensor", 4)], [("data", 2)])``
+    on a 2-slice v5e-16 reservation: gradients all-reduce over DCN once
+    per step, param gathers stay on ICI. DCN axes always come first
+    (outermost), matching CANONICAL_ORDER's data-outside convention.
+
+    Falls back to a plain reshape (DCN axes outermost) when the
+    topology has no slice structure — e.g. virtual CPU devices — so one
+    code path serves tests and pods.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    dcn_total = 1
+    for _, s in dcn_spec:
+        dcn_total *= s
+    ici_spec = resolve_mesh_shape(
+        ici_spec, n // max(dcn_total, 1)
+    )
+    names = tuple(n_ for n_, _ in dcn_spec) + tuple(
+        n_ for n_, _ in ici_spec
+    )
+    if len(set(names)) != len(names):
+        raise ValueError(f"Duplicate axis names: {names}")
+    shape = tuple(s for _, s in dcn_spec) + tuple(
+        s for _, s in ici_spec
+    )
+    try:
+        from jax.experimental import mesh_utils
+
+        # the util requires equal-rank shapes: pad ICI dims with 1s on
+        # the DCN side and vice versa so the result comes back already
+        # [*dcn, *ici]-shaped with slice membership intact
+        n_dcn, n_ici = len(dcn_spec), len(ici_spec)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (1,) * n_dcn + tuple(s for _, s in ici_spec),
+            tuple(s for _, s in dcn_spec) + (1,) * n_ici,
+            devices=list(devices),
+        ).reshape(shape)
+    except Exception as e:
+        # only virtual/CPU topologies may fall back to a flat reshape;
+        # a real multi-slice fleet failing here is a misconfiguration
+        # that must not silently train with fsdp riding DCN
+        if any(
+            getattr(d, "slice_index", None) not in (None, 0)
+            for d in devices
+        ):
+            raise
+        logger.info(
+            "hybrid mesh fallback to flat reshape (no slice "
+            "structure): %s", e,
+        )
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    mesh = Mesh(dev_array, names)
+    logger.info(
+        "Hybrid mesh dcn=%s ici=%s over %d devices",
+        dict(dcn_spec), dict(ici_spec), len(devices),
+    )
+    return mesh
+
+
 def axis_size(mesh: Mesh, name: str) -> int:
     """Size of a mesh axis; 1 when absent (axes are optional)."""
     return mesh.shape.get(name, 1)

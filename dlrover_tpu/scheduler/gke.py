@@ -295,10 +295,15 @@ def pod_to_node(rec: PodRecord) -> Optional[Node]:
         status = NodeStatus.FAILED
         code = int(rec.get("exit_code", 0) or 0)
         reason = str(rec.get("reason", ""))
-        if code == 137 or reason == "OOMKilled":
+        # explicit reasons first: a preempted pod is also SIGKILLed
+        # (137) after its grace period and must NOT be routed into the
+        # OOM grow-memory path
+        if reason == "OOMKilled":
             exit_reason = NodeExitReason.OOM
         elif "preempt" in reason.lower() or "evict" in reason.lower():
             exit_reason = NodeExitReason.PREEMPTED
+        elif code == 137:
+            exit_reason = NodeExitReason.OOM
         elif code == 1:
             exit_reason = NodeExitReason.FATAL_ERROR
         else:

@@ -184,17 +184,22 @@ def test_analyser_ordering_matches_measured_dryruns():
     test_auto_accelerate_bo_path."""
     from dlrover_tpu.auto.accelerate import dryrun_strategy
 
-    cfg = llama.llama_tiny()
-    profile = ModelProfile.from_llama(cfg, 64)
+    # big enough that recompute FLOPs dominate fixed overheads —
+    # llama_tiny's remat delta is below CPU timer noise
+    cfg = llama.LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=1024,
+        num_layers=6, num_heads=8, num_kv_heads=4, max_seq_len=128,
+    )
+    profile = ModelProfile.from_llama(cfg, 128)
     mesh = (("data", 2), ("fsdp", 4))
     cands = [
         Strategy(mesh_spec=mesh, sharding="zero1", remat="off"),
         Strategy(mesh_spec=mesh, sharding="zero1", remat="dots"),
         Strategy(mesh_spec=mesh, sharding="zero1", remat="minimal"),
     ]
-    est = [estimate_step_time(profile, s, 16, 64) for s in cands]
+    est = [estimate_step_time(profile, s, 16, 128) for s in cands]
     meas = [
-        dryrun_strategy(cfg, s, 16, 64, steps=10) for s in cands
+        dryrun_strategy(cfg, s, 16, 128, steps=8) for s in cands
     ]
     # predicted: off < dots < minimal (REMAT_COMPUTE ordering)
     assert est[0] < est[1] < est[2]

@@ -90,3 +90,23 @@ def test_chunked_ce_matches_full():
     np.testing.assert_allclose(
         float(full), float(chunked), rtol=1e-4
     )
+
+
+def test_auto_accelerate_on_gpt_family():
+    """Strategy search dispatches across model families: search + init
+    + one step on the GPT config."""
+    from dlrover_tpu.auto.accelerate import auto_accelerate
+
+    cfg = gpt.gpt_tiny()
+    result = auto_accelerate(
+        cfg, global_batch=8, seq_len=32, hbm_bytes=16e9,
+    )
+    assert result.strategy.num_devices == 8
+    params, opt_state = result.trainer.init(jax.random.key(0))
+    tokens = np.random.randint(0, cfg.vocab_size, (8, 32),
+                               dtype=np.int32)
+    batch = result.trainer.shard_batch(
+        result.trainer.microbatch((tokens, tokens))
+    )
+    _, _, loss = result.trainer.train_step(params, opt_state, batch)
+    assert np.isfinite(float(loss))

@@ -197,3 +197,31 @@ def test_strategies_produce_same_loss():
         losses[strategy] = float(loss)
     vals = list(losses.values())
     np.testing.assert_allclose(vals, vals[0], rtol=2e-2)
+
+
+def test_hybrid_mesh_dcn_outermost_and_trains():
+    """create_hybrid_mesh: DCN axes outermost (data over the slow
+    network), ICI axes inside; a step under tp_fsdp runs on it."""
+    from dlrover_tpu.parallel.mesh import create_hybrid_mesh
+
+    mesh = create_hybrid_mesh(
+        [("fsdp", 2), ("tensor", 2)], [("data", 2)],
+    )
+    assert mesh.axis_names == ("data", "fsdp", "tensor")
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tensor": 2}
+    cfg = llama.llama_tiny()
+    trainer = make_trainer_for_llama(cfg, mesh, strategy="tp_fsdp")
+    params, opt_state = trainer.init(jax.random.key(0))
+    tokens = np.asarray(jax.random.randint(
+        jax.random.key(1), (8, 16), 0, cfg.vocab_size
+    ))
+    batch = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
+    _, _, loss = trainer.train_step(params, opt_state, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_hybrid_mesh_rejects_duplicate_axes():
+    from dlrover_tpu.parallel.mesh import create_hybrid_mesh
+
+    with pytest.raises(ValueError):
+        create_hybrid_mesh([("data", 4)], [("data", 2)])
