@@ -43,7 +43,9 @@ def test_successful_job_lifecycle():
                           "time.sleep(0.2)"])
     mgr.start()
     try:
-        assert _wait(mgr.all_workers_exited)
+        # 30s: interpreter startup of the children can exceed the
+        # default wait under full-suite load
+        assert _wait(mgr.all_workers_exited, timeout=30)
         assert mgr.all_workers_succeeded()
     finally:
         mgr.stop()
