@@ -119,3 +119,18 @@ def test_llama_system_e2e_with_shm_data_plane():
         assert int(step) == 6
         assert float(loss) > 0 and float(loss) < 50
         assert int(start) == 0
+
+
+def test_preemption_drill_recovers():
+    """Injected preemption (SIGTERM to the worker's own process group —
+    the spot-VM reclaim shape: the agent sees a signal death, not a
+    traceback) -> relaunch -> flash-checkpoint resume -> completion."""
+    with tempfile.TemporaryDirectory() as tmp:
+        proc, out_file = _run_launcher(
+            tmp, extra_env={"DLROVER_FAULT_INJECT": "preempt@15"}
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        step, loss, start = open(out_file).read().split(",")
+        assert int(step) == 30
+        assert int(start) == 10  # resumed from the step-10 snapshot
+        assert "INJECTED PREEMPTION" in proc.stdout + proc.stderr
