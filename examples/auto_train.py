@@ -1,0 +1,81 @@
+"""auto_accelerate end to end: search the strategy space, train with
+the winner, save it for the next (possibly resized) run.
+
+Run directly (uses all local devices)::
+
+    python examples/auto_train.py --steps 20 --dryrun-top-k 2
+    python examples/auto_train.py --load-strategy /tmp/strategy.json
+
+Parity role: the reference's semi-automatic `auto_accelerate(model,
+optim_func, dataset, ...)` usage (atorch/examples) — here the search is
+a plain function of the model config and the cluster (no rank-0 engine
+choreography), and the saved strategy refits its data-parallel dim when
+the device count changes (auto/accelerate.py adjust_strategy).
+"""
+
+import argparse
+import os
+import sys
+
+# runnable directly (python examples/auto_train.py) without pip install
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import numpy as np
+import optax
+
+from dlrover_tpu.auto.accelerate import auto_accelerate
+from dlrover_tpu.auto.strategy import save_strategy
+from dlrover_tpu.models import llama
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--global-batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--dryrun-top-k", type=int, default=0)
+    ap.add_argument("--bo-iters", type=int, default=0)
+    ap.add_argument("--save-strategy", type=str, default="")
+    ap.add_argument("--load-strategy", type=str, default="")
+    args = ap.parse_args()
+
+    cfg = llama.llama_tiny()
+    result = auto_accelerate(
+        cfg,
+        global_batch=args.global_batch,
+        seq_len=args.seq_len,
+        dryrun_top_k=args.dryrun_top_k,
+        bo_iters=args.bo_iters,
+        load_strategy_path=args.load_strategy or None,
+        optimizer=optax.adamw(1e-3),
+    )
+    print(f"strategy: {result.strategy}")
+    if args.save_strategy:
+        save_strategy(result.strategy, args.save_strategy)
+        print(f"saved -> {args.save_strategy}")
+
+    trainer = result.trainer
+    params, opt_state = trainer.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(
+        0, cfg.vocab_size, (args.global_batch, args.seq_len),
+        dtype=np.int32,
+    )
+    batch = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
+    loss = None
+    for step in range(1, args.steps + 1):
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, batch
+        )
+        if step % 5 == 0:
+            print(f"step {step}: loss={float(loss):.4f}")
+    loss_val = float(loss) if loss is not None else float("nan")
+    print(f"FINAL loss={loss_val:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
