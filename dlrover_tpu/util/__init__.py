@@ -1,0 +1,15 @@
+from dlrover_tpu.util.event_queue import EventQueue
+from dlrover_tpu.util.state_store import (
+    FileStore,
+    MemoryStore,
+    StateBackend,
+    build_state_store,
+)
+
+__all__ = [
+    "EventQueue",
+    "FileStore",
+    "MemoryStore",
+    "StateBackend",
+    "build_state_store",
+]
