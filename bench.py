@@ -87,7 +87,7 @@ def main():
     )
     mb = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
 
-    batches = None
+    batches = loader = prefetch = None
     if args.data == "shm":
         from dlrover_tpu.data.shm_dataloader import (
             DevicePrefetch,
@@ -101,10 +101,11 @@ def main():
             num_workers=2,
             slot_bytes=max(1 << 20, 4 * batch * seq * 2 + 4096),
         )
-        batches = iter(DevicePrefetch(
+        prefetch = DevicePrefetch(
             (trainer.microbatch(b) for b in loader),
             depth=2, sharding=trainer.microbatch_sharding,
-        ))
+        )
+        batches = iter(prefetch)
 
     def next_mb():
         return mb if batches is None else next(batches)
@@ -125,6 +126,14 @@ def main():
     # so this waits for all 20 steps without a per-step host round-trip
     loss_val = float(loss)
     dt = time.perf_counter() - t0
+
+    if loader is not None:
+        # same shutdown order as ElasticShmDataLoader.shutdown: EOF the
+        # ring, let the prefetch thread drain to the source's end, and
+        # only unmap once no native pop can be in flight
+        loader.close()
+        joined = prefetch.join(timeout=10.0)
+        loader.shutdown(destroy=joined)
 
     step_time = dt / steps
     tokens_per_step = batch * seq

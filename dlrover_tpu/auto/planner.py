@@ -34,6 +34,12 @@ PLANNABLE_AXES = (
 #: tensor-style mesh axes whose sharding of a contraction dim implies
 #: per-layer activation collectives
 _ACT_COLLECTIVE_AXES = ("mlp", "heads", "kv_heads")
+#: per-collective dispatch/latency cost (seconds) — what makes many
+#: small gathers lose to one fused all-reduce for models that fit
+_COLLECTIVE_LATENCY = 5e-6
+#: fraction of HBM a plan may use: XLA temp buffers and fragmentation
+#: need headroom beyond params+opt+grad+activations
+HBM_UTILIZATION = 0.8
 
 
 @dataclasses.dataclass
@@ -77,11 +83,13 @@ def _feasible(assign: Dict[str, Optional[str]], leaf_infos,
 
 
 def _score(assign, leaf_infos, mesh_sizes, *, tokens_per_step,
-           hidden_size, num_layers, ici_bandwidth):
+           hidden_size, num_layers, ici_bandwidth, has_dp,
+           state_bytes_multiplier):
     """(memory, comm) of one assignment — same physics as
     auto/analyser.py, applied per leaf."""
     mem = 0.0
-    fsdp_like_bytes = 0.0  # params gathered on use each step
+    comm = 0.0
+    n_sharded_leaves = 0
     for axes, shape, nbytes in leaf_infos:
         shard = 1
         used = set()
@@ -91,11 +99,23 @@ def _score(assign, leaf_infos, mesh_sizes, *, tokens_per_step,
                 continue
             used.add(mesh_ax)
             shard *= mesh_sizes[mesh_ax]
-        mem += nbytes / shard * 4  # params + adam m+v + grad
+        mem += nbytes / shard * state_bytes_multiplier
+        # grad sync moves ~2x the full param volume through each link
+        # per step either way: ring all-gather + reduce-scatter when
+        # sharded, ring all-reduce when replicated under DP — the
+        # bandwidth term is near-constant across assignments; what
+        # differs is per-collective dispatch latency (below) and memory
         if shard > 1:
-            fsdp_like_bytes += nbytes / shard
-    # comm: gather/scatter of sharded params (2x sharded volume) ...
-    comm = 2.0 * fsdp_like_bytes / ici_bandwidth
+            n_sharded_leaves += 1
+            comm += 2.0 * nbytes / ici_bandwidth
+        elif has_dp:
+            comm += 2.0 * nbytes / ici_bandwidth
+    # dispatch latency: sharded leaves pay a gather + a scatter each
+    # per step; replicated grads ride ONE fused all-reduce — this is
+    # why DDP beats FSDP when everything fits (test_planner.py)
+    comm += _COLLECTIVE_LATENCY * (
+        2 * n_sharded_leaves + (1 if has_dp else 0)
+    )
     # ... plus per-layer activation collectives when contraction dims
     # are tensor-sharded (Megatron f/g ops; XLA inserts the same)
     act_axes = {
@@ -118,14 +138,25 @@ def plan_rules(
     num_layers: int,
     act_bytes_per_token: float = 24.0,
     ici_bandwidth: float = 4.5e10,
+    batch_axes: Optional[Tuple[str, ...]] = None,
+    state_bytes_multiplier: float = 4.0,
 ) -> PlanReport:
     """Pick the cheapest feasible logical->mesh assignment.
 
     ``mesh_sizes`` maps shardable mesh axes (e.g. {"fsdp": 4,
     "tensor": 2}) — data/pipe axes are handled by their own layers.
-    The batch rule is always data+fsdp (activations shard over them).
+    The batch rule is always data+fsdp (activations shard over them):
+    since the mesh's ``data`` axis is deliberately NOT in
+    ``mesh_sizes`` (it never shards params), callers on a
+    data-parallel mesh must pass ``batch_axes`` naming every
+    batch-sharding mesh axis; otherwise it defaults to the
+    batch-capable axes found in ``mesh_sizes``.
     Raises if nothing fits ``hbm_bytes``.
     """
+    if batch_axes is None:
+        batch_axes = tuple(
+            a for a in ("data", "fsdp") if a in mesh_sizes
+        )
     leaf_infos = _leaf_infos(abs_params, axes_tree)
     param_bytes_total = sum(b for _, _, b in leaf_infos)
     options: List[Optional[str]] = [None] + [
@@ -146,21 +177,20 @@ def plan_rules(
             assign, leaf_infos, mesh_sizes,
             tokens_per_step=tokens_per_step, hidden_size=hidden_size,
             num_layers=num_layers, ici_bandwidth=ici_bandwidth,
+            has_dp=bool(batch_axes),
+            state_bytes_multiplier=state_bytes_multiplier,
         )
         total_mem = mem + act_bytes
-        if total_mem > hbm_bytes:
+        if total_mem > hbm_bytes * HBM_UTILIZATION:
             continue
         n_feasible += 1
-        # lexicographic-ish: minimize comm, break ties toward LESS
-        # sharding (fewer collectives tomorrow) then lower memory
+        # lexicographic-ish: minimize comm (param sync is ~constant
+        # across assignments, so activation collectives decide), then
+        # lower per-chip memory (headroom), then fewer sharded axes
         sharded_axes = sum(1 for v in assign.values() if v)
-        score = comm + 1e-6 * sharded_axes + 1e-18 * total_mem
+        score = comm + 1e-15 * total_mem + 1e-9 * sharded_axes
         if best is None or score < best.score:
-            rules: Rules = {
-                "batch": tuple(
-                    a for a in ("data", "fsdp") if a in mesh_sizes
-                ) or None,
-            }
+            rules: Rules = {"batch": tuple(batch_axes) or None}
             rules.update({
                 ax: mesh_ax for ax, mesh_ax in assign.items()
                 if mesh_ax is not None
@@ -169,8 +199,8 @@ def plan_rules(
     if best is None:
         raise ValueError(
             f"no feasible sharding plan fits {hbm_bytes / 1e9:.1f} GB "
-            f"(params {param_bytes_total / 1e9:.1f} GB, mesh "
-            f"{mesh_sizes})"
+            f"at {HBM_UTILIZATION:.0%} utilization (params "
+            f"{param_bytes_total / 1e9:.1f} GB, mesh {mesh_sizes})"
         )
     logger.info(
         "Planned rules over %d feasible assignments: %s "
@@ -204,4 +234,8 @@ def plan_rules_for_llama(cfg, mesh, global_batch: int, seq_len: int,
         abs_params, llama.param_axes(cfg), mesh_sizes, hbm_bytes,
         tokens_per_step=max(1, global_batch // max(dp, 1)) * seq_len,
         hidden_size=cfg.hidden_size, num_layers=cfg.num_layers,
+        batch_axes=tuple(
+            a for a in ("data", "fsdp")
+            if a in mesh.axis_names and axis_size(mesh, a) > 1
+        ),
     )

@@ -1,0 +1,145 @@
+"""Brain optimization algorithms over the archived metrics (L5 depth).
+
+Parity reference: dlrover/go/brain/pkg/optimizer/implementation/
+optalgorithm/optimize_job_worker_resource.go (worker resource plans
+from persisted runtime metrics: used-memory trend + margin),
+optimize_job_oom_resource shapes (grow memory for jobs with OOM
+history), and the Brain's cross-run warm start role for the
+acceleration engine (atorch auto_accelerate).
+
+TPU shape: three pure functions over the BrainClient archive
+(brain/client.py → util/state_store.py):
+
+- :func:`predict_peak_memory_mb` — least-squares trend of per-node used
+  host memory vs global step, extrapolated a horizon ahead (training
+  memory grows: caches, logging, python heap).
+- :func:`plan_worker_resource` — the initial host-RAM plan for a new
+  run of a job name: trend-predicted peak x safety margin, grown
+  preemptively per archived OOM exit (the reference relaunches first
+  and grows after; with history we grow BEFORE the first OOM).
+- :func:`warm_start_strategies` — archived best acceleration strategy
+  for a job name, so auto_accelerate re-validates one known-good
+  candidate instead of running a cold search.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import NodeResource
+
+#: headroom over the predicted peak (Go plan: used * (1 + margin))
+MEMORY_MARGIN = 1.2
+#: preemptive growth per archived OOM exit (matches the job manager's
+#: reactive OOM growth factor, master/node/dist_job_manager.py)
+OOM_GROWTH = 1.5
+#: cap on compounded OOM growth
+MAX_OOM_FACTOR = 4.0
+
+
+def predict_peak_memory_mb(
+    samples: List[Dict], horizon_fraction: float = 0.5
+) -> Tuple[float, float]:
+    """(observed_peak_mb, predicted_peak_mb) from runtime samples.
+
+    ``samples`` are the archive's runtime entries ({"global_step",
+    "max_used_memory_mb"}). The prediction extrapolates the linear
+    used-memory trend ``horizon_fraction`` of the observed step range
+    past the last sample — the role of the Go algorithm's
+    ``OptimizeJobWorkerMemory`` trend term.
+    """
+    pts = [
+        (float(s.get("global_step", 0)),
+         float(s.get("max_used_memory_mb", 0) or 0))
+        for s in samples
+        if (s.get("max_used_memory_mb") or 0) > 0
+    ]
+    if not pts:
+        return 0.0, 0.0
+    peak = max(m for _, m in pts)
+    if len(pts) < 3:
+        return peak, peak
+    n = len(pts)
+    xs = [p[0] for p in pts]
+    ys = [p[1] for p in pts]
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    denom = sum((x - mx) ** 2 for x in xs)
+    if denom <= 0:
+        return peak, peak
+    slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+    horizon = (max(xs) - min(xs)) * horizon_fraction
+    predicted = ys[-1] + max(slope, 0.0) * horizon
+    return peak, max(peak, predicted)
+
+
+def count_oom_exits(client, job_name: str) -> int:
+    """Archived runs of ``job_name`` that ended in an OOM exit."""
+    from dlrover_tpu.common.constants import NodeExitReason
+
+    n = 0
+    for uuid in client.get_job_runs(job_name):
+        exit_doc = client._store.get(
+            f"brain/{job_name}/{uuid}/exit", {}
+        )
+        if exit_doc.get("reason") == NodeExitReason.OOM:
+            n += 1
+    return n
+
+
+def plan_worker_resource(
+    client, job_name: str, base: Optional[NodeResource] = None
+) -> Optional[NodeResource]:
+    """Initial host-RAM plan for a new run of ``job_name`` from its
+    archive; None when there is no usable history (parity role:
+    optimize_job_worker_resource.go's create-stage plan)."""
+    import dataclasses
+
+    base = base or NodeResource()
+    peak = predicted = 0.0
+    for uuid in client.get_job_runs(job_name):
+        p, pred = predict_peak_memory_mb(
+            client.get_runtime_stats(job_name, uuid)
+        )
+        peak = max(peak, p)
+        predicted = max(predicted, pred)
+    oom_exits = count_oom_exits(client, job_name)
+    oom_factor = min(OOM_GROWTH ** oom_exits, MAX_OOM_FACTOR)
+    if predicted <= 0:
+        if oom_factor > 1.0 and base.memory > 0:
+            planned = dataclasses.replace(
+                base, memory=int(base.memory * oom_factor)
+            )
+            logger.info(
+                "Brain OOM-history plan for %s: memory %d -> %d MB "
+                "(%d archived OOM exits)", job_name, base.memory,
+                planned.memory, oom_exits,
+            )
+            return planned
+        return None
+    # floor at the spec's base first, THEN compound OOM growth: an OOM
+    # that happened at the base allocation means the base itself is too
+    # small
+    mem = int(max(predicted * MEMORY_MARGIN, base.memory) * oom_factor)
+    planned = dataclasses.replace(base, memory=mem)
+    logger.info(
+        "Brain memory plan for %s: observed peak %.0f MB, predicted "
+        "%.0f MB -> planned %d MB (margin %.1fx, oom %.1fx)",
+        job_name, peak, predicted, mem, MEMORY_MARGIN, oom_factor,
+    )
+    return planned
+
+
+def warm_start_strategies(client, job_name: str) -> List[Dict]:
+    """Archived winning acceleration strategies for ``job_name``,
+    best-measured first (each: {"strategy_json", "measured_seconds"})."""
+    out = []
+    for uuid in client.get_job_runs(job_name):
+        doc = client._store.get(
+            f"brain/{job_name}/{uuid}/strategy", None
+        )
+        if doc and doc.get("strategy_json"):
+            out.append(doc)
+    out.sort(
+        key=lambda d: d.get("measured_seconds") or float("inf")
+    )
+    return out

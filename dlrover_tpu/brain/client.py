@@ -80,8 +80,29 @@ class BrainClient:
             "global_step": stats.global_step,
             "speed": stats.speed,
             "timestamp": stats.timestamp,
+            # hottest node's host RAM this sample — what the memory
+            # trend algorithm (brain/algorithms.py) regresses over
+            "max_used_memory_mb": max(
+                (
+                    n.get("used_memory_mb", 0) or 0
+                    for n in stats.running_nodes
+                ),
+                default=0,
+            ),
         })
         self._store.set(key, samples[-500:])
+
+    def report_strategy(self, job: JobMeta, strategy_json: str,
+                        measured_seconds: Optional[float]) -> None:
+        """Archive the winning acceleration strategy of this run so the
+        next run of the job name warm-starts (brain/algorithms.py
+        warm_start_strategies; parity role: the Brain feeding the
+        acceleration engine's initial candidate)."""
+        self._store.set(self._key(job, "strategy"), {
+            "strategy_json": strategy_json,
+            "measured_seconds": measured_seconds,
+            "timestamp": time.time(),
+        })
 
     def report_exit_reason(self, job: JobMeta, reason: str) -> None:
         self._store.set(self._key(job, "exit"), {

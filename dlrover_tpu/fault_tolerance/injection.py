@@ -172,5 +172,10 @@ class FaultInjector:
             )
         elif fault.kind == "preempt":
             print(f"INJECTED PREEMPTION at step {step}", flush=True)
-            os.kill(os.getpid(), signal.SIGTERM)
+            try:
+                # the whole process group, like a real node preemption
+                # (coworker loaders die with the trainer)
+                os.killpg(os.getpgid(0), signal.SIGTERM)
+            except (OSError, PermissionError):
+                os.kill(os.getpid(), signal.SIGTERM)
             time.sleep(30)  # await delivery

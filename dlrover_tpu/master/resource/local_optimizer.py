@@ -56,11 +56,32 @@ class TPULocalOptimizer(ResourceOptimizer):
         node_num = getattr(self._job_args, "node_num", 0) or 0
         resource = getattr(self._job_args, "node_resource", None)
         node_num = self._brain_warm_start(node_num)
+        resource = self._brain_memory_plan(resource)
         if node_num:
             plan.node_group_resources[NodeType.WORKER] = (
                 NodeGroupResource(node_num, resource or NodeResource())
             )
         return plan
+
+    def _brain_memory_plan(self, resource):
+        """Initial host-RAM from the job's archived memory trend + OOM
+        history (brain/algorithms.py plan_worker_resource; parity:
+        optimize_job_worker_resource.go's create-stage plan)."""
+        if self._brain_client is None:
+            return resource
+        job_name = getattr(self._job_args, "job_name", "") or ""
+        if not job_name:
+            return resource
+        try:
+            from dlrover_tpu.brain.algorithms import plan_worker_resource
+
+            planned = plan_worker_resource(
+                self._brain_client, job_name, resource
+            )
+        except Exception as e:
+            logger.warning("brain memory plan failed: %s", e)
+            return resource
+        return planned or resource
 
     def _brain_warm_start(self, node_num: int) -> int:
         """Start at the historically fastest worker count of previous
@@ -79,14 +100,17 @@ class TPULocalOptimizer(ResourceOptimizer):
             return node_num
         if hist is None or hist.worker_num <= 0:
             return node_num
+        if not node_num:
+            # a spec that asked for zero workers stays at zero: history
+            # must never provision nodes the job didn't request
+            return node_num
         n = (hist.worker_num // self._node_unit) * self._node_unit
         # JobArgs fields (scheduler/job_spec.py): min_node_num is the
         # declared floor; node_num is the provisioned count and acts as
         # the ceiling (warm start shrinks toward history, never grows
         # past what the spec asked for)
         lo = getattr(self._job_args, "min_node_num", 0) or 0
-        hi = node_num or n
-        n = max(lo, min(n, hi))
+        n = max(lo, min(n, node_num))
         if n and n != node_num:
             logger.info(
                 "Brain warm start: %d -> %d workers (history %s)",

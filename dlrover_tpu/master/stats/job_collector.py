@@ -98,11 +98,15 @@ class JobMetricCollector:
         if speed <= 0 or step <= self._last_sampled_step:
             return
         self._last_sampled_step = step
+        def node_dict(n):
+            d = n.to_dict() if hasattr(n, "to_dict") else dict(n)
+            used = getattr(n, "used_resource", None)
+            if used is not None and "used_memory_mb" not in d:
+                d["used_memory_mb"] = getattr(used, "memory", 0)
+            return d
+
         metric = RuntimeMetric(
-            running_nodes=[
-                n.to_dict() if hasattr(n, "to_dict") else dict(n)
-                for n in running_nodes
-            ],
+            running_nodes=[node_dict(n) for n in running_nodes],
             worker_num=len(speed_monitor.running_workers),
             global_step=step,
             speed=speed,

@@ -8,6 +8,8 @@ function owns the mapping:
   tpu_vm  -> TpuVmScaler/TpuVmWatcher over RestTpuVmApi, or FakeTpuVmApi
              when DLROVER_TPU_FAKE_PLATFORM=1 (system tests without a
              cloud project)
+  gke     -> GkePodScaler/GkePodWatcher over RestK8sApi (in-cluster
+             auth), or FakeK8sApi under DLROVER_TPU_FAKE_PLATFORM=1
 """
 
 import os
@@ -58,21 +60,19 @@ def build_platform(
             FakeK8sApi,
             GkePodScaler,
             GkePodWatcher,
+            RestK8sApi,
         )
 
         if os.getenv("DLROVER_TPU_FAKE_PLATFORM") == "1":
             logger.info("gke platform using FAKE pod API")
             api = FakeK8sApi(auto_running=True)
         else:
-            # the K8sApi seam is where a kubernetes-client implementation
-            # plugs in; this image ships none, so fleet automation is
-            # fake-only (agents on real clusters start via the operator
-            # pod template instead)
-            logger.warning(
-                "gke platform requires a kubernetes client "
-                "(set DLROVER_TPU_FAKE_PLATFORM=1 for the fake fleet)"
+            res = getattr(job_args, "node_resource", None)
+            api = RestK8sApi(
+                namespace=getattr(job_args, "namespace", "default"),
+                job_name=job_name,
+                image=getattr(res, "image", "") if res else "",
             )
-            return None, None
         scaler = GkePodScaler(
             job_name, api, master_addr,
             worker_env=dict(getattr(job_args, "worker_env", {}) or {}),

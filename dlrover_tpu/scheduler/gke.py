@@ -10,9 +10,11 @@ dlrover/python/scheduler/kubernetes.py:84 (k8sClient).
 TPU shape: on GKE a worker is a pod bound to a TPU node pool
 (`google.com/tpu` resources + nodeSelector for the slice topology).
 The master mutates pods through a minimal ``K8sApi`` seam —
-``FakeK8sApi`` for tests (the reference's mocked-client pattern) and a
-REST-backed client for real clusters; pod phases and container exit
-codes map onto the Node status/exit-reason model:
+``FakeK8sApi`` for tests (the reference's mocked-client pattern) and
+``RestK8sApi`` (this file) talking to the kube apiserver over the
+shared retried transport (scheduler/rest.py), stub-server-tested in
+tests/test_rest_clients.py; pod phases and container exit codes map
+onto the Node status/exit-reason model:
 
   Pending                      -> PENDING
   Running                      -> RUNNING
@@ -151,6 +153,194 @@ class FakeK8sApi(K8sApi):
     def succeed(self, name: str):
         with self._lock:
             self._pods[name]["phase"] = PodPhase.SUCCEEDED
+
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def service_account_token(path: str = f"{_SA_DIR}/token") -> str:
+    """Read the (auto-rotated) in-cluster service-account token."""
+    with open(path) as f:
+        return f.read().strip()
+
+
+def tpu_node_selector(tpu_type: str,
+                      topology: str = "") -> Dict[str, str]:
+    """GKE TPU node-pool selector for a worker pod (the TPU shape of
+    pod_scaler.py:343's node placement: slice pools are selected by
+    accelerator + topology labels)."""
+    sel: Dict[str, str] = {}
+    if tpu_type:
+        sel["cloud.google.com/gke-tpu-accelerator"] = tpu_type
+    if topology:
+        sel["cloud.google.com/gke-tpu-topology"] = topology
+    return sel
+
+
+class RestK8sApi(K8sApi):
+    """Kube-apiserver REST client over the shared retried transport.
+
+    Parity: dlrover/python/scheduler/kubernetes.py:84 (k8sClient —
+    incluster config + retried verb set) and
+    master/scaler/pod_scaler.py:343 (_create_pod — full pod spec with
+    resources, env, labels, node placement). In-cluster defaults come
+    from the standard env/secret mounts; ``base_url`` /
+    ``token_provider`` / ``sleep`` are injectable so every verb is
+    stub-server-tested (tests/test_rest_clients.py).
+    """
+
+    def __init__(
+        self,
+        namespace: str = "default",
+        job_name: str = "",
+        image: str = "",
+        node_selector: Optional[Dict[str, str]] = None,
+        base_url: str = "",
+        token_provider=service_account_token,
+        timeout: float = 30.0,
+        retries: int = 5,
+        backoff: float = 0.5,
+        sleep=None,
+    ):
+        import os
+        import time as _time
+
+        from dlrover_tpu.scheduler.rest import RestClient
+
+        if not base_url:
+            host = os.getenv("KUBERNETES_SERVICE_HOST", "kubernetes")
+            port = os.getenv("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+        self._ns = namespace
+        self._job_name = job_name
+        self._image = image
+        self._node_selector = dict(node_selector or {})
+        self._client = RestClient(
+            base_url, token_provider=token_provider, timeout=timeout,
+            retries=retries, backoff=backoff,
+            sleep=sleep or _time.sleep,
+        )
+
+    # -- spec construction ------------------------------------------------
+
+    def _pod_manifest(self, name, labels, env, resource) -> Dict:
+        requests: Dict[str, str] = {}
+        if resource is not None:
+            if getattr(resource, "cpu", 0):
+                requests["cpu"] = str(resource.cpu)
+            if getattr(resource, "memory", 0):
+                requests["memory"] = f"{int(resource.memory)}Mi"
+            if getattr(resource, "tpu_chips", 0):
+                requests["google.com/tpu"] = str(resource.tpu_chips)
+        selector = dict(self._node_selector)
+        if not selector and getattr(resource, "tpu_type", ""):
+            selector = tpu_node_selector(resource.tpu_type)
+        container = {
+            "name": "worker",
+            "image": self._image or getattr(resource, "image", "")
+            or "dlrover-tpu-worker",
+            "env": [
+                {"name": k, "value": str(v)} for k, v in env.items()
+            ],
+            "resources": {
+                "requests": requests, "limits": dict(requests),
+            },
+        }
+        spec: Dict = {
+            "containers": [container],
+            # the master relaunches through the scaler, never kubelet
+            "restartPolicy": "Never",
+        }
+        if selector:
+            spec["nodeSelector"] = selector
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "labels": dict(labels)},
+            "spec": spec,
+        }
+
+    # -- K8sApi verbs -----------------------------------------------------
+
+    def create_pod(self, name, labels, env, resource) -> bool:
+        from dlrover_tpu.scheduler.rest import RestError
+
+        manifest = self._pod_manifest(name, labels, env, resource)
+        try:
+            self._client.request(
+                "POST", f"api/v1/namespaces/{self._ns}/pods", manifest
+            )
+            return True
+        except RestError as e:
+            if e.status == 409:
+                logger.info("pod %s already exists", name)
+                return True
+            logger.error("create pod %s failed: %s", name, e)
+            return False
+
+    def delete_pod(self, name) -> bool:
+        from dlrover_tpu.scheduler.rest import NotFound, RestError
+
+        try:
+            self._client.request(
+                "DELETE", f"api/v1/namespaces/{self._ns}/pods/{name}"
+            )
+            return True
+        except NotFound:
+            return False  # already gone
+        except RestError as e:
+            logger.error("delete pod %s failed: %s", name, e)
+            return False
+
+    def list_pods(self) -> List[PodRecord]:
+        from dlrover_tpu.scheduler.rest import RestError
+
+        out: List[PodRecord] = []
+        cont = ""
+        while True:
+            path = f"api/v1/namespaces/{self._ns}/pods"
+            params = []
+            if self._job_name:
+                params.append(
+                    f"labelSelector=dlrover-job%3D{self._job_name}"
+                )
+            if cont:
+                params.append(f"continue={cont}")
+            if params:
+                path += "?" + "&".join(params)
+            try:
+                resp = self._client.request("GET", path)
+            except RestError as e:
+                logger.error("list pods failed: %s", e)
+                return []
+            for item in resp.get("items", []):
+                out.append(self._to_record(item))
+            cont = resp.get("metadata", {}).get("continue", "")
+            if not cont:
+                return out
+
+    @staticmethod
+    def _to_record(item: Dict) -> PodRecord:
+        """V1Pod JSON -> PodRecord (parity: k8s_watcher.py:130
+        _get_pod_exit_reason reads containerStatuses.terminated)."""
+        meta = item.get("metadata", {})
+        status = item.get("status", {})
+        rec = PodRecord(
+            name=meta.get("name", ""),
+            phase=status.get("phase", PodPhase.PENDING),
+            labels=meta.get("labels", {}),
+            env={},
+        )
+        for cs in status.get("containerStatuses", []):
+            term = cs.get("state", {}).get("terminated")
+            if term:
+                rec["exit_code"] = int(term.get("exitCode", 0) or 0)
+                rec["reason"] = term.get("reason", "")
+                break
+        if not rec.get("reason") and status.get("reason"):
+            # pod-level reason (eviction: status.reason="Evicted")
+            rec["reason"] = status["reason"]
+        return rec
 
 
 def pod_name(job_name: str, node_type: str, node_id: int) -> str:

@@ -1,0 +1,113 @@
+"""Shared retried HTTP transport for real-cluster platform clients.
+
+Parity reference: dlrover/python/scheduler/kubernetes.py:62
+(``retry_k8s_request`` — 5 attempts with sleep, NOT_FOUND short-circuits
+to None) and :84 (k8sClient wrapping the apiserver). Both TPU-native
+clients (RestTpuVmApi for tpu.googleapis.com, RestK8sApi for the kube
+apiserver) share this transport so auth, retry/backoff and error
+mapping behave identically and are tested once against a local stub
+server (tests/test_rest_clients.py).
+
+Policy:
+- transport errors (connection refused/reset) and 5xx/429 responses are
+  retried with linear backoff up to ``retries`` attempts;
+- 404 raises :class:`NotFound` immediately (the reference maps it to
+  None — deletion of a gone object is success-shaped);
+- other 4xx raise :class:`RestError` immediately (retrying a bad
+  request cannot help);
+- the token provider is called per-request so short-lived tokens
+  (metadata server, service-account rotation) stay fresh.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class RestError(Exception):
+    """Terminal API failure (after retries, or a non-retryable 4xx)."""
+
+    def __init__(self, status: int, reason: str, body: str = ""):
+        super().__init__(f"HTTP {status}: {reason} {body[:200]}")
+        self.status = status
+        self.reason = reason
+        self.body = body
+
+
+class NotFound(RestError):
+    """404 — the object does not exist (never retried)."""
+
+
+_RETRYABLE = (429, 500, 502, 503, 504)
+
+
+class RestClient:
+    """Minimal JSON-over-HTTP client with retries and bearer auth."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token_provider: Optional[Callable[[], str]] = None,
+        timeout: float = 30.0,
+        retries: int = 5,
+        backoff: float = 0.5,
+        extra_headers: Optional[Dict[str, str]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self._base = base_url.rstrip("/")
+        self._token_provider = token_provider
+        self._timeout = timeout
+        self._retries = max(1, retries)
+        self._backoff = backoff
+        self._headers = dict(extra_headers or {})
+        self._sleep = sleep
+
+    def request(self, method: str, path: str, body=None) -> Dict:
+        """One JSON request; returns the decoded response body."""
+        url = f"{self._base}/{path.lstrip('/')}"
+        data = json.dumps(body).encode() if body is not None else None
+        last_err: Optional[Exception] = None
+        for attempt in range(self._retries):
+            headers = {"Content-Type": "application/json"}
+            headers.update(self._headers)
+            try:
+                # token fetch is part of the retried attempt: the
+                # metadata server / SA-token mount blips like any other
+                # transport dependency
+                if self._token_provider is not None:
+                    headers["Authorization"] = (
+                        f"Bearer {self._token_provider()}"
+                    )
+                req = urllib.request.Request(
+                    url, data=data, method=method, headers=headers
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self._timeout
+                ) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                text = ""
+                try:
+                    text = e.read().decode(errors="replace")
+                except Exception:
+                    pass
+                if e.code == 404:
+                    raise NotFound(e.code, str(e.reason), text)
+                if e.code not in _RETRYABLE:
+                    raise RestError(e.code, str(e.reason), text)
+                last_err = RestError(e.code, str(e.reason), text)
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                last_err = e
+            if attempt + 1 < self._retries:
+                self._sleep(self._backoff * (attempt + 1))
+        logger.error(
+            "REST %s %s failed after %d attempts: %s",
+            method, url, self._retries, last_err,
+        )
+        if isinstance(last_err, RestError):
+            raise last_err
+        raise RestError(0, f"transport failure: {last_err}")

@@ -149,46 +149,46 @@ class FakeTpuVmApi(TpuVmApi):
                 self._fleet[name]["health"] = health
 
 
-class RestTpuVmApi(TpuVmApi):
-    """Real Cloud TPU v2 REST client (VM metadata-server auth).
+def metadata_server_token(timeout: float = 5.0) -> str:
+    """Fetch an access token from the GCE/TPU-VM metadata server."""
+    req = urllib.request.Request(
+        "http://metadata.google.internal/computeMetadata/v1/"
+        "instance/service-accounts/default/token",
+        headers={"Metadata-Flavor": "Google"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())["access_token"]
 
-    Constructed only for platform=tpu_vm with project/zone configured;
-    every call degrades to a logged failure rather than an exception so
-    the master survives API blips (the scaler retries).
+
+class RestTpuVmApi(TpuVmApi):
+    """Real Cloud TPU v2 REST client over the shared retried transport
+    (scheduler/rest.py; parity: kubernetes.py:62 retry_k8s_request).
+
+    Defaults talk to tpu.googleapis.com with VM metadata-server auth;
+    ``base_url``/``token_provider``/``sleep`` are injectable so the
+    full verb set runs against a local stub server in tests
+    (tests/test_rest_clients.py). Create/delete degrade to a logged
+    False rather than raising so the master survives API blips (the
+    scaler's bounded-retry queue takes over).
     """
 
-    _BASE = "https://tpu.googleapis.com/v2"
-    _TOKEN_URL = (
-        "http://metadata.google.internal/computeMetadata/v1/"
-        "instance/service-accounts/default/token"
-    )
+    def __init__(self, project: str, zone: str, timeout: float = 30.0,
+                 base_url: str = "https://tpu.googleapis.com/v2",
+                 token_provider=metadata_server_token,
+                 retries: int = 5, backoff: float = 0.5,
+                 sleep=time.sleep):
+        from dlrover_tpu.scheduler.rest import RestClient
 
-    def __init__(self, project: str, zone: str, timeout: float = 30.0):
         self._parent = f"projects/{project}/locations/{zone}"
-        self._timeout = timeout
-
-    def _token(self) -> str:
-        req = urllib.request.Request(
-            self._TOKEN_URL, headers={"Metadata-Flavor": "Google"}
+        self._client = RestClient(
+            base_url, token_provider=token_provider, timeout=timeout,
+            retries=retries, backoff=backoff, sleep=sleep,
         )
-        with urllib.request.urlopen(req, timeout=5) as resp:
-            return json.loads(resp.read())["access_token"]
-
-    def _call(self, method: str, path: str, body=None):
-        req = urllib.request.Request(
-            f"{self._BASE}/{path}",
-            data=json.dumps(body).encode() if body is not None else None,
-            method=method,
-            headers={
-                "Authorization": f"Bearer {self._token()}",
-                "Content-Type": "application/json",
-            },
-        )
-        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
-            return json.loads(resp.read() or b"{}")
 
     def create_node(self, name, accelerator_type, runtime_version,
                     labels, metadata, preemptible=False) -> bool:
+        from dlrover_tpu.scheduler.rest import RestError
+
         body = {
             "acceleratorType": accelerator_type,
             "runtimeVersion": runtime_version,
@@ -197,36 +197,56 @@ class RestTpuVmApi(TpuVmApi):
             "schedulingConfig": {"preemptible": preemptible},
         }
         try:
-            self._call(
+            self._client.request(
                 "POST", f"{self._parent}/nodes?nodeId={name}", body
             )
             return True
-        except Exception as e:
+        except RestError as e:
+            if e.status == 409:
+                # AlreadyExists: the goal state holds (idempotent
+                # relaunch after a partial failure)
+                logger.info("TPU VM %s already exists", name)
+                return True
             logger.error("TPU VM create %s failed: %s", name, e)
             return False
 
     def delete_node(self, name) -> bool:
+        from dlrover_tpu.scheduler.rest import NotFound, RestError
+
         try:
-            self._call("DELETE", f"{self._parent}/nodes/{name}")
+            self._client.request(
+                "DELETE", f"{self._parent}/nodes/{name}"
+            )
             return True
-        except Exception as e:
+        except NotFound:
+            return False  # already gone — nothing to do
+        except RestError as e:
             logger.error("TPU VM delete %s failed: %s", name, e)
             return False
 
     def list_nodes(self) -> List[TpuVmRecord]:
-        try:
-            resp = self._call("GET", f"{self._parent}/nodes")
-        except Exception as e:
-            logger.error("TPU VM list failed: %s", e)
-            return []
-        out = []
-        for node in resp.get("nodes", []):
-            out.append(TpuVmRecord(
-                name=node["name"].rsplit("/", 1)[-1],
-                state=node.get("state", TpuVmState.UNKNOWN),
-                labels=node.get("labels", {}),
-                metadata=node.get("metadata", {}),
-                health=node.get("health", ""),
-                accelerator_type=node.get("acceleratorType", ""),
-            ))
-        return out
+        from dlrover_tpu.scheduler.rest import RestError
+
+        out: List[TpuVmRecord] = []
+        page_token = ""
+        while True:
+            path = f"{self._parent}/nodes"
+            if page_token:
+                path += f"?pageToken={page_token}"
+            try:
+                resp = self._client.request("GET", path)
+            except RestError as e:
+                logger.error("TPU VM list failed: %s", e)
+                return []
+            for node in resp.get("nodes", []):
+                out.append(TpuVmRecord(
+                    name=node["name"].rsplit("/", 1)[-1],
+                    state=node.get("state", TpuVmState.UNKNOWN),
+                    labels=node.get("labels", {}),
+                    metadata=node.get("metadata", {}),
+                    health=node.get("health", ""),
+                    accelerator_type=node.get("acceleratorType", ""),
+                ))
+            page_token = resp.get("nextPageToken", "")
+            if not page_token:
+                return out

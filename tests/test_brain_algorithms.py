@@ -1,0 +1,203 @@
+"""Brain optimization algorithms (VERDICT r2 Missing #2): memory-trend
+resource plans, OOM-history preemptive growth, auto_accelerate warm
+start. Parity roles: dlrover/go/brain/pkg/optimizer/implementation/
+optalgorithm/optimize_job_worker_resource.go + the Brain feeding the
+acceleration engine."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.brain.algorithms import (
+    MEMORY_MARGIN,
+    plan_worker_resource,
+    predict_peak_memory_mb,
+    warm_start_strategies,
+)
+from dlrover_tpu.brain.client import BrainClient
+from dlrover_tpu.common.constants import NodeExitReason
+from dlrover_tpu.common.node import NodeResource
+from dlrover_tpu.master.stats.reporter import JobMeta
+from dlrover_tpu.master.stats.training_metrics import RuntimeMetric
+from dlrover_tpu.util.state_store import MemoryStore
+
+
+def _report_run(client, job_name, uuid, mem_points, worker_num=4,
+                speed=2.0, exit_reason=None):
+    job = JobMeta(uuid=uuid, name=job_name)
+    for step, mem in mem_points:
+        client.report_runtime_stats(job, RuntimeMetric(
+            running_nodes=[{"used_memory_mb": mem}],
+            worker_num=worker_num, global_step=step, speed=speed,
+            timestamp=float(step),
+        ))
+    if exit_reason:
+        client.report_exit_reason(job, exit_reason)
+
+
+class TestMemoryTrend:
+    def test_flat_usage_predicts_peak(self):
+        samples = [
+            {"global_step": s, "max_used_memory_mb": 1000}
+            for s in range(0, 100, 10)
+        ]
+        peak, pred = predict_peak_memory_mb(samples)
+        assert peak == 1000
+        assert pred == pytest.approx(1000, rel=0.01)
+
+    def test_growing_usage_extrapolates(self):
+        # 10 MB per step of growth over steps 0..90: trend must predict
+        # past the observed 1900 peak
+        samples = [
+            {"global_step": s, "max_used_memory_mb": 1000 + 10 * s}
+            for s in range(0, 100, 10)
+        ]
+        peak, pred = predict_peak_memory_mb(samples)
+        assert peak == 1900
+        assert pred > 1900
+        # horizon = half the observed range (45 steps) at slope 10
+        assert pred == pytest.approx(1900 + 450, rel=0.05)
+
+    def test_no_memory_samples(self):
+        peak, pred = predict_peak_memory_mb(
+            [{"global_step": 1, "speed": 2.0}]
+        )
+        assert peak == 0 and pred == 0
+
+
+class TestResourcePlan:
+    def test_archive_changes_initial_plan(self):
+        """VERDICT done-criterion: archives measurably change the
+        initial ResourcePlan."""
+        client = BrainClient(MemoryStore())
+        base = NodeResource(cpu=8, memory=2000)
+        # no history -> no plan
+        assert plan_worker_resource(client, "jobA", base) is None
+        # history with growing memory -> planned above observed peak
+        _report_run(
+            client, "jobA", "run1",
+            [(s, 1500 + 5 * s) for s in range(0, 200, 10)],
+        )
+        planned = plan_worker_resource(client, "jobA", base)
+        assert planned is not None
+        peak = 1500 + 5 * 190
+        assert planned.memory > peak  # margin + trend beyond the peak
+        assert planned.cpu == base.cpu  # only memory is planned
+
+    def test_oom_history_grows_preemptively(self):
+        client = BrainClient(MemoryStore())
+        base = NodeResource(cpu=8, memory=2000)
+        _report_run(
+            client, "jobB", "run1", [(s, 1000) for s in range(0, 50, 5)],
+            exit_reason=NodeExitReason.OOM,
+        )
+        grown = plan_worker_resource(client, "jobB", base)
+        # one OOM exit: max(trend*margin, base) * 1.5 growth — the OOM
+        # happened at the base allocation, so growth applies past it
+        assert grown.memory == pytest.approx(
+            max(1000 * MEMORY_MARGIN, 2000) * 1.5, rel=0.01
+        )
+        # two OOM exits compound
+        _report_run(
+            client, "jobB", "run2", [(s, 1000) for s in range(0, 50, 5)],
+            exit_reason=NodeExitReason.OOM,
+        )
+        grown2 = plan_worker_resource(client, "jobB", base)
+        assert grown2.memory > grown.memory
+
+    def test_oom_history_without_memory_samples_grows_base(self):
+        client = BrainClient(MemoryStore())
+        base = NodeResource(memory=4000)
+        _report_run(client, "jobC", "run1", [],
+                    exit_reason=NodeExitReason.OOM)
+        planned = plan_worker_resource(client, "jobC", base)
+        assert planned.memory == 6000  # base * 1.5
+
+    def test_local_optimizer_initial_plan_uses_memory_trend(self):
+        from dlrover_tpu.master.resource.local_optimizer import (
+            TPULocalOptimizer,
+        )
+        from dlrover_tpu.scheduler.job_spec import JobArgs
+
+        client = BrainClient(MemoryStore())
+        _report_run(
+            client, "jobD", "run1",
+            [(s, 3000) for s in range(0, 100, 10)],
+            worker_num=2,
+        )
+        args = JobArgs(
+            job_name="jobD", node_num=2,
+            node_resource=NodeResource(cpu=4, memory=1000),
+        )
+        opt = TPULocalOptimizer(args, brain_client=client)
+        plan = opt.init_job_resource()
+        group = plan.node_group_resources["worker"]
+        assert group.node_resource.memory == pytest.approx(
+            3000 * MEMORY_MARGIN, rel=0.01
+        )
+
+
+class TestStrategyWarmStart:
+    def _cfg(self):
+        from dlrover_tpu.models import llama
+
+        return llama.llama_tiny()
+
+    def test_warm_start_cuts_dryrun_count(self, monkeypatch):
+        """VERDICT done-criterion: a warm-started search measures fewer
+        dryruns than a cold BO search and still lands on the winner."""
+        import dlrover_tpu.auto.accelerate as acc
+        from dlrover_tpu.auto.accelerate import auto_accelerate
+
+        client = BrainClient(MemoryStore())
+        cfg = self._cfg()
+        calls = []
+        real_dryrun = acc.dryrun_strategy
+
+        def counting_dryrun(cfg_, s, gb, sl, devices=None, **kw):
+            calls.append(s)
+            return real_dryrun(cfg_, s, gb, sl, devices, steps=2, **kw)
+
+        monkeypatch.setattr(acc, "dryrun_strategy", counting_dryrun)
+
+        cold = auto_accelerate(
+            cfg, global_batch=8, seq_len=32, bo_iters=2,
+            dryrun_top_k=2, job_name="warmjob", brain_client=client,
+        )
+        cold_count = len(calls)
+        assert cold_count >= 3  # n_init + BO iterations
+
+        calls.clear()
+        warm = auto_accelerate(
+            cfg, global_batch=8, seq_len=32, bo_iters=2,
+            dryrun_top_k=2, job_name="warmjob", brain_client=client,
+        )
+        warm_count = len(calls)
+        assert warm_count <= 2  # archived winner + analytic top-1
+        assert warm_count < cold_count
+        assert warm.strategy is not None
+
+    def test_archive_roundtrip(self):
+        from dlrover_tpu.auto.strategy import Strategy
+
+        client = BrainClient(MemoryStore())
+        s = Strategy(mesh_spec=(("data", 8),), sharding="ddp")
+        client.report_strategy(
+            JobMeta(uuid="u1", name="jobE"), s.to_json(), 0.5
+        )
+        docs = warm_start_strategies(client, "jobE")
+        assert len(docs) == 1
+        assert Strategy.from_json(docs[0]["strategy_json"]) == s
+        assert docs[0]["measured_seconds"] == 0.5
+
+
+def test_runtime_stats_capture_max_used_memory():
+    client = BrainClient(MemoryStore())
+    job = JobMeta(uuid="u", name="jobF")
+    client.report_runtime_stats(job, RuntimeMetric(
+        running_nodes=[
+            {"used_memory_mb": 100}, {"used_memory_mb": 900},
+        ],
+        worker_num=2, global_step=5, speed=1.0, timestamp=1.0,
+    ))
+    samples = client.get_runtime_stats("jobF", "u")
+    assert samples[0]["max_used_memory_mb"] == 900

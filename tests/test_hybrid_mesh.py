@@ -1,0 +1,105 @@
+"""Slice-aware hybrid ICI x DCN mesh (VERDICT r2 Weak #7): the branch
+that real multi-slice fleets take, exercised against mocked sliced
+device lists; plus the CPU fallback and the misconfiguration guard.
+Parity role: SURVEY §5.8 — DCN axes outermost (data/pipe over the slow
+network), ICI axes within a slice."""
+
+import pytest
+
+from dlrover_tpu.parallel.mesh import create_hybrid_mesh
+
+
+class FakeTpuDev:
+    """Just enough surface for jax.experimental.mesh_utils'
+    slice-grouped mesh construction."""
+
+    platform = "tpu"
+
+    def __init__(self, i: int, slice_index: int, per_slice: int):
+        self.id = i
+        self.slice_index = slice_index
+        self.process_index = i // 4
+        self.device_kind = "TPU v5 lite"
+        self.coords = (i % per_slice, 0, 0)
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"FakeTpuDev({self.id}, slice={self.slice_index})"
+
+
+def _fleet(n_slices: int, per_slice: int):
+    return [
+        FakeTpuDev(i, i // per_slice, per_slice)
+        for i in range(n_slices * per_slice)
+    ]
+
+
+def test_dcn_axis_spans_slices_ici_axis_within():
+    devs = _fleet(2, 4)
+    mesh = create_hybrid_mesh(
+        [("fsdp", 4)], [("data", 2)], devices=devs
+    )
+    # DCN axes outermost
+    assert mesh.axis_names == ("data", "fsdp")
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 4}
+    # each data index is exactly one slice: fsdp collectives ride ICI
+    for di in range(2):
+        slice_ids = {d.slice_index for d in mesh.devices[di].flat}
+        assert len(slice_ids) == 1, (
+            f"fsdp axis crosses slices at data={di}: {slice_ids}"
+        )
+    # the data axis crosses both slices: grad all-reduce rides DCN
+    assert {
+        mesh.devices[di].flat[0].slice_index for di in range(2)
+    } == {0, 1}
+
+
+def test_two_ici_axes_within_slice():
+    devs = _fleet(2, 8)
+    mesh = create_hybrid_mesh(
+        [("fsdp", 4), ("tensor", 2)], [("data", 2)], devices=devs
+    )
+    assert mesh.axis_names == ("data", "fsdp", "tensor")
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 4, "tensor": 2}
+    for di in range(2):
+        assert len({d.slice_index for d in mesh.devices[di].flat}) == 1
+
+
+def test_ici_shape_resolved_from_fleet():
+    """ici_spec sizes of -1 resolve against per-slice device count."""
+    devs = _fleet(2, 4)
+    mesh = create_hybrid_mesh(
+        [("fsdp", -1)], [("data", 2)], devices=devs
+    )
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 4}
+
+
+def test_misconfigured_multislice_raises():
+    """A sliced fleet that the hybrid construction cannot lay out must
+    raise — never silently train with fsdp riding DCN."""
+
+    class BrokenDev:
+        platform = "tpu"
+
+        def __init__(self, i, slice_index):
+            self.id = i
+            self.slice_index = slice_index
+            self.process_index = i // 4
+            # no coords/core_on_chip: mesh_utils will fail
+
+    devs = [BrokenDev(i, i // 4) for i in range(8)]
+    with pytest.raises(Exception):
+        create_hybrid_mesh([("fsdp", 4)], [("data", 2)], devices=devs)
+
+
+def test_cpu_fallback_flat_reshape():
+    """Virtual CPU devices (no slice structure) take the reshape
+    fallback with DCN axes still outermost."""
+    import jax
+
+    devs = jax.devices()[:8]
+    mesh = create_hybrid_mesh(
+        [("fsdp", 4)], [("data", 2)], devices=devs
+    )
+    assert mesh.axis_names == ("data", "fsdp")
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 4}

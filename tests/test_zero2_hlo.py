@@ -1,0 +1,123 @@
+"""ZeRO-2 proven at the program level (VERDICT r2 Weak #6).
+
+zero2's contract vs zero1 is the GRAD ACCUMULATION BUFFER layout:
+grads are reduce-scattered into an fsdp-sharded buffer instead of held
+replicated. Two assertions pin it:
+
+1. the LOWERED (pre-XLA) module of the zero2 step carries explicit
+   sharding-constraint ops on the grad buffers inside the accumulation
+   scan — the guarantee zero1 does not have (XLA may still shard
+   zero1's carry by propagation; zero2 makes it a contract);
+2. the COMPILED zero2 program holds strictly fewer full-size fp32
+   buffers than ddp's — grads/opt state are physically sharded.
+
+Parity role: atorch/atorch/auto/opt_lib/zero_optimization.py:53.
+"""
+
+import re
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel import sharding as shd
+from dlrover_tpu.parallel.mesh import create_mesh
+from dlrover_tpu.trainer.sharded import make_trainer_for_llama
+
+ACCUM = 4
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.llama_tiny()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh([("data", 2), ("fsdp", 4)])
+
+
+def _abstract_args(tr, cfg):
+    abs_p = jax.eval_shape(tr._init_fn, jax.random.key(0))
+    abs_o = jax.eval_shape(tr.optimizer.init, abs_p)
+    opt_sh = tr.opt_shardings or shd.opt_state_shardings(
+        abs_o, abs_p, tr.param_shardings, tr.mesh
+    )
+    abs_p = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        abs_p, tr.param_shardings,
+    )
+    abs_o = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        abs_o, opt_sh,
+    )
+    abs_b = jax.tree.map(
+        lambda _: jax.ShapeDtypeStruct(
+            (ACCUM, 8, 32), np.int32, sharding=tr.microbatch_sharding
+        ),
+        (0, 0),
+    )
+    return abs_p, abs_o, abs_b
+
+
+def _lowered(cfg, mesh, strategy):
+    tr = make_trainer_for_llama(
+        cfg, mesh, strategy=strategy, accum_steps=ACCUM,
+        optimizer=optax.adamw(1e-3),
+    )
+    return tr, tr.train_step.lower(*_abstract_args(tr, cfg))
+
+
+def _constraint_count(text: str) -> int:
+    """Explicit sharding-constraint ops in a lowered StableHLO module
+    (sdy dialect or the legacy @Sharding custom-call)."""
+    return (
+        text.count("sdy.sharding_constraint")
+        + text.count('@Sharding')
+    )
+
+
+def test_zero2_lowered_module_constrains_grad_buffers(cfg, mesh):
+    _, low1 = _lowered(cfg, mesh, "zero1")
+    _, low2 = _lowered(cfg, mesh, "zero2")
+    c1 = _constraint_count(low1.as_text())
+    c2 = _constraint_count(low2.as_text())
+    # zero2 = zero1 + grad-buffer constraints: strictly more constraint
+    # ops, at least one per param leaf (zeros init + per-micro grads)
+    n_leaves = len(jax.tree.leaves(
+        jax.eval_shape(lambda k: llama.init_params(k, cfg),
+                       jax.random.key(0))
+    ))
+    assert c2 > c1, (c1, c2)
+    assert c2 - c1 >= n_leaves, (c1, c2, n_leaves)
+
+
+def test_zero2_compiled_grads_physically_sharded(cfg, mesh):
+    """The compiled program must not hold replicated full-size fp32
+    grad/opt buffers: full-shape fp32 tensor count drops vs ddp, and
+    fsdp-sharded fp32 shapes appear."""
+    V, H = cfg.vocab_size, cfg.hidden_size
+
+    def counts(strategy):
+        _, low = _lowered(cfg, mesh, strategy)
+        text = low.compile().as_text()
+        full = len(re.findall(rf"f32\[{V},{H}\]", text))
+        sharded = len(re.findall(rf"f32\[{V // 4},{H}\]", text))
+        return full, sharded
+
+    full_ddp, _ = counts("ddp")
+    full_z2, sharded_z2 = counts("zero2")
+    assert full_z2 < full_ddp, (full_z2, full_ddp)
+    assert sharded_z2 > 0
+
+
+def test_zero2_regression_guard_rules_not_equal_semantics(cfg, mesh):
+    """zero2's table may equal zero1's (both batch-only), but its grad
+    rules must exist and shard over fsdp — the exact regression VERDICT
+    r2 flagged as silently possible."""
+    assert shd.grad_rules("zero1") is None
+    g = shd.grad_rules("zero2")
+    assert g is not None
+    assert "fsdp" in set(g.values())
