@@ -30,6 +30,20 @@ import os, time
 import jax
 import jax.numpy as jnp
 
+# fault-injection hook (drill grammar, tests/test_four_node_drill.py):
+# "rank:seconds[,rank:seconds]" delays THIS node's probe so the master
+# records it as a straggler (rdzv_manager.get_straggler_nodes)
+_delay_spec = os.environ.get("DLROVER_TPU_PROBE_DELAY", "")
+_own_rank = os.environ.get("DLROVER_TPU_NODE_RANK", "")
+for _part in _delay_spec.split(","):
+    _r, _, _secs = _part.partition(":")
+    try:
+        _delay = float(_secs)
+    except ValueError:
+        continue  # malformed entry must not fail the probe itself
+    if _r and _r == _own_rank:
+        time.sleep(_delay)
+
 coordinator = os.environ.get("{COORD}")
 num_processes = int(os.environ.get("{NPROC}", "1"))
 process_id = int(os.environ.get("{PID}", "0"))
@@ -84,17 +98,24 @@ class NetworkCheckElasticAgent:
                 rdzv_round, normal, elapsed
             )
             # wait for all peers to report, then ask the verdict
+            reason = ""
             deadline = time.time() + 60
             while time.time() < deadline:
                 success, reason = self._client.network_check_success()
-                if success:
-                    return True
-                if reason and reason != "waiting_node":
+                if success or (reason and reason != "waiting_node"):
                     break
                 time.sleep(1)
-            if success:
-                return True
-            logger.warning("Network check round %d failed (%s)", r, reason)
+            # even on a green verdict, ALL rounds run: the probe is
+            # collective, so one round cannot tell a straggler from the
+            # group members it slowed — the re-paired second round
+            # provides the evidence the master's straggler localization
+            # intersects (rdzv_manager.get_straggler_nodes)
+            if not success:
+                logger.warning(
+                    "Network check round %d failed (%s)", r, reason
+                )
+        if success:
+            return True
         fault_nodes = self._client.get_fault_nodes()
         if self._config.node_rank in fault_nodes:
             logger.error("This node localized as faulty: %s", fault_nodes)

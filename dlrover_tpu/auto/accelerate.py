@@ -223,8 +223,13 @@ def auto_accelerate(
         if warm is not None:
             return warm
         # warm-start dryruns may have disqualified candidates (OOM /
-        # compile failure); never fall through onto one of those
-        fitting = [r for r in fitting if r.fits] or fitting
+        # compile failure); never fall through onto one of those — if
+        # every fitting candidate just failed, fall back to the most
+        # memory-conservative report and let XLA be the judge (same
+        # escape hatch as the nothing-fits path above)
+        fitting = [r for r in fitting if r.fits] or sorted(
+            reports, key=lambda r: r.memory_bytes
+        )[:1]
 
     if bo_iters > 0:
         # BO refinement (parity: auto/engine/sg_algo/bo_sg.py): GP+EI

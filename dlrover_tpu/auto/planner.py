@@ -211,7 +211,9 @@ def plan_rules(
 
 
 def plan_rules_for_llama(cfg, mesh, global_batch: int, seq_len: int,
-                         hbm_bytes: float) -> PlanReport:
+                         hbm_bytes: float,
+                         state_bytes_multiplier: float = 4.0
+                         ) -> PlanReport:
     """Convenience wrapper binding the flagship model's abstract shapes
     (zero materialization) to the planner."""
     from dlrover_tpu.models import llama
@@ -238,4 +240,5 @@ def plan_rules_for_llama(cfg, mesh, global_batch: int, seq_len: int,
             a for a in ("data", "fsdp")
             if a in mesh.axis_names and axis_size(mesh, a) > 1
         ),
+        state_bytes_multiplier=state_bytes_multiplier,
     )

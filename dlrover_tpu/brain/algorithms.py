@@ -76,14 +76,10 @@ def count_oom_exits(client, job_name: str) -> int:
     """Archived runs of ``job_name`` that ended in an OOM exit."""
     from dlrover_tpu.common.constants import NodeExitReason
 
-    n = 0
-    for uuid in client.get_job_runs(job_name):
-        exit_doc = client._store.get(
-            f"brain/{job_name}/{uuid}/exit", {}
-        )
-        if exit_doc.get("reason") == NodeExitReason.OOM:
-            n += 1
-    return n
+    return sum(
+        1 for uuid in client.get_job_runs(job_name)
+        if client.get_exit_reason(job_name, uuid) == NodeExitReason.OOM
+    )
 
 
 def plan_worker_resource(
@@ -134,9 +130,7 @@ def warm_start_strategies(client, job_name: str) -> List[Dict]:
     best-measured first (each: {"strategy_json", "measured_seconds"})."""
     out = []
     for uuid in client.get_job_runs(job_name):
-        doc = client._store.get(
-            f"brain/{job_name}/{uuid}/strategy", None
-        )
+        doc = client.get_strategy(job_name, uuid)
         if doc and doc.get("strategy_json"):
             out.append(doc)
     out.sort(

@@ -126,6 +126,16 @@ class BrainClient:
             f"brain/{job_name}/{uuid}/runtime", []
         )
 
+    def get_exit_reason(self, job_name: str, uuid: str) -> str:
+        doc = self._store.get(f"brain/{job_name}/{uuid}/exit", {})
+        return doc.get("reason", "")
+
+    def get_strategy(self, job_name: str,
+                     uuid: str) -> Optional[Dict]:
+        return self._store.get(
+            f"brain/{job_name}/{uuid}/strategy", None
+        )
+
     def get_optimization_plan(self, job_name: str) -> Optional[
             OptimizePlan]:
         """Recommend the historically fastest worker count across every

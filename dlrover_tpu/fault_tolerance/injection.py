@@ -174,8 +174,15 @@ class FaultInjector:
             print(f"INJECTED PREEMPTION at step {step}", flush=True)
             try:
                 # the whole process group, like a real node preemption
-                # (coworker loaders die with the trainer)
-                os.killpg(os.getpgid(0), signal.SIGTERM)
+                # (coworker loaders die with the trainer) — but ONLY
+                # when this trainer leads its own group (the agent
+                # spawns workers with start_new_session); in a shared
+                # group, group-wide SIGTERM would kill the supervisor
+                # that must observe the death and relaunch
+                if os.getpgid(0) == os.getpid():
+                    os.killpg(os.getpgid(0), signal.SIGTERM)
+                else:
+                    os.kill(os.getpid(), signal.SIGTERM)
             except (OSError, PermissionError):
                 os.kill(os.getpid(), signal.SIGTERM)
             time.sleep(30)  # await delivery

@@ -37,6 +37,9 @@ def parse_master_args(argv=None):
     parser.add_argument("--job_spec", type=str, default="",
                         help="path to a declarative ElasticTpuJob "
                              "YAML/JSON spec (scheduler/job_spec.py)")
+    parser.add_argument("--autoscale_interval", type=float, default=60.0,
+                        help="seconds between auto-scaler optimize "
+                             "passes (speed-window + straggler shrink)")
     parser.add_argument("--brain_store_path", type=str, default="",
                         help="directory for the durable cross-run "
                              "stats archive (brain/client.py); enables "

@@ -224,6 +224,13 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._node_groups: List[Dict[int, int]] = []
         self._singleton_nodes: set = set()
         self._check_round = 2
+        # per-round probe evidence for straggler localization: the
+        # probe is COLLECTIVE, so a slow node drags its whole group's
+        # elapsed time — one round cannot tell the straggler from its
+        # victims; intersecting slow-group membership across rounds
+        # with different pairings can (get_straggler_nodes)
+        self._round_times: Dict[int, Dict[int, float]] = {}
+        self._round_groups: Dict[int, List[set]] = {}
 
     def update_rdzv_params(self, min_nodes, max_nodes, waiting_timeout,
                            node_unit, join_timeout=600.0):
@@ -239,9 +246,20 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             if world is not None:
                 self._rdzv_round += 1
                 self._rdzv_nodes = dict(sorted(world.items()))
+                if (self._rdzv_round - 1) % self._check_round == 0:
+                    # a fresh check cycle: evidence from a previous
+                    # incarnation (different membership/pairings) must
+                    # not be intersected with this one — stale sets
+                    # could mislocalize a healthy node, and the dicts
+                    # would grow for the master's lifetime
+                    self._round_times.clear()
+                    self._round_groups.clear()
                 self._node_groups = self._group_nodes(
                     self._rdzv_round, self._rdzv_nodes
                 )
+                self._round_groups[self._rdzv_round] = [
+                    set(g) for g in self._node_groups
+                ]
                 logger.info(
                     "Network-check round %d groups: %s",
                     self._rdzv_round, self._node_groups,
@@ -273,10 +291,16 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 else:
                     node_groups.append(cur)
         else:
+            # re-pair FAILED nodes and straggler SUSPECTS (members of
+            # the previous round's slow groups) with known-good
+            # partners: the second pairing localizes both fault and
+            # slowness (the common member of two slow groups)
+            suspects = self._straggler_suspects()
             abnormal = [
-                r for r in ranks if not self._node_status.get(r, True)
+                r for r in ranks
+                if not self._node_status.get(r, True) or r in suspects
             ]
-            normal = [r for r in ranks if self._node_status.get(r, True)]
+            normal = [r for r in ranks if r not in abnormal]
             for a in abnormal:
                 if normal:
                     n0 = normal.pop(0)
@@ -293,7 +317,8 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         return node_groups
 
     def report_network_check_result(self, node_rank: int, normal: bool,
-                                    elapsed: float):
+                                    elapsed: float,
+                                    rdzv_round: Optional[int] = None):
         with self._lock:
             self._reported_nodes.add(node_rank)
             # latest round wins: a node that failed round 0 but passes the
@@ -304,6 +329,11 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 normal = self._node_status.get(node_rank, False)
             self._node_status[node_rank] = normal
             self._node_times[node_rank] = elapsed
+            if rdzv_round is None:
+                rdzv_round = self._rdzv_round
+            self._round_times.setdefault(
+                rdzv_round, {}
+            )[node_rank] = elapsed
 
     def network_check_success(self) -> Tuple[bool, str]:
         """Decide overall health and localize broken nodes
@@ -325,9 +355,53 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 if not self._node_status.get(r, True)
             ]
 
+    def _slow_sets(self, ratio: float) -> List[set]:
+        """Per recorded round: the union of members of probe groups
+        whose elapsed time exceeds ratio x the round's fastest group.
+        Rounds with fewer than two timed groups carry no signal."""
+        out: List[set] = []
+        for rnd in sorted(self._round_times):
+            times = self._round_times[rnd]
+            groups = self._round_groups.get(rnd) or [
+                {r} for r in times
+            ]
+            gtimes = []
+            for g in groups:
+                ts = [times[m] for m in g if m in times]
+                if ts:
+                    gtimes.append((g, max(ts)))
+            if len(gtimes) < 2:
+                continue
+            fastest = min(t for _, t in gtimes)
+            if fastest <= 0:
+                continue
+            slow: set = set()
+            for g, t in gtimes:
+                if t > ratio * fastest:
+                    slow |= g
+            out.append(slow)
+        return out
+
+    def _straggler_suspects(self, ratio: float = 2.0) -> set:
+        """Union of slow-group members so far (round-1 re-pairing)."""
+        sets = self._slow_sets(ratio)
+        return set().union(*sets) if sets else set()
+
     def get_straggler_nodes(self, ratio: float = 2.0) -> List[int]:
-        """Nodes whose probe time exceeds ratio x median."""
+        """Localized stragglers.
+
+        The probe is collective, so a slow node inflates every group
+        member's elapsed time; localization needs two rounds with
+        DIFFERENT pairings — the straggler is the common member of its
+        slow groups (parity role: rdzv_manager.py:368's two-round
+        fault localization, applied to slowness). With only one
+        informative round, fall back to the per-node median threshold
+        (meaningful when times are per-node, e.g. solo probes)."""
         with self._lock:
+            sets = self._slow_sets(ratio)
+            if len(sets) >= 2:
+                localized = set.intersection(*sets[-2:])
+                return sorted(localized)
             if not self._node_times:
                 return []
             times = sorted(self._node_times.values())
@@ -335,5 +409,6 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             if median <= 0:
                 return []
             return [
-                r for r, t in self._node_times.items() if t > ratio * median
+                r for r, t in self._node_times.items()
+                if t > ratio * median
             ]

@@ -80,6 +80,9 @@ def run(args) -> int:
                 master = DistributedJobMaster(
                     port=port, job_args=job_args, scaler=scaler,
                     watcher=watcher,
+                    autoscale_interval=getattr(
+                        args, "autoscale_interval", 60.0
+                    ),
                 )
                 break
             except Exception as e:

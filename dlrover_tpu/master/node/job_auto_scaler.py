@@ -76,6 +76,12 @@ class AllreduceTrainingAutoScaler:
             self._job_optimizer, "generate_straggler_shrink_plan"
         ):
             return
+        # never shrink a world that has not trained a step yet: the
+        # pre-flight check's verdicts should reshape a RUNNING job, not
+        # race its first rendezvous (drill: test_four_node_drill.py)
+        monitor = getattr(self._job_optimizer, "_speed_monitor", None)
+        if monitor is not None and monitor.completed_global_step <= 0:
+            return
         mgr = self._job_manager._node_managers.get(NodeType.WORKER)
         if mgr is None:
             return

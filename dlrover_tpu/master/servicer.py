@@ -173,7 +173,8 @@ class MasterServicer:
         mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
         if mgr:
             mgr.report_network_check_result(
-                req.node_id, req.normal, req.elapsed_time
+                req.node_id, req.normal, req.elapsed_time,
+                rdzv_round=req.rdzv_round,
             )
         return comm.Response(success=True)
 

@@ -207,10 +207,20 @@ class RestK8sApi(K8sApi):
 
         from dlrover_tpu.scheduler.rest import RestClient
 
+        ssl_context = None
         if not base_url:
             host = os.getenv("KUBERNETES_SERVICE_HOST", "kubernetes")
             port = os.getenv("KUBERNETES_SERVICE_PORT", "443")
             base_url = f"https://{host}:{port}"
+        if base_url.startswith("https"):
+            # the apiserver's cert chains to the CLUSTER CA (mounted
+            # next to the SA token), not the system trust store
+            import ssl
+
+            ca_path = f"{_SA_DIR}/ca.crt"
+            ssl_context = ssl.create_default_context(
+                cafile=ca_path if os.path.exists(ca_path) else None
+            )
         self._ns = namespace
         self._job_name = job_name
         self._image = image
@@ -218,7 +228,7 @@ class RestK8sApi(K8sApi):
         self._client = RestClient(
             base_url, token_provider=token_provider, timeout=timeout,
             retries=retries, backoff=backoff,
-            sleep=sleep or _time.sleep,
+            sleep=sleep or _time.sleep, ssl_context=ssl_context,
         )
 
     # -- spec construction ------------------------------------------------

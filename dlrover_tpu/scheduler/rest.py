@@ -57,6 +57,7 @@ class RestClient:
         backoff: float = 0.5,
         extra_headers: Optional[Dict[str, str]] = None,
         sleep: Callable[[float], None] = time.sleep,
+        ssl_context=None,
     ):
         self._base = base_url.rstrip("/")
         self._token_provider = token_provider
@@ -65,6 +66,7 @@ class RestClient:
         self._backoff = backoff
         self._headers = dict(extra_headers or {})
         self._sleep = sleep
+        self._ssl_context = ssl_context
 
     def request(self, method: str, path: str, body=None) -> Dict:
         """One JSON request; returns the decoded response body."""
@@ -86,7 +88,8 @@ class RestClient:
                     url, data=data, method=method, headers=headers
                 )
                 with urllib.request.urlopen(
-                    req, timeout=self._timeout
+                    req, timeout=self._timeout,
+                    context=self._ssl_context,
                 ) as resp:
                     return json.loads(resp.read() or b"{}")
             except urllib.error.HTTPError as e:
@@ -100,7 +103,13 @@ class RestClient:
                 if e.code not in _RETRYABLE:
                     raise RestError(e.code, str(e.reason), text)
                 last_err = RestError(e.code, str(e.reason), text)
-            except (urllib.error.URLError, OSError, TimeoutError) as e:
+            except (urllib.error.URLError, OSError, TimeoutError,
+                    ValueError, KeyError) as e:
+                # transport blips, TLS failures, a proxy answering 200
+                # with a non-JSON body, a token provider returning a
+                # malformed document — all retried, then surfaced as a
+                # RestError so verb-level handlers degrade to False/[]
+                # instead of killing the scaler/watcher thread
                 last_err = e
             if attempt + 1 < self._retries:
                 self._sleep(self._backoff * (attempt + 1))

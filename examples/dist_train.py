@@ -93,6 +93,20 @@ def main():
     params = jax.device_put(jax.device_get(params), repl)
     opt_state = jax.device_put(jax.device_get(opt_state), repl)
 
+    # rank 0 feeds the master's speed monitor (the trainer contract,
+    # trainer/elastic.py) — the auto-scaler gates scaling/straggler
+    # shrink on training actually progressing
+    step_reporter = None
+    if jax.process_index() == 0 and os.getenv("DLROVER_TPU_MASTER_ADDR"):
+        try:
+            from dlrover_tpu.agent.master_client import (
+                build_master_client,
+            )
+
+            step_reporter = build_master_client()
+        except Exception:
+            step_reporter = None
+
     n_local = args.per_proc_batch * jax.local_device_count()
     global_batch = n_local * world
     step = start_step
@@ -111,6 +125,11 @@ def main():
         params, opt_state, loss = train_step(params, opt_state, (x, y))
         loss_val = float(loss)
         step += 1
+        if step_reporter is not None and step % 5 == 0:
+            try:
+                step_reporter.report_global_step(step)
+            except Exception:
+                pass
         if args.progress:
             with open(args.progress, "a") as f:
                 f.write(f"{step},{world},{loss_val:.6f},{time.time()}\n")
