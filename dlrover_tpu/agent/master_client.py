@@ -236,6 +236,12 @@ class MasterClient:
         ))
         return self._call("report_global_step", req)
 
+    def report_custom_data(self, data: Dict):
+        """Free-form metrics into the stats pipeline (evaluator
+        results; parity: report_customized_data)."""
+        req = self._fill(comm.CustomData(data=dict(data)))
+        return self._call("report_custom_data", req)
+
     def report_model_info(self, param_count: int, flops_per_step: float,
                           batch_size: int, seq_len: int = 0,
                           extra: Optional[Dict] = None):
@@ -340,6 +346,9 @@ class LocalMasterClient:
         return self._kv.get(key, b"")
 
     def report_global_step(self, step, timestamp=None):
+        pass
+
+    def report_custom_data(self, data):
         pass
 
     def report_heartbeat(self):

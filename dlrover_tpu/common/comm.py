@@ -256,6 +256,14 @@ class ModelInfo(BaseRequest):
     extra: Dict = field(default_factory=dict)
 
 
+@dataclass
+class CustomData(BaseRequest):
+    """Free-form metrics into the stats pipeline (evaluator results,
+    user counters) — parity: report_customized_data."""
+
+    data: Dict = field(default_factory=dict)
+
+
 # ---------------------------------------------------------------- sync
 
 

@@ -95,6 +95,31 @@ class DistributedJobManager:
             )
             self._mark_critical_nodes(new_nodes)
             self._scaler.scale(ScalePlan(launch_nodes=new_nodes))
+        # evaluator side-job role (parity: EvaluatorManager,
+        # master/node/worker.py:32 role): eval hosts consuming flash
+        # checkpoints, outside the training rendezvous, never critical
+        eval_num = getattr(self._job_args, "evaluator_num", 0) or 0
+        if eval_num and self._scaler and not self._scaler.supports_role(
+            NodeType.EVALUATOR
+        ):
+            logger.warning(
+                "spec declares %d evaluator(s) but platform scaler %s "
+                "has no evaluator entrypoint; skipping the role",
+                eval_num, type(self._scaler).__name__,
+            )
+            eval_num = 0
+        if eval_num and self._scaler:
+            emgr = self._node_managers.setdefault(
+                NodeType.EVALUATOR,
+                TrainingNodeManager(NodeType.EVALUATOR),
+            )
+            eval_nodes = emgr.scale_up_nodes(
+                eval_num,
+                getattr(self._job_args, "evaluator_resource", None)
+                or NodeResource(),
+                max_relaunch_count=self._max_relaunch_count,
+            )
+            self._scaler.scale(ScalePlan(launch_nodes=eval_nodes))
         if self._watcher is not None:
             t = threading.Thread(
                 target=self._monitor_nodes, daemon=True,
@@ -164,14 +189,18 @@ class DistributedJobManager:
                 cur.set_exit_reason(node.exit_reason)
             cur.update_status(flow.to_status)
 
+        # the speed monitor tracks TRAINING capacity only: side-job
+        # roles (evaluator) must not inflate worker_num in runtime
+        # stats or stall worker_adjustment_finished
+        is_worker = cur.type == NodeType.WORKER
         if flow.to_status == NodeStatus.RUNNING:
-            if self._speed_monitor:
+            if self._speed_monitor and is_worker:
                 self._speed_monitor.add_running_worker(cur.type, cur.id)
             self._fire("on_node_started", cur)
         elif flow.to_status == NodeStatus.SUCCEEDED:
             self._fire("on_node_succeeded", cur)
         elif flow.to_status in (NodeStatus.FAILED, NodeStatus.DELETED):
-            if self._speed_monitor:
+            if self._speed_monitor and is_worker:
                 self._speed_monitor.remove_running_worker(
                     cur.type, cur.id
                 )
@@ -355,8 +384,10 @@ class DistributedJobManager:
         stale per the speed monitor."""
         if self._speed_monitor is None:
             return False
-        running = self.get_running_nodes()
-        if not running:
+        # WORKERS only: an always-RUNNING side-job (evaluator) must not
+        # make a worker-less recovery window look like a hang
+        mgr = self._node_managers.get(NodeType.WORKER)
+        if mgr is None or not mgr.running_nodes():
             return False
         return self._speed_monitor.worker_hanged(self._hang_seconds)
 

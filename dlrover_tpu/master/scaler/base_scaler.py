@@ -49,6 +49,16 @@ class Scaler(ABC):
     def scale(self, plan: ScalePlan) -> None:
         """Apply the plan."""
 
+    def supports_role(self, node_type: str) -> bool:
+        """Whether this platform can launch ``node_type`` nodes with
+        the right workload. Default: workers only — side-job roles
+        (evaluator) need a per-role command/entrypoint the platform
+        must explicitly support, or they would silently launch the
+        training workload under the wrong role."""
+        from dlrover_tpu.common.constants import NodeType
+
+        return node_type == NodeType.WORKER
+
     def start(self) -> None:
         pass
 

@@ -47,14 +47,25 @@ class ProcessScaler(Scaler):
         command: Optional[List[str]] = None,
         env: Optional[Dict[str, str]] = None,
         watcher: Optional[InMemoryWatcher] = None,
+        commands: Optional[Dict[str, List[str]]] = None,
+        envs: Optional[Dict[str, Dict[str, str]]] = None,
     ):
         super().__init__(job_name)
         self._master_addr = master_addr
         self._command = command
+        #: per-role command override (evaluator side-jobs run a
+        #: different entrypoint than workers); non-worker roles REQUIRE
+        #: an entry here (supports_role) — falling back to the training
+        #: command would launch a rogue trainer under the wrong role
+        self._commands = dict(commands or {})
+        #: per-role env override; falls back to env
+        self._envs = dict(envs or {})
         self._env = env or {}
         self.watcher = watcher or InMemoryWatcher()
-        self._procs: Dict[int, subprocess.Popen] = {}
-        self._nodes: Dict[int, Node] = {}
+        # keyed by (node_type, node_id): roles allocate ids
+        # independently, so worker 0 and evaluator 0 coexist
+        self._procs: Dict[tuple, subprocess.Popen] = {}
+        self._nodes: Dict[tuple, Node] = {}
         self._lock = threading.Lock()
         self._stopped = threading.Event()
         self._monitor = threading.Thread(
@@ -62,6 +73,9 @@ class ProcessScaler(Scaler):
             name="process-scaler-monitor",
         )
         self._monitor.start()
+
+    def supports_role(self, node_type: str) -> bool:
+        return node_type == NodeType.WORKER or node_type in self._commands
 
     def scale(self, plan: ScalePlan) -> None:
         for node in plan.remove_nodes:
@@ -71,17 +85,25 @@ class ProcessScaler(Scaler):
 
     def _launch_node(self, node: Node):
         env = dict(os.environ)
-        env.update(self._env)
+        env.update(self._envs.get(node.type, self._env))
         env[NodeEnv.MASTER_ADDR] = self._master_addr
+        env[NodeEnv.NODE_TYPE] = node.type
         env[NodeEnv.NODE_ID] = str(node.id)
         env[NodeEnv.NODE_RANK] = str(node.rank_index)
         env[NodeEnv.RESTART_COUNT] = str(node.relaunch_count)
-        if not self._command:
-            raise ValueError(
-                "ProcessScaler needs the per-node command (e.g. a "
-                "dlrover-tpu-run invocation of the training script)"
+        command = self._commands.get(node.type) or (
+            self._command if node.type == NodeType.WORKER else None
+        )
+        if not command:
+            logger.error(
+                "no command configured for role %r (node %s); "
+                "declare spec.%s.command", node.type, node.name,
+                node.type,
             )
-        cmd = list(self._command)
+            node.set_exit_reason(NodeExitReason.FATAL_ERROR)
+            self._emit(node, NodeStatus.FAILED)
+            return
+        cmd = list(command)
         try:
             proc = subprocess.Popen(cmd, env=env)
         except Exception as e:
@@ -90,8 +112,8 @@ class ProcessScaler(Scaler):
             self._emit(node, NodeStatus.FAILED)
             return
         with self._lock:
-            self._procs[node.id] = proc
-            self._nodes[node.id] = node
+            self._procs[(node.type, node.id)] = proc
+            self._nodes[(node.type, node.id)] = node
         node.create_time = time.time()
         node.start_time = time.time()
         self._emit(node, NodeStatus.RUNNING)
@@ -99,8 +121,8 @@ class ProcessScaler(Scaler):
 
     def _kill_node(self, node: Node):
         with self._lock:
-            proc = self._procs.pop(node.id, None)
-            self._nodes.pop(node.id, None)
+            proc = self._procs.pop((node.type, node.id), None)
+            self._nodes.pop((node.type, node.id), None)
         if proc and proc.poll() is None:
             proc.terminate()
             try:
@@ -114,13 +136,13 @@ class ProcessScaler(Scaler):
         while not self._stopped.wait(0.5):
             with self._lock:
                 finished = [
-                    (nid, p) for nid, p in self._procs.items()
+                    (key, p) for key, p in self._procs.items()
                     if p.poll() is not None
                 ]
-                for nid, _ in finished:
-                    self._procs.pop(nid, None)
-            for nid, proc in finished:
-                node = self._nodes.pop(nid, None)
+                for key, _ in finished:
+                    self._procs.pop(key, None)
+            for key, proc in finished:
+                node = self._nodes.pop(key, None)
                 if node is None:
                     continue
                 rc = proc.returncode

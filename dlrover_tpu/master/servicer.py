@@ -303,6 +303,15 @@ class MasterServicer:
             self._job_metric_collector.collect_model_metric(req)
         return comm.Response(success=True)
 
+    def rpc_report_custom_data(self, req: comm.CustomData) -> comm.Response:
+        """Evaluator results / user counters into the stats pipeline
+        (parity: report_customized_data RPC). The dict is ONE row —
+        splitting it per key would detach eval metrics from their
+        step."""
+        if self._job_metric_collector and req.data:
+            self._job_metric_collector.collect_custom_metrics(req.data)
+        return comm.Response(success=True)
+
     # ----------------------------------------------------------------- sync
 
     def rpc_join_sync(self, req: comm.SyncJoin) -> comm.Response:

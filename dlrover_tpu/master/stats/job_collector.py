@@ -10,7 +10,7 @@ rather than a wall-clock thread.
 """
 
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.stats.reporter import JobMeta, StatsReporter
@@ -124,6 +124,13 @@ class JobMetricCollector:
     def collect_custom_data(self, key: str, value):
         self._custom[key] = value
         self._reporter.report_customized_data({key: value})
+
+    @_catch
+    def collect_custom_metrics(self, data: Dict):
+        """One report = one row: keys that belong together (an eval
+        step with its metrics) stay together in the archive."""
+        self._custom.update(data)
+        self._reporter.report_customized_data(dict(data))
 
     @_catch
     def collect_job_exit_reason(self, reason: str):

@@ -88,9 +88,20 @@ def build_platform(
                 "agents; no fleet automation"
             )
             return None, None
+        commands = {}
+        envs = {}
+        eval_cmd = list(
+            getattr(job_args, "evaluator_command", []) or []
+        )
+        if eval_cmd:
+            commands["evaluator"] = eval_cmd
+            envs["evaluator"] = dict(
+                getattr(job_args, "evaluator_env", {}) or {}
+            )
         scaler = ProcessScaler(
             job_name, master_addr, command=command,
             env=dict(getattr(job_args, "worker_env", {}) or {}),
+            commands=commands, envs=envs,
         )
         return scaler, scaler.watcher
     if platform != "local":

@@ -107,6 +107,20 @@ class JobArgs:
     critical_worker_index: Dict[int, int] = dataclasses.field(
         default_factory=dict
     )
+    # evaluator side-job role (parity: the reference's EvaluatorManager,
+    # master/node/worker.py EvaluatorManager role): an eval loop on a
+    # spare host consuming the job's flash checkpoints; never part of
+    # the training rendezvous, relaunched independently
+    evaluator_num: int = 0
+    evaluator_command: List[str] = dataclasses.field(
+        default_factory=list
+    )
+    evaluator_env: Dict[str, str] = dataclasses.field(
+        default_factory=dict
+    )
+    evaluator_resource: NodeResource = dataclasses.field(
+        default_factory=NodeResource
+    )
 
     @property
     def worker_group(self) -> NodeGroupResource:
@@ -154,6 +168,17 @@ class JobArgs:
                 int(worker.get("replicas", 1)),
             ),
         )
+        evaluator = spec.get("evaluator", {})
+        if evaluator:
+            eres = evaluator.get("resource", {})
+            args.evaluator_num = int(evaluator.get("replicas", 1))
+            args.evaluator_command = list(evaluator.get("command", []))
+            args.evaluator_env = dict(evaluator.get("env", {}))
+            args.evaluator_resource = NodeResource(
+                cpu=float(eres.get("cpu", 0)),
+                memory=parse_memory_mb(eres.get("memory", 0)),
+                tpu_type=evaluator.get("acceleratorType", ""),
+            )
         return args
 
     @classmethod

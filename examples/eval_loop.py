@@ -1,0 +1,61 @@
+"""Evaluator side-job entrypoint: eval loop over flash checkpoints.
+
+Launched by the master's scaler for ``spec.evaluator`` replicas::
+
+    spec:
+      worker: {replicas: 4, command: [...train...]}
+      evaluator:
+        replicas: 1
+        command: [python, examples/eval_loop.py, --ckpt-dir, /ckpt]
+
+The loop (trainer/evaluator.py) watches the training job's flash
+checkpoints, computes eval loss on a held-out batch for every new
+step, and reports results into the master's stats pipeline. Parity
+role: the reference's estimator evaluator replica
+(master/node/worker.py:32 EvaluatorManager).
+"""
+
+import argparse
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ckpt-dir", required=True)
+    parser.add_argument("--dim", type=int, default=16)
+    parser.add_argument("--eval-batch", type=int, default=64)
+    parser.add_argument("--poll", type=float, default=5.0)
+    parser.add_argument("--max-evals", type=int, default=0)
+    parser.add_argument("--out", default="")
+    args = parser.parse_args()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.trainer.evaluator import run_evaluator_from_env
+
+    # held-out data from a seed the training stream never uses
+    rng = np.random.RandomState(9999)
+    w_true = np.random.RandomState(0).randn(args.dim, 1).astype(
+        np.float32
+    )
+    x = rng.randn(args.eval_batch, args.dim).astype(np.float32)
+    y = (x @ w_true).astype(np.float32)
+
+    def eval_fn(state, step):
+        params = state["params"]
+        pred = x @ np.asarray(params["w"]) + np.asarray(params["b"])
+        loss = float(jnp.mean((pred - y) ** 2))
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(f"{step},{loss:.6f}\n")
+        return {"loss": loss}
+
+    n = run_evaluator_from_env(
+        eval_fn, ckpt_dir=args.ckpt_dir, poll_interval=args.poll,
+        max_evals=args.max_evals or None,
+    )
+    print(f"EVALUATOR done after {n} evals", flush=True)
+
+
+if __name__ == "__main__":
+    main()
