@@ -26,28 +26,58 @@ import json
 import os
 import sys
 
-N_CHIPS = 32
 V5P_HBM = 95e9
 V5P_PEAK = 459e12
-ICI_BW_V5P = 9e10  # bytes/s per link (v5p 2x v5e-class links)
+#: aggregate per-chip ICI bandwidth: v5p is a 3D torus (links on 3
+#: axes); collectives stripe across them, so the effective bandwidth is
+#: ~3x a single v5p link (~9e10 B/s)
+ICI_BW_V5P = 2.7e11
+#: fraction of fsdp param-gather traffic hidden under compute by XLA's
+#: async collectives (standard FSDP prefetch: gather block i+1 while
+#: computing block i) — the analyser charges the rest as exposed
+COMM_OVERLAP = 0.7
 
-GLOBAL_BATCH = 256  # sequences/step = 1.05M tokens at seq 4096
 SEQ_LEN = 4096
+
+#: the two BASELINE.json scale targets: the 7B/v5p-32 north star and
+#: the 70B/v5p-64 elastic config (BASELINE configs #3/#5)
+MODELS = {
+    "7b": {
+        "chips": 32,
+        "global_batch": 256,  # 1.05M tokens/step at seq 4096
+        "accum_steps": 1,
+        "meshes": [
+            {"fsdp": 32},
+            {"data": 2, "fsdp": 16},
+            {"data": 4, "fsdp": 8},
+            {"data": 8, "fsdp": 4},
+            {"fsdp": 16, "tensor": 2},
+            {"data": 2, "fsdp": 8, "tensor": 2},
+            {"fsdp": 8, "tensor": 4},
+        ],
+    },
+    "70b": {
+        "chips": 64,
+        "global_batch": 1024,  # 4.2M tokens/step (Llama-2 pretrain)
+        # 16 accumulation microbatches: one seq per chip per micro —
+        # at 70B the live-activation budget is set by the MICRObatch
+        "accum_steps": 16,
+        "meshes": [
+            {"fsdp": 64},
+            {"data": 2, "fsdp": 32},
+            {"fsdp": 32, "tensor": 2},
+            {"data": 4, "fsdp": 16},
+            {"fsdp": 16, "tensor": 4},
+            {"data": 2, "fsdp": 16, "tensor": 2},
+        ],
+    },
+}
 #: single-chip compute efficiency measured on real TPU in round 2
 #: (BENCH_r02.json: 50.66% MFU, llama-1b, dots remat, Pallas flash
 #: attention) — the prior the step-time model extrapolates from
 MEASURED_MFU_PRIOR = 0.5066
 
-#: candidate (data, fsdp, tensor) factorizations of 32 chips
-CANDIDATE_MESHES = [
-    {"fsdp": 32},
-    {"data": 2, "fsdp": 16},
-    {"data": 4, "fsdp": 8},
-    {"data": 8, "fsdp": 4},
-    {"fsdp": 16, "tensor": 2},
-    {"data": 2, "fsdp": 8, "tensor": 2},
-    {"fsdp": 8, "tensor": 4},
-]
+
 
 
 def _ensure_devices(n: int) -> None:
@@ -61,7 +91,9 @@ def _ensure_devices(n: int) -> None:
         pass
 
 
-def candidate_reports(cfg, global_batch: int, seq_len: int):
+def candidate_reports(cfg, global_batch: int, seq_len: int,
+                      meshes=None, n_chips: int = 32,
+                      accum_steps: int = 1):
     """Planner + analyser over every candidate mesh (no devices)."""
     import jax
 
@@ -79,7 +111,7 @@ def candidate_reports(cfg, global_batch: int, seq_len: int):
     axes_tree = llama.param_axes(cfg)
     profile = ModelProfile.from_llama(cfg, seq_len)
     out = []
-    for mesh_axes in CANDIDATE_MESHES:
+    for mesh_axes in meshes or MODELS["7b"]["meshes"]:
         param_axes_sizes = {
             k: v for k, v in mesh_axes.items()
             if k in ("fsdp", "tensor", "expert") and v > 1
@@ -88,7 +120,10 @@ def candidate_reports(cfg, global_batch: int, seq_len: int):
         try:
             plan = plan_rules(
                 abs_params, axes_tree, param_axes_sizes, V5P_HBM,
-                tokens_per_step=max(1, global_batch // dp) * seq_len,
+                # live activations scale with the per-device MICRObatch
+                tokens_per_step=max(
+                    1, global_batch // dp // accum_steps
+                ) * seq_len,
                 hidden_size=cfg.hidden_size, num_layers=cfg.num_layers,
                 ici_bandwidth=ICI_BW_V5P,
                 batch_axes=tuple(
@@ -110,15 +145,16 @@ def candidate_reports(cfg, global_batch: int, seq_len: int):
             sharding="tp_fsdp" if mesh_axes.get("tensor", 1) > 1
             else "fsdp",
             remat=cfg.remat,
+            accum_steps=accum_steps,
         )
         step_s = estimate_step_time(
             profile, strategy, global_batch, seq_len,
             peak_flops=V5P_PEAK, ici_bandwidth=ICI_BW_V5P,
-            mfu=MEASURED_MFU_PRIOR,
+            mfu=MEASURED_MFU_PRIOR, comm_overlap=COMM_OVERLAP,
         )
         tokens = global_batch * seq_len
         achieved = tokens * profile.flops_per_token / step_s
-        mfu = achieved / (V5P_PEAK * N_CHIPS)
+        mfu = achieved / (V5P_PEAK * n_chips)
         out.append({
             "mesh": mesh_axes,
             "feasible": True,
@@ -132,14 +168,15 @@ def candidate_reports(cfg, global_batch: int, seq_len: int):
             "planned_comm_ms": round(plan.comm_seconds * 1e3, 2),
             "predicted_step_seconds": round(step_s, 3),
             "predicted_tokens_per_sec_per_chip": round(
-                tokens / step_s / N_CHIPS, 1
+                tokens / step_s / n_chips, 1
             ),
             "predicted_mfu_percent": round(100 * mfu, 1),
         })
     return out
 
 
-def abstract_dryrun(cfg, chosen, global_batch: int, seq_len: int):
+def abstract_dryrun(cfg, chosen, global_batch: int, seq_len: int,
+                    accum: int = 8):
     """AOT-compile the real 7B step on 32 virtual devices; return XLA's
     per-device memory analysis (exact where the analyser approximates).
 
@@ -155,7 +192,8 @@ def abstract_dryrun(cfg, chosen, global_batch: int, seq_len: int):
     from dlrover_tpu.auto.accelerate import dryrun_abstract
     from dlrover_tpu.auto.strategy import Strategy
 
-    accum = 8
+    workload_accum = max(accum, 1)
+    accum = max(accum, 8)  # the compiled proof's floor (CPU attention)
     cfg_proof = _dc.replace(cfg, remat="minimal")
     strategy = Strategy(
         mesh_spec=tuple(chosen["mesh"].items()),
@@ -167,7 +205,39 @@ def abstract_dryrun(cfg, chosen, global_batch: int, seq_len: int):
     arg_b, temp_b, out_b = dryrun_abstract(
         cfg_proof, strategy, global_batch, seq_len
     )
+    # quantify what the CPU fallback adds that the TPU Pallas kernel
+    # never allocates: per (microbatch, layer) the einsum path holds
+    # the [b_micro, heads, s, s] scores in bf16 plus fp32 softmax and
+    # backward copies (~10 bytes/element total)
+    dp = strategy.axis("data") * strategy.axis("fsdp")
+    b_micro = max(1, global_batch // max(dp, 1) // accum)
+    score_gb = (
+        10.0 * b_micro * cfg.num_heads * seq_len * seq_len / 1e9
+    )
+    # the TPU path's analytic footprint under the REAL remat policy
+    from dlrover_tpu.auto.analyser import (
+        ModelProfile,
+        estimate_memory,
+    )
+
+    # the estimate must describe the PLANNED workload (its accum),
+    # not the proof config's accum floor
+    est = estimate_memory(
+        ModelProfile.from_llama(cfg, seq_len),
+        _dc.replace(
+            strategy, remat=cfg.remat, accum_steps=workload_accum
+        ),
+        global_batch, seq_len,
+    )
     return {
+        "tpu_path_estimate": {
+            "analytic_total_gb_per_device": round(est.total / 1e9, 2),
+            "remat": cfg.remat,
+            "fits_v5p_hbm": bool(est.total < V5P_HBM * 0.8),
+            "cpu_only_score_buffers_gb_per_microbatch_layer": round(
+                score_gb, 2
+            ),
+        },
         "proof_config": {
             "remat": "minimal", "accum_steps": accum,
             "note": "CPU-backend fallback attention materializes "
@@ -189,40 +259,58 @@ def abstract_dryrun(cfg, chosen, global_batch: int, seq_len: int):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--full", action="store_true",
-        help="also AOT-compile the real 7B step over 32 virtual "
-        "devices and record XLA memory analysis (minutes of compile)",
+        "--model", choices=sorted(MODELS), default="7b",
+        help="7b: the v5p-32 north star; 70b: the v5p-64 elastic "
+        "config (BASELINE configs #5)",
     )
     ap.add_argument(
-        "--out", default=os.path.join(
-            os.path.dirname(__file__), "..", "NORTHSTAR_7B.json"
-        ),
+        "--full", action="store_true",
+        help="also AOT-compile the real step over the virtual-device "
+        "mesh and record XLA memory analysis (minutes of compile)",
     )
+    ap.add_argument("--out", default="")
     args = ap.parse_args()
 
-    _ensure_devices(N_CHIPS)
+    target = MODELS[args.model]
+    n_chips = target["chips"]
+    global_batch = target["global_batch"]
+    if not args.out:
+        args.out = os.path.join(
+            os.path.dirname(__file__), "..",
+            f"NORTHSTAR_{args.model.upper()}.json",
+        )
+
+    _ensure_devices(n_chips)
     from dlrover_tpu.models import llama
     from dlrover_tpu.scheduler.job_spec import JobArgs
 
     # "dots" remat (the policy the measured 50.66% single-chip MFU
-    # used) fits comfortably once params shard over fsdp=32; chunked
+    # used) fits comfortably once params shard over fsdp; chunked
     # CE keeps the [tokens, vocab] fp32 logits off HBM
-    cfg = llama.llama2_7b(remat="dots", loss_chunk=1024)
-    reports = candidate_reports(cfg, GLOBAL_BATCH, SEQ_LEN)
+    builder = {"7b": llama.llama2_7b, "70b": llama.llama2_70b}
+    cfg = builder[args.model](remat="dots", loss_chunk=1024)
+    reports = candidate_reports(
+        cfg, global_batch, SEQ_LEN, meshes=target["meshes"],
+        n_chips=n_chips, accum_steps=target["accum_steps"],
+    )
     feasible = [r for r in reports if r["feasible"]]
     if not feasible:
         print(json.dumps({"error": "no feasible mesh"}))
         sys.exit(1)
     chosen = min(feasible, key=lambda r: r["predicted_step_seconds"])
 
-    # the job spec a real v5p-32 run would submit (examples/)
+    # the job spec a real run of this target would submit (examples/)
+    spec_file = {
+        "7b": "llama7b_v5p32.yaml", "70b": "llama70b_v5p64.yaml",
+    }[args.model]
     spec = JobArgs.from_file(os.path.join(
-        os.path.dirname(__file__), "..", "examples",
-        "llama7b_v5p32.yaml",
+        os.path.dirname(__file__), "..", "examples", spec_file,
     ))
 
     doc = {
-        "north_star": "Llama-2-7B on TPU v5p-32",
+        "north_star": (
+            f"Llama-2-{args.model.upper()} on TPU v5p-{n_chips}"
+        ),
         "model": {
             "params_b": round(llama.param_count(cfg) / 1e9, 2),
             **{
@@ -234,15 +322,16 @@ def main():
             },
         },
         "workload": {
-            "global_batch": GLOBAL_BATCH, "seq_len": SEQ_LEN,
-            "tokens_per_step": GLOBAL_BATCH * SEQ_LEN,
+            "global_batch": global_batch, "seq_len": SEQ_LEN,
+            "accum_steps": target["accum_steps"],
+            "tokens_per_step": global_batch * SEQ_LEN,
         },
         "chip": {
-            "kind": "v5p", "count": N_CHIPS,
+            "kind": "v5p", "count": n_chips,
             "hbm_gb": V5P_HBM / 1e9, "peak_bf16_tflops": V5P_PEAK / 1e12,
         },
         "job_spec": {
-            "file": "examples/llama7b_v5p32.yaml",
+            "file": f"examples/{spec_file}",
             "job_name": spec.job_name, "node_num": spec.node_num,
             "node_unit": spec.node_unit,
             "accelerator_type": spec.accelerator_type,
@@ -252,10 +341,13 @@ def main():
         "meets_mfu_bar": chosen["predicted_mfu_percent"] >= 45.0,
     }
     if args.full:
-        print("AOT-compiling the 7B step on 32 virtual devices...",
-              file=sys.stderr)
+        print(
+            f"AOT-compiling the {args.model} step on {n_chips} "
+            "virtual devices...", file=sys.stderr,
+        )
         doc["abstract_dryrun"] = abstract_dryrun(
-            cfg, chosen, GLOBAL_BATCH, SEQ_LEN
+            cfg, chosen, global_batch, SEQ_LEN,
+            accum=target["accum_steps"],
         )
     out_path = os.path.abspath(args.out)
     with open(out_path, "w") as f:

@@ -87,6 +87,10 @@ class NetworkCheckElasticAgent:
                 self._client, self._config.node_rank,
                 self._config.nproc_per_node,
                 rdzv_name=RendezvousName.NETWORK_CHECK,
+                rdzv_params=(
+                    self._config.min_nodes, self._config.max_nodes,
+                    self._config.rdzv_timeout, self._config.node_unit,
+                ),
             )
             rdzv_round, world, process_id, num_processes, coordinator = (
                 handler.next_rendezvous()

@@ -88,17 +88,29 @@ class MasterRendezvousHandler:
     def __init__(self, master_client: MasterClient, node_rank: int,
                  local_world_size: int,
                  rdzv_name: str = RendezvousName.TRAINING,
-                 join_timeout: float = RendezvousConstant.JOIN_TIMEOUT):
+                 join_timeout: float = RendezvousConstant.JOIN_TIMEOUT,
+                 rdzv_params: Optional[tuple] = None):
         self._client = master_client
         self._node_rank = node_rank
         self._local_world_size = local_world_size
         self._rdzv_name = rdzv_name
         self._join_timeout = join_timeout
+        #: (min_nodes, max_nodes, waiting_timeout, node_unit) —
+        #: re-reported before EVERY join so a relaunched (HA) master
+        #: relearns them; no round can complete against the defaults
+        #: (rdzv_manager._params_reported), so a single startup-time
+        #: report from rank 0 would deadlock a master restart
+        self._rdzv_params = rdzv_params
 
     def next_rendezvous(self):
         """Block until a world forms. Returns
         (round, world, process_id, num_processes, coordinator_addr)."""
         start = time.time()
+        if self._rdzv_params is not None:
+            try:
+                self._client.report_rdzv_params(*self._rdzv_params)
+            except Exception as e:
+                logger.warning("rdzv params report failed: %s", e)
         rdzv_round = self._client.join_rendezvous(
             self._node_rank, self._local_world_size, self._rdzv_name
         )
@@ -158,7 +170,11 @@ class ElasticTrainingAgent:
         self._config = config
         self._client = master_client
         self._rdzv_handler = MasterRendezvousHandler(
-            master_client, config.node_rank, config.nproc_per_node
+            master_client, config.node_rank, config.nproc_per_node,
+            rdzv_params=(
+                config.min_nodes, config.max_nodes,
+                config.rdzv_timeout, config.node_unit,
+            ),
         )
         self._restart_count = 0
         self._proc: Optional[subprocess.Popen] = None

@@ -140,12 +140,17 @@ def estimate_step_time(
     peak_flops: float = 197e12,
     mfu: float = 0.4,
     ici_bandwidth: float = 4.5e10,  # bytes/s per link, v5e
+    comm_overlap: float = 0.0,
 ) -> float:
     """Analytic seconds/step: compute + collective terms.
 
-    Collectives: fsdp all-gather+reduce-scatter moves ~2x sharded params
-    per step; tp moves ~activation-sized all-reduces per layer; pure DP
-    all-reduces the full gradient."""
+    Collectives: fsdp all-gathers params once per MICRObatch (the
+    gathered copy is freed after use, so accumulation re-gathers) and
+    reduce-scatters grads once per step; tp moves ~activation-sized
+    all-reduces per layer; pure DP all-reduces the full gradient.
+    ``comm_overlap`` discounts the fsdp param traffic for XLA's async
+    collectives (gather of block i+1 hidden under block i's compute —
+    the standard FSDP prefetch); 0 models fully exposed comm."""
     dp = strategy.axis("data") * strategy.axis("fsdp")
     tokens = global_batch * seq_len
     model_parallel = strategy.axis("tensor") * max(strategy.axis("seq"), 1)
@@ -158,9 +163,17 @@ def estimate_step_time(
     b = BYTES[strategy.precision]
     comm = 0.0
     if strategy.axis("fsdp") > 1:
-        # fsdp: all-gather(use)+reduce-scatter(grad); zero1/2: reduce-
-        # scatter(grad)+all-gather(update) — same ~2x param volume
-        comm += 2 * profile.param_count * b / ici_bandwidth
+        # fsdp: all-gather(use) PER MICROBATCH + reduce-scatter(grad)
+        # once; zero1/2: reduce-scatter(grad)+all-gather(update) — the
+        # per-micro factor only applies to param-sharded tables
+        per_micro = (
+            max(strategy.accum_steps, 1)
+            if strategy.sharding in ("fsdp", "tp_fsdp", "sequence")
+            else 1
+        )
+        comm += (
+            (per_micro + 1) * profile.param_count * b / ici_bandwidth
+        ) * (1.0 - comm_overlap)
     elif dp > 1:
         comm += 2 * profile.param_count * b / ici_bandwidth
     if strategy.axis("tensor") > 1:

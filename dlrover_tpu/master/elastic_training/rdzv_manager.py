@@ -44,6 +44,11 @@ class RendezvousManager(ABC):
         self._rdzv_nodes: Dict[int, int] = {}  # the latest completed world
         self._lastcall_time = 0.0
         self._rdzv_params = RendezvousParameters()
+        #: set once rank 0 reports the real min/max — before that, NO
+        #: round may complete: a fast-starting node joining against the
+        #: min=max=1 defaults would otherwise form a solo world while
+        #: the rest of the fleet is still launching
+        self._params_reported = False
         self._rdzv_round = 0
         self._node_unit = 1
         self._start_rdzv_ts = 0.0
@@ -58,6 +63,7 @@ class RendezvousManager(ABC):
                 min_nodes, max_nodes, waiting_timeout, node_unit,
                 join_timeout,
             )
+            self._params_reported = True
             self._node_unit = max(1, node_unit)
             logger.info(
                 "Rendezvous params: min=%d max=%d timeout=%s node_unit=%d",
@@ -149,7 +155,7 @@ class RendezvousManager(ABC):
         are not members of this world and keep polling)."""
         p = self._rdzv_params
         n = len(self._waiting_nodes)
-        if n == 0:
+        if n == 0 or not self._params_reported:
             return None
         if n >= p.max_nodes:
             ranks = sorted(self._waiting_nodes)[: p.max_nodes]

@@ -17,6 +17,10 @@ def model_module_for(cfg):
         from dlrover_tpu.models import llama
 
         return llama
+    if name == "CNNConfig":
+        from dlrover_tpu.models import cnn
+
+        return cnn
     raise TypeError(
         f"unknown model family config {type(cfg).__name__!r}; register "
         "it in models.model_module_for"

@@ -1,0 +1,153 @@
+"""Small conv-net family for image classification (elastic-DDP demo).
+
+Parity reference: the reference's MNIST CNN workload
+(model_zoo/pytorch/mnist/mnist_cnn.py — conv/conv/pool/fc/fc trained
+under elastic DDP) — BASELINE.json config #1. TPU shape: same
+models-package contract as llama/gpt (Config, init_params, param_axes,
+param_count, forward, loss, make_trainer), so the ShardedTrainer and
+auto layers drive it unchanged; convs lower to XLA convolutions that
+tile onto the MXU, batch shards over the data axes.
+"""
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class CNNConfig:
+    image_size: int = 28
+    channels: int = 1
+    num_classes: int = 10
+    conv_features: Tuple[int, int] = (32, 64)
+    hidden: int = 128
+    dtype: Any = jnp.float32
+
+
+def mnist_cnn(**kw) -> CNNConfig:
+    return CNNConfig(**kw)
+
+
+def init_params(rng: jax.Array, cfg: CNNConfig) -> Dict:
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    c1, c2 = cfg.conv_features
+    s = cfg.image_size // 4  # two stride-2 pools
+    flat = s * s * c2
+
+    def he(key, *shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32)
+            * (2.0 / fan_in) ** 0.5
+        ).astype(cfg.dtype)
+
+    return {
+        "conv1": he(k1, 3, 3, cfg.channels, c1, fan_in=9 * cfg.channels),
+        "b1": jnp.zeros((c1,), cfg.dtype),
+        "conv2": he(k2, 3, 3, c1, c2, fan_in=9 * c1),
+        "b2": jnp.zeros((c2,), cfg.dtype),
+        "fc1": he(k3, flat, cfg.hidden, fan_in=flat),
+        "fb1": jnp.zeros((cfg.hidden,), cfg.dtype),
+        "fc2": he(k4, cfg.hidden, cfg.num_classes, fan_in=cfg.hidden),
+        "fb2": jnp.zeros((cfg.num_classes,), cfg.dtype),
+    }
+
+
+def param_axes(cfg: CNNConfig) -> Dict:
+    """Logical axes: convs replicated (tiny), fc dims shardable."""
+    return {
+        "conv1": (None, None, None, None),
+        "b1": (None,),
+        "conv2": (None, None, None, None),
+        "b2": (None,),
+        "fc1": ("embed", "mlp"),
+        "fb1": ("mlp",),
+        "fc2": ("mlp", None),
+        "fb2": (None,),
+    }
+
+
+def param_count(cfg: CNNConfig) -> int:
+    c1, c2 = cfg.conv_features
+    s = cfg.image_size // 4
+    return (
+        9 * cfg.channels * c1 + c1
+        + 9 * c1 * c2 + c2
+        + s * s * c2 * cfg.hidden + cfg.hidden
+        + cfg.hidden * cfg.num_classes + cfg.num_classes
+    )
+
+
+def _conv(x, w, b):
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return jax.nn.relu(y + b)
+
+
+def _pool(x):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def forward(params: Dict, images: jax.Array, cfg: CNNConfig):
+    """images: [batch, H, W, C] -> logits [batch, num_classes]."""
+    x = images.astype(cfg.dtype)
+    x = _pool(_conv(x, params["conv1"], params["b1"]))
+    x = _pool(_conv(x, params["conv2"], params["b2"]))
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"] + params["fb1"])
+    return (x @ params["fc2"] + params["fb2"]).astype(jnp.float32)
+
+
+def loss(params: Dict, batch, cfg: CNNConfig) -> jax.Array:
+    """batch = (images [b,H,W,C], labels int32 [b]) -> mean CE.
+
+    Negative labels are MASKED (elastic tail-shard padding: the sampler
+    pads short shards to the compiled batch size; padded rows must not
+    pull gradients toward class 0)."""
+    images, labels = batch
+    logits = forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = (labels >= 0).astype(jnp.float32)
+    picked = jnp.take_along_axis(
+        logp, jnp.maximum(labels, 0)[:, None], axis=-1
+    )[:, 0]
+    return -jnp.sum(picked * valid) / jnp.maximum(
+        jnp.sum(valid), 1.0
+    )
+
+
+#: models-contract alias: the trainer layers call next_token_loss
+next_token_loss = loss
+
+
+def flops_per_token(cfg: CNNConfig, seq_len: int = 1) -> float:
+    """Per-EXAMPLE forward flops (the contract's "token" is one image)."""
+    c1, c2 = cfg.conv_features
+    hw = cfg.image_size * cfg.image_size
+    s = cfg.image_size // 4
+    return float(
+        2 * hw * 9 * cfg.channels * c1
+        + 2 * (hw // 4) * 9 * c1 * c2
+        + 2 * s * s * c2 * cfg.hidden
+        + 2 * cfg.hidden * cfg.num_classes
+    )
+
+
+def make_trainer(cfg: CNNConfig, mesh=None, strategy: str = "ddp",
+                 accum_steps: int = 1, optimizer=None, attn_fn=None):
+    from dlrover_tpu.parallel.mesh import create_mesh
+    from dlrover_tpu.trainer.sharded import ShardedTrainer
+
+    if mesh is None:
+        mesh = create_mesh([("data", -1)])
+    return ShardedTrainer(
+        lambda p, b: loss(p, b, cfg),
+        lambda k: init_params(k, cfg),
+        param_axes(cfg), mesh, strategy=strategy,
+        optimizer=optimizer, accum_steps=accum_steps,
+    )

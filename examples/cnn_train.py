@@ -1,0 +1,139 @@
+"""Elastic-DDP CNN classification — the MNIST-CNN workload shape.
+
+Parity reference: model_zoo/pytorch/mnist/mnist_cnn.py (the reference's
+canonical elastic-DDP demo; BASELINE.json config #1). Zero-egress image
+data: a procedural "digits" set (class-dependent 28x28 patterns +
+noise), streamed through the master's dynamic data sharding exactly
+like the reference streams MNIST through ElasticDistributedSampler.
+
+Run under the elastic launcher::
+
+    python -m dlrover_tpu.trainer.elastic_run --standalone \
+        examples/cnn_train.py -- --steps 60 --ckpt-dir /tmp/cnn_ckpt
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.agent.master_client import build_master_client
+from dlrover_tpu.agent.sharding.client import ShardingClient
+from dlrover_tpu.models import cnn
+from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+from dlrover_tpu.trainer.distributed import init_from_env
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+
+
+def make_digits(n=2048, size=28, num_classes=10, seed=0):
+    """Class-dependent stripe/blob patterns + noise: learnable but not
+    trivially separable; no dataset download needed."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n).astype(np.int32)
+    xs = rng.randn(n, size, size, 1).astype(np.float32) * 0.3
+    yy, xx = np.mgrid[0:size, 0:size]
+    for cls in range(num_classes):
+        mask = labels == cls
+        pattern = (
+            np.sin(xx * (cls + 1) * np.pi / size)
+            + np.cos(yy * (cls + 2) * np.pi / size)
+        ).astype(np.float32)[None, :, :, None]
+        xs[mask] += pattern
+    return xs, labels
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--ckpt-dir", type=str, default="/tmp/cnn_ckpt")
+    parser.add_argument("--out", type=str, default="")
+    args = parser.parse_args()
+
+    env = init_from_env()
+    client = build_master_client()
+
+    cfg = cnn.mnist_cnn()
+    images, labels = make_digits()
+    params = cnn.init_params(jax.random.key(0), cfg)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    trainer = ElasticTrainer(
+        lambda p, b: cnn.loss(p, b, cfg), opt,
+        max_nodes=max(1, env.node_num),
+        cur_nodes=max(1, env.node_num), master_client=client,
+        report_interval=5,
+    )
+    ckpt = FlashCheckpointer(
+        persist_dir=os.path.join(args.ckpt_dir, "persist"),
+        ram_dir=os.path.join(args.ckpt_dir, "ram"),
+        persist_interval=0, use_orbax=False,
+    )
+    state = {"params": params, "opt_state": opt_state,
+             "step": jnp.array(0)}
+    restored, _ = ckpt.restore(target=state)
+    start_step = 0
+    if restored is not None:
+        state = restored
+        start_step = int(state["step"])
+        print(f"RESTORED from step {start_step}", flush=True)
+
+    sharding = ShardingClient(
+        dataset_name="digits", batch_size=args.batch_size,
+        num_epochs=10**6, dataset_size=len(images), shuffle=True,
+        num_minibatches_per_shard=1, master_client=client,
+    )
+
+    params, opt_state = state["params"], state["opt_state"]
+    step = start_step
+    loss = None
+    while step < args.steps:
+        shard = sharding.fetch_shard()
+        if shard is None:
+            break
+        idx = (
+            shard.record_indices
+            if getattr(shard, "record_indices", None)
+            else list(range(shard.start, shard.end))
+        )
+        xb, yb = images[idx], labels[idx]
+        pad = args.batch_size - len(xb)
+        if pad > 0:
+            xb = np.pad(xb, ((0, pad), (0, 0), (0, 0), (0, 0)))
+            # label -1 marks padding; cnn.loss masks it out of the CE
+            yb = np.pad(yb, ((0, pad),), constant_values=-1)
+        batch = (xb[None], yb[None])  # single microbatch layout
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, batch
+        )
+        sharding.report_batch_done()
+        step += 1
+        trainer.report_step(step)
+        if step % 10 == 0 or step == args.steps:
+            ckpt.save(
+                step,
+                {"params": params, "opt_state": opt_state,
+                 "step": jnp.array(step)},
+            )
+
+    loss_val = float(loss) if loss is not None else float("nan")
+    # training accuracy on a fixed probe batch
+    logits = cnn.forward(params, jnp.asarray(images[:256]), cfg)
+    acc = float(
+        jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(labels[:256]))
+    )
+    print(f"FINAL step={step} loss={loss_val:.6f} acc={acc:.3f}",
+          flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(f"{step},{loss_val:.6f},{acc:.3f},{start_step}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -175,10 +175,12 @@ def test_agent_restarts_on_membership_change(master):
         script = _write_script(tmp, "import time; time.sleep(30)\n")
         config = ElasticLaunchConfig(
             min_nodes=1, max_nodes=2, node_rank=0, monitor_interval=0.2,
-            entrypoint=script,
+            rdzv_timeout=0.5, entrypoint=script,
         )
+        # the agent re-reports its config's rdzv params on every join
+        # (HA master restarts relearn them), so the config carries the
+        # short timeout rather than a one-shot report here
         c0 = _client(master, 0)
-        c0.report_rdzv_params(1, 2, 0.5, 1)
         agent = ElasticTrainingAgent(config, c0)
         t = threading.Thread(target=agent.run, daemon=True)
         t.start()
