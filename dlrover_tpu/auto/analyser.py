@@ -164,16 +164,17 @@ def estimate_step_time(
     comm = 0.0
     if strategy.axis("fsdp") > 1:
         # fsdp: all-gather(use) PER MICROBATCH + reduce-scatter(grad)
-        # once; zero1/2: reduce-scatter(grad)+all-gather(update) — the
-        # per-micro factor only applies to param-sharded tables
-        per_micro = (
-            max(strategy.accum_steps, 1)
-            if strategy.sharding in ("fsdp", "tp_fsdp", "sequence")
-            else 1
-        )
-        comm += (
-            (per_micro + 1) * profile.param_count * b / ici_bandwidth
-        ) * (1.0 - comm_overlap)
+        # once; zero1/2: reduce-scatter(grad)+all-gather(update). Only
+        # the fsdp per-micro GATHERS are prefetch-hidden (gather block
+        # i+1 under block i's compute) — the end-of-step grad
+        # reduce-scatter and zero1/2's update traffic have no compute
+        # to hide under and stay fully exposed.
+        param_vol = profile.param_count * b / ici_bandwidth
+        if strategy.sharding in ("fsdp", "tp_fsdp", "sequence"):
+            gathers = max(strategy.accum_steps, 1) * param_vol
+            comm += gathers * (1.0 - comm_overlap) + param_vol
+        else:  # zero1/zero2: RS(grad) + AG(update), both exposed
+            comm += 2 * param_vol
     elif dp > 1:
         comm += 2 * profile.param_count * b / ici_bandwidth
     if strategy.axis("tensor") > 1:

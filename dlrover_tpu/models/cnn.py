@@ -25,6 +25,19 @@ class CNNConfig:
     hidden: int = 128
     dtype: Any = jnp.float32
 
+    # -- auto-layer contract (ModelProfile.from_config reads these) ---
+    @property
+    def hidden_size(self) -> int:
+        return self.hidden
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.conv_features)
+
+    @property
+    def vocab_size(self) -> int:
+        return self.num_classes
+
 
 def mnist_cnn(**kw) -> CNNConfig:
     return CNNConfig(**kw)

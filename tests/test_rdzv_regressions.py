@@ -289,3 +289,47 @@ def test_no_straggler_when_all_groups_uniform():
         for r in range(4):
             m.report_network_check_result(r, True, 3.0, rdzv_round=got)
     assert m.get_straggler_nodes() == []
+
+
+def test_no_world_before_params_reported():
+    """A fast-starting node must not form a solo world against the
+    min=max=1 defaults while the rest of the fleet is still launching
+    (four-node drill flake class)."""
+    from dlrover_tpu.master.elastic_training.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    import time
+
+    m = ElasticTrainingRendezvousManager()
+    m.join_rendezvous(0, 1)
+    # even after the min=1 waiting_timeout would have elapsed, no
+    # round may complete while params are unreported
+    time.sleep(0.15)
+    rnd, _, world = m.get_comm_world(0)
+    assert world == {}
+    m.update_rdzv_params(1, 2, 0.1, 1)
+    # the node is still waiting from its first join; once params are
+    # known (min=1, timeout already elapsed) the round completes
+    _, _, world = m.get_comm_world(0)
+    assert 0 in world
+
+
+def test_ha_master_restart_relearns_params_from_rejoin():
+    """After a master (HA) relaunch the new managers start with
+    _params_reported=False; agents re-report their config's params
+    before every join (MasterRendezvousHandler), so the world re-forms
+    instead of deadlocking."""
+    from dlrover_tpu.master.elastic_training.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    # "relaunched master": a brand-new manager, nothing reported
+    m = ElasticTrainingRendezvousManager()
+    # surviving agents re-join; each re-reports params first (the
+    # handler's behavior) — simulate the same call order
+    for rank in (0, 1):
+        m.update_rdzv_params(2, 2, 5.0, 1)
+        m.join_rendezvous(rank, 1)
+    _, _, world = m.get_comm_world(0)
+    assert world == {0: 1, 1: 1}
