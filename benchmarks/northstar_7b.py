@@ -248,9 +248,13 @@ def abstract_dryrun(cfg, chosen, global_batch: int, seq_len: int,
         "xla_argument_gb_per_device": round(arg_b / 1e9, 2),
         "xla_temp_gb_per_device": round(temp_b / 1e9, 2),
         "xla_output_gb_per_device": round(out_b / 1e9, 2),
-        "xla_total_gb_per_device": round(
+        # arg+temp only: the real trainer donates params/opt-state via
+        # donate_argnums, so outputs alias arguments and do not add HBM;
+        # named explicitly so the sum is not mistaken for arg+temp+out
+        "xla_arg_plus_temp_gb_per_device": round(
             (arg_b + temp_b) / 1e9, 2
         ),
+        "output_donation_assumed": True,
         "fits_v5p_hbm": bool(arg_b + temp_b < V5P_HBM),
         "hbm_budget_gb": V5P_HBM / 1e9,
     }

@@ -302,6 +302,7 @@ class ElasticTrainingAgent:
         env[NodeEnv.NODE_ID] = str(self._config.node_rank)
         env[NodeEnv.NODE_NUM] = str(len(world))
         env[NodeEnv.RESTART_COUNT] = str(self._restart_count)
+        env[NodeEnv.RDZV_ROUND] = str(rdzv_round)
         env[NodeEnv.MASTER_ADDR] = self._client.master_addr
         # Make the framework importable in the spawned process even when it
         # is not pip-installed and the entrypoint lives in another directory

@@ -2,28 +2,158 @@
 
 The reference defines these in protobuf (dlrover/proto/elastic_training.proto:
 243-299) and generates gRPC stubs. We keep gRPC as the transport (it is
-device-agnostic control plane) but use plain dataclasses serialized with
-pickle over a single generic "Request/Response" envelope — no protoc step,
-same RPC surface. Every master RPC from the reference servicer
+device-agnostic control plane) but carry typed dataclasses over a single
+generic "Request/Response" envelope — no protoc step, same RPC surface.
+Every master RPC from the reference servicer
 (dlrover/python/master/servicer.py:62) has a message here.
+
+Codec: a schema'd JSON encoding, NOT pickle. Anything that can reach the
+master port is untrusted, and ``pickle.loads`` of network bytes executes
+arbitrary code; JSON can only produce primitives, and message
+construction goes through an explicit class registry — an unknown or
+malformed message raises :class:`WireError` instead of instantiating
+anything. Like protobuf, unknown *fields* on a known message are
+ignored (rolling-upgrade tolerance: an old master can parse a newer
+agent's message), while unknown message *types* are rejected.
+
+Wire forms (all JSON):
+  message   -> {"__msg__": "ClassName", "f": {field: value, ...}}
+  bytes     -> {"__b64__": "<base64>"}
+  dict      -> {"__map__": [[key, value], ...]}   (preserves int keys)
+  list/tuple-> [ ... ]        primitives -> as-is
 """
 
-import pickle
+import base64
+import dataclasses
+import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 
+class WireError(ValueError):
+    """A network payload failed schema validation; never executed."""
+
+
+try:
+    from numpy import generic as _np_generic
+except ImportError:  # pragma: no cover - numpy is a hard dep in practice
+    class _np_generic:  # type: ignore
+        pass
+
+
+#: message-type registry: populated by ``BaseMessage.__init_subclass__``
+#: — only classes defined in this module (imported before any decode)
+#: can ever be constructed from network bytes
+_REGISTRY: Dict[str, type] = {}
+
+
+def _encode(obj):
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, _np_generic):
+        # numpy scalars (np.float32 loss values etc.) flow in through
+        # free-form metric dicts; coerce to the Python scalar
+        return obj.item()
+    if isinstance(obj, bytes):
+        return {"__b64__": base64.b64encode(obj).decode("ascii")}
+    if isinstance(obj, BaseMessage):
+        return {
+            "__msg__": type(obj).__name__,
+            "f": {
+                f.name: _encode(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)
+            },
+        }
+    if isinstance(obj, dict):
+        for k in obj:
+            # map keys must survive a JSON round trip AND be hashable
+            # on decode — primitives only, enforced symmetrically here
+            # and in _decode so a payload we emit is always readable
+            # (numpy scalar keys coerce like values do)
+            if k is not None and not isinstance(
+                k, (bool, int, float, str, _np_generic)
+            ):
+                raise WireError(
+                    f"map key of type {type(k).__name__} not wire-safe"
+                )
+        return {
+            "__map__": [[_encode(k), _encode(v)] for k, v in obj.items()]
+        }
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    raise WireError(f"unencodable wire value of type {type(obj).__name__}")
+
+
+def _decode(obj):
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    if isinstance(obj, dict):
+        if "__b64__" in obj:
+            try:
+                return base64.b64decode(obj["__b64__"])
+            except Exception as e:
+                raise WireError(f"bad base64 payload: {e}")
+        if "__map__" in obj:
+            pairs = obj["__map__"]
+            if not isinstance(pairs, list):
+                raise WireError("__map__ payload is not a pair list")
+            out = {}
+            for pair in pairs:
+                if not isinstance(pair, list) or len(pair) != 2:
+                    raise WireError("__map__ entry is not a [k, v] pair")
+                key = _decode(pair[0])
+                if key is not None and not isinstance(
+                    key, (bool, int, float, str)
+                ):
+                    raise WireError(
+                        f"map key of type {type(key).__name__} "
+                        "not wire-safe"
+                    )
+                out[key] = _decode(pair[1])
+            return out
+        if "__msg__" in obj:
+            name = obj["__msg__"]
+            cls = _REGISTRY.get(name)
+            if cls is None:
+                raise WireError(f"unknown message type {name!r}")
+            fields_in = obj.get("f", {})
+            if not isinstance(fields_in, dict):
+                raise WireError(f"malformed fields for {name!r}")
+            known = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {
+                k: _decode(v) for k, v in fields_in.items() if k in known
+            }
+            try:
+                return cls(**kwargs)
+            except TypeError as e:
+                raise WireError(f"cannot construct {name!r}: {e}")
+        raise WireError(
+            f"unrecognized wire object (keys: {sorted(obj)[:4]})"
+        )
+    raise WireError(f"undecodable wire value of type {type(obj).__name__}")
+
+
 def serialize(msg) -> bytes:
-    return pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    return json.dumps(_encode(msg), separators=(",", ":")).encode("utf-8")
 
 
 def deserialize(data: bytes):
     if not data:
         return None
-    return pickle.loads(data)
+    try:
+        doc = json.loads(data.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"payload is not valid JSON: {e}")
+    return _decode(doc)
 
 
 class BaseMessage:
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        _REGISTRY[cls.__name__] = cls
+
     def serialize(self) -> bytes:
         return serialize(self)
 

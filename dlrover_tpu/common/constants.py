@@ -136,6 +136,11 @@ class NodeEnv:
     NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
     # restart bookkeeping
     RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    # the rendezvous round the worker was launched under: globally
+    # consistent across hosts of one world incarnation (unlike
+    # RESTART_COUNT, which is per-agent) — used as the checkpoint
+    # persist tier's save-attempt id
+    RDZV_ROUND = "DLROVER_TPU_RDZV_ROUND"
     # data sharding
     AUTO_SHARDING = "DLROVER_TPU_AUTO_SHARDING"
 

@@ -2,11 +2,14 @@
 
 The reference generates protobuf stubs from dlrover/proto/elastic_training.proto.
 Here the master service is a single generic unary RPC ``/dlrover_tpu.Master/call``
-carrying a pickled ``(method_name, request_message)`` pair; the servicer
-dispatches on ``method_name``. Identical RPC semantics, no protoc toolchain.
+carrying a schema'd JSON envelope ``{"v": 1, "m": method_name, "d": message}``
+(codec: common/comm.py — typed dataclass registry, no pickle anywhere on
+the network path); the servicer dispatches on ``method_name``. Identical
+RPC semantics, no protoc toolchain, and a malformed or unknown payload
+raises :class:`~dlrover_tpu.common.comm.WireError` instead of executing.
 """
 
-import pickle
+import json
 import socket
 import threading
 from concurrent import futures
@@ -14,11 +17,35 @@ from typing import Callable, Optional
 
 import grpc
 
+from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import GRPC
 from dlrover_tpu.common.log import default_logger as logger
 
 SERVICE_NAME = "dlrover_tpu.Master"
 METHOD_NAME = "call"
+WIRE_VERSION = 1
+
+
+def _pack_call(method: str, message) -> bytes:
+    return json.dumps({
+        "v": WIRE_VERSION,
+        "m": method,
+        "d": comm._encode(message),
+    }, separators=(",", ":")).encode("utf-8")
+
+
+def _unpack_call(payload: bytes):
+    try:
+        doc = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise comm.WireError(f"request is not valid JSON: {e}")
+    if not isinstance(doc, dict) or not isinstance(doc.get("m"), str):
+        raise comm.WireError("request envelope malformed")
+    if doc.get("v") != WIRE_VERSION:
+        raise comm.WireError(
+            f"unsupported wire version {doc.get('v')!r}"
+        )
+    return doc["m"], comm._decode(doc.get("d"))
 
 _GRPC_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
@@ -65,9 +92,15 @@ class GenericRpcServer:
 
     def _dispatch(self, request_bytes: bytes, context) -> bytes:
         try:
-            method, message = pickle.loads(request_bytes)
+            method, message = _unpack_call(request_bytes)
+        except comm.WireError as e:
+            # reject, never execute: schema violations are the caller's
+            # fault (or an attack), not a server error
+            logger.warning("rejected malformed RPC: %s", e)
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        try:
             result = self._handler(method, message)
-            return pickle.dumps(result, protocol=pickle.HIGHEST_PROTOCOL)
+            return comm.serialize(result)
         except Exception as e:
             logger.exception("RPC dispatch failed: %s", e)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -106,11 +139,9 @@ class GenericRpcClient:
 
     def call(self, method: str, message, timeout: Optional[float] = None):
         self._ensure_channel()
-        payload = pickle.dumps(
-            (method, message), protocol=pickle.HIGHEST_PROTOCOL
-        )
+        payload = _pack_call(method, message)
         response = self._callable(payload, timeout=timeout or self.timeout)
-        return pickle.loads(response)
+        return comm.deserialize(response)
 
     def close(self):
         with self._lock:

@@ -400,14 +400,26 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         member's elapsed time; localization needs two rounds with
         DIFFERENT pairings — the straggler is the common member of its
         slow groups (parity role: rdzv_manager.py:368's two-round
-        fault localization, applied to slowness). With only one
-        informative round, fall back to the per-node median threshold
-        (meaningful when times are per-node, e.g. solo probes)."""
+        fault localization, applied to slowness). When the probes were
+        collective (any recorded group has >=2 members), a single
+        informative round CANNOT localize — blame would smear over the
+        whole slow group and a shrink could evict a healthy victim —
+        so this returns [] until two informative rounds exist. The
+        per-node median threshold applies only when times are
+        genuinely per-node (solo probes, no group bookkeeping)."""
         with self._lock:
             sets = self._slow_sets(ratio)
             if len(sets) >= 2:
                 localized = set.intersection(*sets[-2:])
                 return sorted(localized)
+            grouped = any(
+                any(len(g) >= 2 for g in groups)
+                for groups in self._round_groups.values()
+            )
+            if grouped:
+                # group-level evidence exists but only len(sets) < 2
+                # informative rounds: wait for the re-pairing round
+                return []
             if not self._node_times:
                 return []
             times = sorted(self._node_times.values())

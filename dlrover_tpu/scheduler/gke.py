@@ -28,6 +28,7 @@ onto the Node status/exit-reason model:
 
 import queue
 import threading
+import urllib.parse
 from abc import ABC, abstractmethod
 from typing import Dict, Iterator, List, Optional
 
@@ -309,15 +310,13 @@ class RestK8sApi(K8sApi):
         cont = ""
         while True:
             path = f"api/v1/namespaces/{self._ns}/pods"
-            params = []
+            params = {}
             if self._job_name:
-                params.append(
-                    f"labelSelector=dlrover-job%3D{self._job_name}"
-                )
+                params["labelSelector"] = f"dlrover-job={self._job_name}"
             if cont:
-                params.append(f"continue={cont}")
+                params["continue"] = cont
             if params:
-                path += "?" + "&".join(params)
+                path += "?" + urllib.parse.urlencode(params)
             try:
                 resp = self._client.request("GET", path)
             except RestError as e:

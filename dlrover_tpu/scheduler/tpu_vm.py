@@ -17,6 +17,7 @@ set the platform layer needs; two implementations:
 import json
 import threading
 import time
+import urllib.parse
 import urllib.request
 from abc import ABC, abstractmethod
 from typing import Dict, List, Optional
@@ -232,7 +233,9 @@ class RestTpuVmApi(TpuVmApi):
         while True:
             path = f"{self._parent}/nodes"
             if page_token:
-                path += f"?pageToken={page_token}"
+                path += "?" + urllib.parse.urlencode(
+                    {"pageToken": page_token}
+                )
             try:
                 resp = self._client.request("GET", path)
             except RestError as e:

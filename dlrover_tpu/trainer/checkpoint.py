@@ -12,14 +12,22 @@ TPU-native shape:
     plus the sharding metadata; restore re-assembles global arrays with
     ``jax.make_array_from_single_device_arrays`` on the re-formed mesh.
   * Persistent tier — Orbax CheckpointManager (async), the JAX-standard
-    distributed checkpoint layout, usable across topology changes.
+    distributed checkpoint layout, usable across topology changes. When
+    Orbax is unavailable the fallback writes the SAME local-shard
+    archives through an :class:`~dlrover_tpu.trainer.ckpt_store.ObjectStore`
+    (``gs://`` bucket, or a directory shim for shared mounts/tests) —
+    a spare host restoring a dead host's state needs the persist tier
+    to be durable shared storage, never local disk. ``persist_dir``
+    accepts a URL (``gs://...``/``file://...``) or a plain path.
 
-Checkpoint atomicity: write to ``<dir>.tmp`` then ``os.rename``.
+Atomicity: RAM tier via tmp+``os.rename`` (local tmpfs); persist tier
+via a COMMIT marker written after the data objects (object stores have
+no rename — see ckpt_store.py for the layout). Archives are the npz+
+manifest format from ckpt_store (``numpy.load(allow_pickle=False)``) —
+no pickle on any tier, a corrupt or foreign file is rejected, not run.
 """
 
 import os
-import pickle
-import shutil
 import threading
 import time
 from dataclasses import dataclass
@@ -28,6 +36,7 @@ from typing import Any, Callable, Optional
 import jax
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.trainer import ckpt_store
 
 
 def default_ram_dir(job_name: str = "job") -> str:
@@ -124,20 +133,37 @@ class FlashCheckpointer:
         max_ram_keep: int = 2,
         max_persist_keep: int = 3,
         use_orbax: bool = True,
+        commit_timeout: float = 300.0,
     ):
-        self.persist_dir = os.path.abspath(persist_dir)
+        self.persist_dir = (
+            persist_dir if ckpt_store.is_url(persist_dir)
+            else os.path.abspath(persist_dir)
+        )
         self.ram_dir = ram_dir or default_ram_dir(
-            os.path.basename(persist_dir) or "job"
+            os.path.basename(persist_dir.rstrip("/")) or "job"
         )
         self.persist_interval = persist_interval
         self.max_ram_keep = max_ram_keep
+        self.max_persist_keep = max_persist_keep
+        self.commit_timeout = commit_timeout
         self._process_index = jax.process_index()
+        self._n_processes = jax.process_count()
+        # the save-attempt id scoping the COMMIT barrier (see
+        # ckpt_store.write_step): the rendezvous round is globally
+        # consistent across hosts of one world incarnation. Outside the
+        # elastic agent the fallback is the CONSTANT "0" — never a
+        # per-host value like RESTART_COUNT, which diverges after a
+        # single-host restart and would starve the barrier forever
+        # (processes writing different-attempt shards never commit)
+        from dlrover_tpu.common.constants import NodeEnv
+
+        self._attempt = os.getenv(NodeEnv.RDZV_ROUND, "0")
         os.makedirs(self.ram_dir, exist_ok=True)
-        os.makedirs(self.persist_dir, exist_ok=True)
         self._persist_lock = threading.Lock()
         self._pending_persist: Optional[threading.Thread] = None
         self._use_orbax = use_orbax
         self._manager = None
+        self._store: Optional[ckpt_store.ObjectStore] = None
         if use_orbax:
             try:
                 import orbax.checkpoint as ocp
@@ -152,9 +178,11 @@ class FlashCheckpointer:
             except Exception as e:  # pragma: no cover
                 logger.warning(
                     "Orbax unavailable (%s); persistent tier uses the "
-                    "shard-pickle format", e,
+                    "object-store shard-archive format", e,
                 )
                 self._use_orbax = False
+        if self._manager is None:
+            self._store = ckpt_store.get_store(self.persist_dir)
 
     # ------------------------------------------------------------------ save
 
@@ -162,13 +190,15 @@ class FlashCheckpointer:
         """RAM snapshot now; persistent save (async) on cadence."""
         t0 = time.time()
         snapshot = _local_shards(state)
-        self._write_ram(step, snapshot)
+        # serialize ONCE; both tiers write the same archive bytes
+        data = ckpt_store.snapshot_to_bytes(snapshot, step)
+        self._write_ram(step, data)
         ram_ms = (time.time() - t0) * 1000
         logger.info("Flash save step %d: RAM tier in %.0f ms", step, ram_ms)
         if force_persist or (
             self.persist_interval > 0 and step % self.persist_interval == 0
         ):
-            self._persist_async(step, state, snapshot)
+            self._persist_async(step, state, data)
         return ram_ms
 
     def _ram_path(self, step: int) -> str:
@@ -176,14 +206,11 @@ class FlashCheckpointer:
             self.ram_dir, f"step-{step}-proc-{self._process_index}"
         )
 
-    def _write_ram(self, step: int, snapshot):
+    def _write_ram(self, step: int, data: bytes):
         path = self._ram_path(step)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(
-                {"step": step, "state": snapshot}, f,
-                protocol=pickle.HIGHEST_PROTOCOL,
-            )
+            f.write(data)
         os.replace(tmp, path)
         self._gc_ram()
 
@@ -212,33 +239,56 @@ class FlashCheckpointer:
             pass
         return sorted(records)
 
-    def _persist_async(self, step: int, state: Any, snapshot):
+    def _persist_async(self, step: int, state: Any, data: bytes):
+        payload = [data]  # holder so the thread can drop the bytes
+
         def work():
-            with self._persist_lock:
-                try:
-                    if self._manager is not None:
+            try:
+                if self._manager is not None:
+                    with self._persist_lock:
                         self._manager.save(
                             step,
                             args=__import__(
                                 "orbax.checkpoint", fromlist=["args"]
                             ).args.StandardSave(jax.device_get(state)),
                         )
-                    else:
-                        path = os.path.join(
-                            self.persist_dir,
-                            f"step-{step}-proc-{self._process_index}",
-                        )
-                        tmp = path + ".tmp"
-                        with open(tmp, "wb") as f:
-                            pickle.dump(
-                                {"step": step, "state": snapshot}, f,
-                                protocol=pickle.HIGHEST_PROTOCOL,
-                            )
-                        os.replace(tmp, path)
                     logger.info("Persistent save step %d done", step)
-                except Exception as e:
-                    logger.error("Persistent save step %d failed: %s",
-                                 step, e)
+                    return
+                # the lock covers only the fast shard upload; the
+                # (possibly long) peer-await for COMMIT runs outside
+                # it, and the archive bytes are released first —
+                # otherwise a dead peer stalls every queued save and
+                # each queued thread pins a full archive in memory
+                with self._persist_lock:
+                    ckpt_store.put_shard(
+                        self._store, step, self._process_index,
+                        payload.pop(), attempt=self._attempt,
+                    )
+                committed = True
+                if self._process_index == 0:
+                    committed = ckpt_store.commit_step(
+                        self._store, step, self._n_processes,
+                        attempt=self._attempt,
+                        timeout=self.commit_timeout,
+                    )
+                    if committed:
+                        with self._persist_lock:
+                            # one gc'er: concurrent per-process deletes
+                            # of the same objects race for no benefit
+                            ckpt_store.gc_steps(
+                                self._store, self.max_persist_keep
+                            )
+                if committed:
+                    logger.info("Persistent save step %d done", step)
+                else:
+                    logger.error(
+                        "Persistent save step %d NOT committed: peer "
+                        "shards missing after %.0fs", step,
+                        self.commit_timeout,
+                    )
+            except Exception as e:
+                logger.error("Persistent save step %d failed: %s",
+                             step, e)
 
         t = threading.Thread(target=work, daemon=True,
                              name=f"persist-ckpt-{step}")
@@ -262,22 +312,15 @@ class FlashCheckpointer:
         if self._manager is not None:
             persist_step = self._manager.latest_step()
         else:
-            steps = self._list_persist_pickle()
-            persist_step = steps[-1][0] if steps else None
+            # per-process availability, not just global COMMITs: a step
+            # that lost this process's shard object must not be chosen
+            # over an older fully-restorable one
+            steps = ckpt_store.available_steps(
+                self._store, self._process_index
+            )
+            persist_step = steps[-1] if steps else None
         candidates = [s for s in (ram_step, persist_step) if s is not None]
         return max(candidates) if candidates else None
-
-    def _list_persist_pickle(self):
-        records = []
-        suffix = f"-proc-{self._process_index}"
-        for name in os.listdir(self.persist_dir):
-            if name.startswith("step-") and name.endswith(suffix):
-                try:
-                    step = int(name.split("-")[1])
-                except ValueError:
-                    continue
-                records.append((step, os.path.join(self.persist_dir, name)))
-        return sorted(records)
 
     def restore(self, target: Any = None, step: Optional[int] = None):
         """Restore (state, step), preferring the RAM tier.
@@ -287,15 +330,38 @@ class FlashCheckpointer:
         works after mesh re-formation.
         """
         ram = dict(self._list_ram())
+        auto_step = step is None
+        # one store scan serves both step selection and the fallback
+        # candidate list (each available_steps call lists the bucket
+        # and HEADs every committed step — don't do it twice)
+        avail: Optional[list] = None
+        if self._manager is None:
+            avail = ckpt_store.available_steps(
+                self._store, self._process_index
+            )
         if step is None:
-            step = self.latest_step()
+            if self._manager is not None:
+                step = self.latest_step()
+            else:
+                candidates_for_latest = [
+                    s for s in (
+                        max(ram) if ram else None,
+                        avail[-1] if avail else None,
+                    ) if s is not None
+                ]
+                step = (
+                    max(candidates_for_latest)
+                    if candidates_for_latest else None
+                )
         if step is None:
             return None, None
         if step in ram:
             try:
                 with open(ram[step], "rb") as f:
-                    payload = pickle.load(f)
-                state = _restore_shards(payload["state"], target)
+                    snapshot, _ = ckpt_store.snapshot_from_bytes(
+                        f.read(), target
+                    )
+                state = _restore_shards(snapshot, target)
                 logger.info("Restored step %d from RAM tier", step)
                 return state, step
             except Exception as e:
@@ -322,11 +388,37 @@ class FlashCheckpointer:
                 restored = self._manager.restore(step)
             logger.info("Restored step %d from persistent tier", step)
             return restored, step
-        steps = dict(self._list_persist_pickle())
-        if step in steps:
-            with open(steps[step], "rb") as f:
-                payload = pickle.load(f)
-            return _restore_shards(payload["state"], target), step
+        # auto-selection may land on a step whose persist shard is gone
+        # (e.g. a RAM-tier step never persisted): fall back down the
+        # restorable persist steps rather than restarting from scratch.
+        # An EXPLICITLY requested step never falls back — the caller
+        # asked for that step, not "the best available".
+        candidates = [step]
+        if auto_step:
+            candidates += [
+                s for s in reversed(avail or []) if s < step
+            ]
+        for cand in candidates:
+            try:
+                data = ckpt_store.read_step(
+                    self._store, cand, self._process_index
+                )
+                snapshot, _ = ckpt_store.snapshot_from_bytes(
+                    data, target
+                )
+            except (KeyError, ckpt_store.ArchiveError) as e:
+                # missing OR corrupt: keep walking down — an unreadable
+                # newest step must not abort the promised fallback
+                logger.warning(
+                    "Persist step %d unusable (%s); trying older", cand, e,
+                )
+                continue
+            if cand != step:
+                logger.warning(
+                    "Step %d not restorable from persist tier; "
+                    "restored older step %d", step, cand,
+                )
+            return _restore_shards(snapshot, target), cand
         return None, None
 
     def close(self):

@@ -1,0 +1,292 @@
+"""Object-store checkpoint tier + safe archive codec (VERDICT r3 #6).
+
+The persist tier must behave like a bucket (put/get/list, COMMIT-marker
+atomicity, no rename) and the archive format must be unexecutable
+(npz + JSON manifest, numpy allow_pickle=False) — a spare host reading
+another host's checkpoint is consuming network input.
+"""
+
+import json
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.trainer import ckpt_store
+from dlrover_tpu.trainer.checkpoint import FlashCheckpointer, _local_shards
+
+
+def _state():
+    return {
+        "params": {
+            "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "b": jnp.ones((4,), jnp.bfloat16),
+        },
+        "step": 7,
+    }
+
+
+def test_archive_round_trip_with_target():
+    state = _state()
+    snap = _local_shards(state)
+    data = ckpt_store.snapshot_to_bytes(snap, step=7)
+    got, step = ckpt_store.snapshot_from_bytes(data, target=state)
+    assert step == 7
+    restored_w = got["params"]["w"]
+    assert restored_w["__jax_shards__"]
+    np.testing.assert_array_equal(
+        restored_w["shards"][0][1], np.asarray(state["params"]["w"])
+    )
+    assert got["step"] == 7
+
+
+def test_archive_round_trip_without_target_nested_dicts():
+    data = ckpt_store.snapshot_to_bytes(_local_shards(_state()), step=3)
+    got, step = ckpt_store.snapshot_from_bytes(data)
+    assert step == 3
+    assert set(got) == {"params", "step"}
+    assert got["params"]["b"]["dtype"] == "bfloat16"
+
+
+def test_archive_rejects_pickle_and_garbage():
+    with pytest.raises(ckpt_store.ArchiveError):
+        ckpt_store.snapshot_from_bytes(pickle.dumps({"state": object()}))
+    with pytest.raises(ckpt_store.ArchiveError):
+        ckpt_store.snapshot_from_bytes(b"not a zip at all")
+
+
+def test_archive_rejects_unserializable_leaf_at_save():
+    with pytest.raises(ckpt_store.ArchiveError):
+        ckpt_store.snapshot_to_bytes({"fn": lambda x: x}, step=0)
+
+
+def test_archive_structure_mismatch_raises():
+    data = ckpt_store.snapshot_to_bytes(_local_shards(_state()), step=1)
+    with pytest.raises(ckpt_store.ArchiveError):
+        ckpt_store.snapshot_from_bytes(
+            data, target={"completely": {"different": jnp.zeros(2)}}
+        )
+
+
+def test_local_store_key_traversal_rejected(tmp_path):
+    store = ckpt_store.LocalFsStore(str(tmp_path / "root"))
+    with pytest.raises(KeyError):
+        store.put("../outside", b"x")
+    with pytest.raises(KeyError):
+        store.get("/etc/passwd")
+
+
+def test_commit_marker_gates_visibility(tmp_path):
+    """A step whose data objects exist but whose COMMIT does not is
+    invisible — object-store crash consistency without rename."""
+    store = ckpt_store.LocalFsStore(str(tmp_path))
+    store.put(ckpt_store.step_key(5, 0), b"data")  # no COMMIT
+    assert ckpt_store.committed_steps(store) == []
+    with pytest.raises(KeyError):
+        ckpt_store.read_step(store, 5, 0)
+    store.put(ckpt_store.commit_key(5), json.dumps({"step": 5}).encode())
+    assert ckpt_store.committed_steps(store) == [5]
+    assert ckpt_store.read_step(store, 5, 0) == b"data"
+
+
+def test_gc_keeps_newest_and_deletes_commit_first(tmp_path):
+    store = ckpt_store.LocalFsStore(str(tmp_path))
+    for s in (1, 2, 3):
+        ckpt_store.write_step(store, s, 0, b"d%d" % s)
+    ckpt_store.gc_steps(store, keep=2)
+    assert ckpt_store.committed_steps(store) == [2, 3]
+    assert not store.list("step-1/")
+
+
+def test_get_store_url_forms(tmp_path):
+    assert isinstance(
+        ckpt_store.get_store(str(tmp_path)), ckpt_store.LocalFsStore
+    )
+    s = ckpt_store.get_store(f"file://{tmp_path}/sub")
+    assert isinstance(s, ckpt_store.LocalFsStore)
+    assert ckpt_store.is_url("gs://b/p") and not ckpt_store.is_url("/p")
+
+
+def test_flash_checkpointer_persist_tier_cross_host(tmp_path):
+    """e2e: the writer persists through the store; a READER WITH A
+    DIFFERENT RAM DIR (a spare host — local tmpfs empty) restores from
+    the persist tier alone."""
+    persist = f"file://{tmp_path}/bucket"
+    writer = FlashCheckpointer(
+        persist_dir=persist, ram_dir=str(tmp_path / "ram_a"),
+        persist_interval=1, use_orbax=False,
+    )
+    state = _state()
+    writer.save(4, state, force_persist=True)
+    writer.wait()
+
+    reader = FlashCheckpointer(
+        persist_dir=persist, ram_dir=str(tmp_path / "ram_b"),
+        persist_interval=0, use_orbax=False,
+    )
+    assert reader.latest_step() == 4
+    restored, step = reader.restore(target=state)
+    assert step == 4
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]),
+        np.asarray(state["params"]["w"]),
+    )
+
+
+def test_evaluator_reads_object_store_tier(tmp_path):
+    """VERDICT r3 #6 'done' criterion: evaluator e2e against the shim —
+    eval host polls the shared store, never the trainer's local disk."""
+    from dlrover_tpu.trainer.evaluator import CheckpointEvaluator
+
+    persist = f"file://{tmp_path}/bucket"
+    trainer_ckpt = FlashCheckpointer(
+        persist_dir=persist, ram_dir=str(tmp_path / "trainer_ram"),
+        persist_interval=1, use_orbax=False,
+    )
+    state = _state()
+    trainer_ckpt.save(2, state, force_persist=True)
+    trainer_ckpt.wait()
+
+    eval_ckpt = FlashCheckpointer(
+        persist_dir=persist, ram_dir=str(tmp_path / "eval_ram"),
+        persist_interval=0, use_orbax=False,
+    )
+    seen = []
+
+    def eval_fn(st, step):
+        w = st["params"]["w"]
+        # no target: leaves arrive as shard-snap dicts; assemble
+        arr = w["shards"][0][1] if isinstance(w, dict) else np.asarray(w)
+        return {"w_sum": float(np.sum(arr)), "step": step}
+
+    ev = CheckpointEvaluator(
+        eval_ckpt, eval_fn,
+        report_fn=lambda step, res: seen.append((step, res)),
+        poll_interval=0.01,
+    )
+    res = ev.poll_once()
+    assert res is not None and res["step"] == 2
+    assert seen and seen[0][0] == 2
+    assert res["w_sum"] == float(np.sum(np.arange(12)))
+
+
+def test_multiproc_commit_waits_for_all_shards(tmp_path):
+    """Review fix: process 0 must not publish COMMIT until every
+    process's shard object is visible (the store IS the barrier)."""
+    store = ckpt_store.LocalFsStore(str(tmp_path))
+    # proc 0 writes alone with 2 expected processes and a tiny timeout:
+    # no COMMIT appears
+    ckpt_store.write_step(
+        store, 9, 0, b"p0", n_processes=2, commit_timeout=0.1
+    )
+    assert ckpt_store.committed_steps(store) == []
+    # peer shard lands, proc 0 retries: COMMIT appears
+    store.put(ckpt_store.step_key(9, 1), b"p1")
+    ckpt_store.write_step(
+        store, 9, 0, b"p0", n_processes=2, commit_timeout=1.0
+    )
+    assert ckpt_store.committed_steps(store) == [9]
+
+
+def test_restore_falls_back_to_older_available_step(tmp_path):
+    """Review fix: a committed step missing THIS process's shard must
+    not shadow an older fully-restorable step."""
+    persist = str(tmp_path / "bucket")
+    ckpt = FlashCheckpointer(
+        persist_dir=persist, ram_dir=str(tmp_path / "ram"),
+        persist_interval=1, use_orbax=False,
+    )
+    state = _state()
+    ckpt.save(2, state, force_persist=True)
+    ckpt.wait()
+    # forge a torn newer step: COMMIT without this proc's shard
+    store = ckpt_store.get_store(persist)
+    store.put(ckpt_store.commit_key(5), json.dumps({"step": 5}).encode())
+
+    fresh = FlashCheckpointer(
+        persist_dir=persist, ram_dir=str(tmp_path / "ram2"),
+        persist_interval=0, use_orbax=False,
+    )
+    assert fresh.latest_step() == 2  # torn step invisible
+    restored, step = fresh.restore(target=state)
+    assert step == 2 and restored is not None
+    # explicit request for the torn step does NOT silently fall back
+    restored, step = fresh.restore(target=state, step=5)
+    assert restored is None and step is None
+
+
+def test_stale_attempt_shards_cannot_satisfy_commit_barrier(tmp_path):
+    """Review fix: an orphan shard from a crashed earlier attempt at
+    the SAME step must not let proc 0 commit a mixed-run step."""
+    store = ckpt_store.LocalFsStore(str(tmp_path))
+    # run 1: proc 1's shard landed, proc 0 died -> no COMMIT
+    store.put(ckpt_store.step_key(100, 1, attempt="1"), b"old-p1")
+    # run 2 (attempt 2): proc 0 writes; barrier must NOT see old-p1
+    ckpt_store.write_step(
+        store, 100, 0, b"new-p0", n_processes=2,
+        commit_timeout=0.1, attempt="2",
+    )
+    assert ckpt_store.committed_steps(store) == []
+    # run 2's peer lands with the matching attempt -> commit succeeds
+    store.put(ckpt_store.step_key(100, 1, attempt="2"), b"new-p1")
+    ckpt_store.write_step(
+        store, 100, 0, b"new-p0", n_processes=2,
+        commit_timeout=1.0, attempt="2",
+    )
+    assert ckpt_store.committed_steps(store) == [100]
+    # readers get run 2's shard, not the orphan
+    assert ckpt_store.read_step(store, 100, 1) == b"new-p1"
+
+
+def test_gc_removes_orphaned_uncommitted_steps(tmp_path):
+    """Review fix: shards of never-committed steps older than the
+    newest committed step are pruned (bounded storage), while an
+    in-flight newer step is untouched."""
+    store = ckpt_store.LocalFsStore(str(tmp_path))
+    store.put(ckpt_store.step_key(3, 1), b"orphan")  # torn old save
+    ckpt_store.write_step(store, 10, 0, b"committed")
+    store.put(ckpt_store.step_key(12, 0), b"in-flight")  # newer, no COMMIT
+    ckpt_store.gc_steps(store, keep=3)
+    assert not store.list("step-3/")          # orphan swept
+    assert ckpt_store.committed_steps(store) == [10]
+    assert store.list("step-12/")             # in-flight preserved
+
+
+def test_corrupt_newest_step_falls_back_to_older(tmp_path):
+    """Review fix: ArchiveError on the newest persist step continues
+    the fallback walk instead of crashing restore."""
+    persist = str(tmp_path / "bucket")
+    ckpt = FlashCheckpointer(
+        persist_dir=persist, ram_dir=str(tmp_path / "ram"),
+        persist_interval=1, use_orbax=False,
+    )
+    state = _state()
+    ckpt.save(2, state, force_persist=True)
+    ckpt.wait()
+    store = ckpt_store.get_store(persist)
+    # forge a committed-but-corrupt newer step
+    store.put(ckpt_store.step_key(8, 0), b"garbage not a zip")
+    store.put(ckpt_store.commit_key(8), json.dumps({"step": 8}).encode())
+
+    fresh = FlashCheckpointer(
+        persist_dir=persist, ram_dir=str(tmp_path / "ram2"),
+        persist_interval=0, use_orbax=False,
+    )
+    restored, step = fresh.restore(target=state)
+    assert step == 2 and restored is not None
+
+
+def test_exists_is_metadata_only(tmp_path, monkeypatch):
+    """Review fix: availability checks must not download the blob."""
+    store = ckpt_store.LocalFsStore(str(tmp_path))
+    store.put("k", b"x" * 1000)
+    monkeypatch.setattr(
+        ckpt_store.LocalFsStore, "get",
+        lambda self, key: (_ for _ in ()).throw(
+            AssertionError("exists() downloaded the object")
+        ),
+    )
+    assert store.exists("k") and not store.exists("missing")

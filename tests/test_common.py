@@ -80,3 +80,140 @@ def test_priority_half_rule():
     assert [n.config_resource.priority for n in nodes] == [
         "high", "high", "low", "low",
     ]
+
+
+# ---------------------------------------------------------------- wire codec
+
+
+def test_wire_codec_round_trips_nested_and_typed_keys():
+    """The schema'd JSON codec (comm.py) must preserve nested messages,
+    int-keyed dicts, and bytes — the three shapes plain JSON loses."""
+    from dlrover_tpu.common import comm
+
+    task = comm.Task(
+        task_id=3,
+        task_type="train",
+        shard=comm.Shard(name="ds", start=10, end=20,
+                         record_indices=[1, 2, 3]),
+    )
+    got = comm.deserialize(comm.serialize(task))
+    assert got == task and isinstance(got.shard, comm.Shard)
+
+    world = comm.CommWorld(rdzv_round=2, group=0, world={0: 4, 3: 4})
+    got = comm.deserialize(comm.serialize(world))
+    assert got.world == {0: 4, 3: 4}
+    assert all(isinstance(k, int) for k in got.world)
+
+    kv = comm.KVStoreSetRequest(key="k", value=b"\x00\xffraw")
+    assert comm.deserialize(comm.serialize(kv)).value == b"\x00\xffraw"
+
+
+def test_wire_codec_rejects_unknown_and_malformed():
+    """An unknown or malformed network payload raises WireError —
+    nothing is instantiated or executed (VERDICT r3 Weak #1)."""
+    import json
+    import pickle
+
+    import pytest
+
+    from dlrover_tpu.common import comm
+
+    # a pickle payload (the old wire format / an attack) is rejected
+    with pytest.raises(comm.WireError):
+        comm.deserialize(pickle.dumps(("get_task", object())))
+    # unknown message type
+    evil = json.dumps(
+        {"__msg__": "os.system", "f": {}}
+    ).encode()
+    with pytest.raises(comm.WireError):
+        comm.deserialize(evil)
+    # a plain dict that is not one of the sentinel shapes
+    with pytest.raises(comm.WireError):
+        comm.deserialize(json.dumps({"a": 1}).encode())
+    # non-JSON bytes
+    with pytest.raises(comm.WireError):
+        comm.deserialize(b"\x80\x05junk")
+    # unknown FIELDS on a known type are ignored (rolling upgrade),
+    # not an error
+    newer = json.dumps({
+        "__msg__": "HeartBeat",
+        "f": {"timestamp": 1.0, "field_from_the_future": 9},
+    }).encode()
+    msg = comm.deserialize(newer)
+    assert isinstance(msg, comm.HeartBeat) and msg.timestamp == 1.0
+
+
+def test_wire_codec_refuses_unencodable_values():
+    import pytest
+
+    from dlrover_tpu.common import comm
+
+    with pytest.raises(comm.WireError):
+        comm.serialize(object())
+
+
+def test_rpc_server_rejects_malformed_without_executing():
+    """End-to-end over real gRPC: a malformed envelope gets
+    INVALID_ARGUMENT and the handler is never invoked."""
+    import grpc
+    import pytest
+
+    from dlrover_tpu.common import grpc_utils
+
+    calls = []
+
+    def handler(method, message):
+        calls.append(method)
+        return None
+
+    server = grpc_utils.GenericRpcServer(handler, port=0)
+    server.start()
+    try:
+        channel = grpc.insecure_channel(f"localhost:{server.port}")
+        raw = channel.unary_unary(
+            f"/{grpc_utils.SERVICE_NAME}/{grpc_utils.METHOD_NAME}",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        import pickle
+
+        with pytest.raises(grpc.RpcError) as ei:
+            raw(pickle.dumps(("ping", None)), timeout=5)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert calls == []
+        channel.close()
+    finally:
+        server.stop(0)
+
+
+def test_wire_codec_map_keys_must_be_primitive():
+    """Review fix: unhashable/non-primitive map keys are a WireError on
+    BOTH encode and decode — never a TypeError escaping the contract."""
+    import json
+
+    import pytest
+
+    from dlrover_tpu.common import comm
+
+    with pytest.raises(comm.WireError):
+        comm.serialize(comm.CustomData(data={(1, 2): "tuple-key"}))
+    evil = json.dumps({"__map__": [[[1, 2], 3]]}).encode()
+    with pytest.raises(comm.WireError):
+        comm.deserialize(evil)
+
+
+def test_wire_codec_coerces_numpy_scalars():
+    """Review fix: numpy scalars in free-form metric dicts must encode
+    (the evaluator reports np.float32 losses through CustomData)."""
+    import numpy as np
+
+    from dlrover_tpu.common import comm
+
+    msg = comm.CustomData(data={
+        "loss": np.float32(0.5), "n": np.int64(3),
+        np.int32(7): "np-key",
+    })
+    got = comm.deserialize(comm.serialize(msg))
+    assert got.data["loss"] == 0.5 and isinstance(got.data["loss"], float)
+    assert got.data["n"] == 3 and isinstance(got.data["n"], int)
+    assert got.data[7] == "np-key"
