@@ -21,6 +21,78 @@ import jax.numpy as jnp
 import numpy as np
 
 BASELINE_HFU_PERCENT = 49.6
+#: the reference's CRITEO Wide&Deep rate AFTER DeepRec PS autoscaling
+#: added 3 workers (docs/blogs/deeprec_autoscale_cn.md:223, BASELINE.md)
+BASELINE_DLRM_STEPS_PER_SEC = 100.0
+
+
+def bench_dlrm():
+    """Single-chip recommender throughput (BASELINE config #4).
+
+    The reference's comparable is steps/sec on the CRITEO Wide&Deep
+    job: 30 -> 100 step/s after DeepRec's PS autoscaler added 3
+    workers (CPU cluster). Here the same model shape (dim-8 deep
+    embeddings + wide tower over the CRITEO vocab stats) trains on one
+    TPU chip with the vocab-stacked table — no PS tier at all;
+    vs_baseline = our steps/sec over their post-scaling 100."""
+    import optax
+
+    from dlrover_tpu.models import dlrm
+    from dlrover_tpu.parallel.mesh import create_mesh
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    cfg = dlrm.criteo_wide_deep()
+    batch = 4096 if on_tpu else 256
+    steps, warmup = (30, 5) if on_tpu else (6, 2)
+
+    mesh = create_mesh([("data", 1), ("fsdp", 1)], devices=[dev])
+    trainer = dlrm.make_trainer(
+        cfg, mesh, optimizer=optax.adagrad(0.05)
+    )
+    params, opt_state = trainer.init(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    dense = rng.standard_normal(
+        (1, batch, cfg.dense_dim), dtype=np.float32
+    )
+    cat = np.stack(
+        [rng.integers(0, s, (1, batch)) for s in cfg.vocab_sizes], -1
+    ).astype(np.int32)
+    labels = rng.integers(0, 2, (1, batch)).astype(np.int32)
+    mb = trainer.shard_batch((dense, cat, labels))
+
+    for _ in range(warmup):
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, mb
+        )
+    float(loss)  # hard sync (axon tunnel ignores block_until_ready)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, mb
+        )
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    step_time = dt / steps
+    sps = 1.0 / step_time
+    print(json.dumps({
+        "metric": "dlrm_steps_per_sec",
+        "value": round(sps, 1),
+        "unit": "steps/s",
+        "vs_baseline": round(sps / BASELINE_DLRM_STEPS_PER_SEC, 3),
+        "baseline": "DeepRec CRITEO Wide&Deep 100 step/s after PS "
+        "autoscale (deeprec_autoscale_cn.md:223)",
+        "examples_per_sec": round(batch * sps, 1),
+        "batch": batch,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "table_rows": cfg.padded_vocab,
+        "embed_dim": cfg.embed_dim,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "platform": dev.platform,
+        "final_loss": round(loss_val, 4),
+    }))
 
 
 class _BenchProducer:
@@ -59,7 +131,16 @@ def main():
         "C++ shm ring + DevicePrefetch (the production data plane) "
         "instead of reusing one in-memory batch",
     )
+    ap.add_argument(
+        "--model", choices=["llama", "dlrm"], default="llama",
+        help="dlrm: the CRITEO recommender bench (steps/sec vs the "
+        "reference's DeepRec autoscaling claim) instead of the "
+        "headline Llama MFU",
+    )
     args = ap.parse_args()
+    if args.model == "dlrm":
+        bench_dlrm()
+        return
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"

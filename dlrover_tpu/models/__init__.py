@@ -21,6 +21,10 @@ def model_module_for(cfg):
         from dlrover_tpu.models import cnn
 
         return cnn
+    if name == "DLRMConfig":
+        from dlrover_tpu.models import dlrm
+
+        return dlrm
     raise TypeError(
         f"unknown model family config {type(cfg).__name__!r}; register "
         "it in models.model_module_for"

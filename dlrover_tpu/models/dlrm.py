@@ -1,0 +1,315 @@
+"""Sparse-embedding recommender family (DLRM / Wide&Deep class).
+
+Parity reference: the reference's CRITEO click-through workload —
+Wide&Deep / DeepFM / xDeepFM estimators over DeepRec embedding
+variables partitioned across an elastic PS fleet
+(model_zoo/tf_estimator/criteo_deeprec/deepctr_models.py:121,457 —
+13 continuous "I*" + 26 categorical "C*" columns, per-feature vocab
+stats at :91, wide part = dim-1 embeddings, deep part = dim-8
+embeddings into a DNN; BASELINE config #4, the DeepRec autoscaling
+blog's 30->100 step/s job).
+
+TPU-native redesign (NO parameter servers):
+  * all 26 categorical vocabs stack into ONE table ``[total_vocab, d]``
+    with per-feature row offsets; rows shard over the mesh via the
+    ordinary "vocab" logical axis (parallel/sharding.py "rowwise"
+    strategy) — HBM over the mesh is the PS fleet, and elasticity is
+    the same resharding restore every other family uses.
+  * lookups are Megatron-style vocab-parallel gathers under shard_map
+    (parallel/embedding.py): masked local gather + psum, static shapes,
+    table gradients scatter-add only into owned rows.
+  * the wide (linear) part is a second stacked table with dim 1,
+    sharded the same way — Wide&Deep's two towers, one mechanism.
+  * dense features go through a bottom MLP; a DLRM dot-interaction
+    crosses embedding/dense latents (the FM role in DeepFM); a top MLP
+    emits the click logit. MLPs are tiny and stay replicated — the
+    model's scale lives in the tables, which is exactly why the
+    reference needed a PS and this design needs a mesh.
+"""
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.parallel.embedding import (
+    feature_offsets,
+    stack_ids,
+    vocab_parallel_lookup,
+)
+
+#: per-feature vocabulary sizes of the CRITEO categorical columns
+#: (reference deepctr_models.py:91 _CATEGORY_FEATURE_STATS C1..C26)
+CRITEO_VOCAB_SIZES = (
+    1036, 530, 169550, 71524, 241, 15, 10025, 458, 3, 22960, 4469,
+    144780, 3034, 26, 7577, 113860, 10, 3440, 1678, 3, 130892, 11, 14,
+    27189, 65, 20188,
+)
+CRITEO_DENSE = 13  # I1..I13
+
+
+@dataclasses.dataclass(frozen=True)
+class DLRMConfig:
+    vocab_sizes: Tuple[int, ...] = CRITEO_VOCAB_SIZES
+    dense_dim: int = CRITEO_DENSE
+    embed_dim: int = 16
+    bottom_mlp: Tuple[int, ...] = (64, 16)
+    top_mlp: Tuple[int, ...] = (64, 32)
+    interaction: str = "dot"  # "dot" (DLRM/FM role) | "concat"
+    dtype: Any = jnp.float32
+    #: table rows are padded up to a multiple of this so the row dim
+    #: divides any plausible shard count (ids never reference padding)
+    row_align: int = 1024
+
+    def __post_init__(self):
+        if self.interaction == "dot" and self.bottom_mlp and (
+            self.bottom_mlp[-1] != self.embed_dim
+        ):
+            raise ValueError(
+                f"dot interaction needs bottom_mlp[-1] == embed_dim "
+                f"({self.bottom_mlp[-1]} != {self.embed_dim}): the "
+                "dense latent joins the pairwise dot with the embeddings"
+            )
+
+    @property
+    def total_vocab(self) -> int:
+        return int(sum(self.vocab_sizes))
+
+    @property
+    def padded_vocab(self) -> int:
+        a = max(1, self.row_align)
+        return (self.total_vocab + a - 1) // a * a
+
+    @property
+    def num_features(self) -> int:
+        return len(self.vocab_sizes)
+
+    # -- auto-layer contract (analyser/planner read these) -------------
+    @property
+    def hidden_size(self) -> int:
+        return self.embed_dim
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.bottom_mlp) + len(self.top_mlp)
+
+    @property
+    def vocab_size(self) -> int:
+        return self.total_vocab
+
+
+def criteo_wide_deep(**kw) -> DLRMConfig:
+    """The reference workload's shape: dim-8 deep embeddings + wide
+    linear part (deepctr_models.py DEEP_EMBEDDING_DIM=8)."""
+    kw.setdefault("embed_dim", 8)
+    kw.setdefault("bottom_mlp", (16, 8))
+    kw.setdefault("top_mlp", (16, 4))
+    return DLRMConfig(**kw)
+
+
+def dlrm_large(total_vocab: int = 400_000_000, embed_dim: int = 32,
+               **kw) -> DLRMConfig:
+    """A production-recommender scale point: the stacked table alone
+    (f32) is ``total_vocab*embed_dim*4`` bytes — 51.2 GB at the
+    defaults, far beyond one chip's HBM; only the mesh holds it."""
+    n = 26
+    base = total_vocab // n
+    sizes = tuple(
+        base + (total_vocab - base * n if i == n - 1 else 0)
+        for i in range(n)
+    )
+    kw.setdefault("bottom_mlp", (64, embed_dim))
+    return DLRMConfig(vocab_sizes=sizes, embed_dim=embed_dim, **kw)
+
+
+# ---------------------------------------------------------------- params
+
+
+def init_params(rng: jax.Array, cfg: DLRMConfig) -> Dict:
+    n_mlp = len(cfg.bottom_mlp) + len(cfg.top_mlp) + 1
+    keys = jax.random.split(rng, 2 + n_mlp)
+    v, d = cfg.padded_vocab, cfg.embed_dim
+
+    def dense_stack(kseq, in_dim, widths):
+        layers = []
+        for k, w in zip(kseq, widths):
+            layers.append({
+                "w": (jax.random.normal(k, (in_dim, w), jnp.float32)
+                      * (2.0 / in_dim) ** 0.5).astype(cfg.dtype),
+                "b": jnp.zeros((w,), cfg.dtype),
+            })
+            in_dim = w
+        return layers, in_dim
+
+    bottom, bot_out = dense_stack(
+        jax.random.split(keys[2], len(cfg.bottom_mlp)),
+        cfg.dense_dim, cfg.bottom_mlp,
+    )
+    top_in = _interaction_dim(cfg, bot_out)
+    top, top_out = dense_stack(
+        jax.random.split(keys[3], len(cfg.top_mlp)),
+        top_in, cfg.top_mlp,
+    )
+    return {
+        # embedding rows ~U(-1/sqrt(d), 1/sqrt(d)) (standard recsys init)
+        "table": jax.random.uniform(
+            keys[0], (v, d), jnp.float32, -1.0, 1.0
+        ) / (d ** 0.5),
+        "wide": jnp.zeros((v, 1), jnp.float32),
+        "bottom": bottom,
+        "top": top,
+        "head": {
+            "w": (jax.random.normal(keys[4], (top_out, 1), jnp.float32)
+                  * (1.0 / top_out) ** 0.5).astype(cfg.dtype),
+            "b": jnp.zeros((1,), cfg.dtype),
+        },
+    }
+
+
+def param_axes(cfg: DLRMConfig) -> Dict:
+    """Logical axes: both tables row-sharded ("vocab"); MLPs tiny ->
+    replicated."""
+    return {
+        "table": ("vocab", None),
+        "wide": ("vocab", None),
+        "bottom": [{"w": (None, None), "b": (None,)}
+                   for _ in cfg.bottom_mlp],
+        "top": [{"w": (None, None), "b": (None,)} for _ in cfg.top_mlp],
+        "head": {"w": (None, None), "b": (None,)},
+    }
+
+
+def param_count(cfg: DLRMConfig) -> int:
+    n = cfg.padded_vocab * (cfg.embed_dim + 1)
+    in_dim = cfg.dense_dim
+    for w in cfg.bottom_mlp:
+        n += in_dim * w + w
+        in_dim = w
+    t = _interaction_dim(cfg, in_dim)
+    for w in cfg.top_mlp:
+        n += t * w + w
+        t = w
+    return n + t + 1
+
+
+def _interaction_dim(cfg: DLRMConfig, bot_out: int) -> int:
+    f = cfg.num_features + 1  # +1: the dense latent joins the dot
+    if cfg.interaction == "dot":
+        return f * (f - 1) // 2 + bot_out
+    return cfg.num_features * cfg.embed_dim + bot_out
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _mlp(layers, x, dtype):
+    for layer in layers:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    return x
+
+
+def forward(params: Dict, dense: jax.Array, cat_ids: jax.Array,
+            cfg: DLRMConfig, mesh=None) -> jax.Array:
+    """dense [b, 13] f32, cat_ids [b, 26] int32 per-feature indices ->
+    click logits [b] f32."""
+    offsets = feature_offsets(cfg.vocab_sizes)
+    # clip into each feature's own vocab: an out-of-range id (hashing
+    # off-by-one) must not silently read a NEIGHBORING feature's rows
+    sizes = jnp.asarray(cfg.vocab_sizes, jnp.int32)
+    cat_ids = jnp.clip(cat_ids, 0, sizes[None, :] - 1)
+    rows = stack_ids(cat_ids, offsets)  # [b, F] global row ids
+
+    emb = vocab_parallel_lookup(params["table"], rows, mesh)  # [b,F,d]
+    wide = vocab_parallel_lookup(params["wide"], rows, mesh)  # [b,F,1]
+    wide_logit = jnp.sum(wide[..., 0].astype(jnp.float32), axis=-1)
+
+    x = _mlp(params["bottom"], dense.astype(cfg.dtype), cfg.dtype)
+    if cfg.interaction == "dot":
+        # DLRM pairwise dot interaction: bottom latent must match
+        # embed_dim to join the dot (enforced by config construction)
+        lat = jnp.concatenate(
+            [emb.astype(cfg.dtype), x[:, None, :]], axis=1
+        )  # [b, F+1, d]
+        gram = jnp.einsum("bfd,bgd->bfg", lat, lat)
+        f = lat.shape[1]
+        iu, ju = jnp.triu_indices(f, k=1)
+        inter = gram[:, iu, ju]  # [b, F(F+1)/2]
+        z = jnp.concatenate([inter, x], axis=-1)
+    else:
+        z = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1).astype(cfg.dtype), x],
+            axis=-1,
+        )
+    z = _mlp(params["top"], z, cfg.dtype)
+    deep_logit = (
+        z @ params["head"]["w"] + params["head"]["b"]
+    ).astype(jnp.float32)[:, 0]
+    return wide_logit + deep_logit
+
+
+def loss(params: Dict, batch, cfg: DLRMConfig, mesh=None) -> jax.Array:
+    """batch = (dense [b,13], cat_ids [b,26], labels [b]) -> masked
+    mean sigmoid-BCE. Labels: 1.0 click / 0.0 no-click / -1 padding
+    (elastic tail shards — padded rows carry no gradient)."""
+    dense, cat_ids, labels = batch
+    logits = forward(params, dense, cat_ids, cfg, mesh=mesh)
+    labels = labels.astype(jnp.float32)
+    valid = (labels >= 0).astype(jnp.float32)
+    y = jnp.maximum(labels, 0.0)
+    per = jnp.maximum(logits, 0) - logits * y + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+    return jnp.sum(per * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+#: models-contract alias (the contract's "token" is one example)
+next_token_loss = loss
+
+
+def flops_per_token(cfg: DLRMConfig, seq_len: int = 1) -> float:
+    """Per-EXAMPLE forward flops: MLPs + interaction (lookups are
+    gathers — bandwidth, not flops)."""
+    n = 0.0
+    in_dim = cfg.dense_dim
+    for w in cfg.bottom_mlp:
+        n += 2.0 * in_dim * w
+        in_dim = w
+    f = cfg.num_features + 1
+    if cfg.interaction == "dot":
+        n += 2.0 * f * f * cfg.embed_dim
+    t = _interaction_dim(cfg, in_dim)
+    for w in cfg.top_mlp:
+        n += 2.0 * t * w
+        t = w
+    return n + 2.0 * t
+
+
+def table_bytes(cfg: DLRMConfig) -> int:
+    """f32 stacked-table footprint incl. alignment padding (the
+    capacity-planning number)."""
+    return 4 * cfg.padded_vocab * (cfg.embed_dim + 1)
+
+
+def make_trainer(cfg: DLRMConfig, mesh=None, strategy: str = "rowwise",
+                 accum_steps: int = 1, optimizer=None, attn_fn=None):
+    """ShardedTrainer over the rowwise strategy (batch over "data",
+    table rows over "fsdp" — see parallel/sharding.rowwise_rules)."""
+    import optax
+
+    from dlrover_tpu.parallel.mesh import create_mesh
+    from dlrover_tpu.trainer.sharded import ShardedTrainer
+
+    if mesh is None:
+        mesh = create_mesh([("data", 1), ("fsdp", -1)])
+    return ShardedTrainer(
+        lambda p, b: loss(p, b, cfg, mesh=mesh),
+        lambda k: init_params(k, cfg),
+        param_axes(cfg), mesh, strategy=strategy,
+        # recsys default: adagrad-class updates are the industry
+        # standard for embedding tables (per-row adaptive lr, no
+        # momentum buffers doubling the table footprint)
+        optimizer=optimizer or optax.adagrad(0.05),
+        accum_steps=accum_steps,
+        batch_extra_axes=(),
+    )

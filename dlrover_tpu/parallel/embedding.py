@@ -1,0 +1,124 @@
+"""Vocab-parallel sparse embedding lookup (the elastic-PS replacement).
+
+Parity reference: the reference serves large recommender embedding
+tables from parameter servers — DeepRec ``get_embedding_variable`` with
+``fixed_size_partitioner(num_shards=ps_num)`` + ``tf.nn.embedding_lookup``
+(model_zoo/tf_estimator/criteo_deeprec/deepctr_models.py:457-485), the
+PS fleet scaled elastically by the master. TPU fleets have no PS: HBM
+over the mesh IS the parameter server.
+
+TPU-native shape:
+  * ONE stacked table ``[total_vocab, dim]`` (all categorical features
+    concatenated with per-feature row offsets — the classic DLRM
+    layout) so sharding and the optimizer see a single large dense
+    array instead of 26 ragged ones.
+  * Rows sharded over a mesh axis via the ordinary rule tables
+    (logical axis "vocab" — the same rule that vocab-shards the LM
+    head, parallel/sharding.py).
+  * The lookup runs under ``shard_map``: each shard gathers the rows
+    it owns (ids out of range masked to zero) and a ``psum`` over the
+    table axis assembles the full embedding — Megatron-style
+    vocab-parallel embedding. Static shapes throughout: the masked
+    gather + all-reduce moves ``[batch, features, dim]`` activations
+    regardless of which rows are hot, which XLA pipelines well; a
+    dynamic "send only owned rows" all-to-all would need data-dependent
+    shapes that break TPU compilation.
+  * The gradient falls out of autodiff: the psum transposes to an
+    identity (cotangent replicated over the table axis) and the masked
+    gather transposes to a scatter-add into ONLY the owned rows — each
+    shard updates its own slice, no cross-device gradient traffic for
+    the table.
+
+CPU-backend note: a 16-bit psum under shard_map crash-loops XLA CPU's
+AllReducePromotion pass (see parallel/pipeline.py::_cpu_needs_f32_boundary);
+the psum here is done in f32 when the backend is CPU (tables are
+normally f32 anyway — lookups don't touch the MXU).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.parallel.mesh import FSDP_AXIS, axis_size
+
+
+def _cpu_backend() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def vocab_parallel_lookup(
+    table: jax.Array,          # [total_vocab, dim] rows sharded
+    ids: jax.Array,            # [batch, features] int32 global row ids
+    mesh: Optional[Mesh],
+    shard_axis: str = FSDP_AXIS,
+    batch_axes: Tuple[str, ...] = ("data",),
+) -> jax.Array:
+    """Gather ``table[ids]`` with the table row-sharded over ``shard_axis``.
+
+    Returns ``[batch, features, dim]``. With no mesh, or the shard axis
+    absent/size-1, this is a plain gather (GSPMD handles any remaining
+    layout). ``batch_axes`` must NOT contain ``shard_axis``: the psum
+    over the table axis requires every table shard to see the same
+    batch slice (use the "rowwise" strategy rules, which shard batch
+    over "data" only).
+    """
+    if (
+        mesh is None
+        or shard_axis not in mesh.axis_names
+        or axis_size(mesh, shard_axis) <= 1
+    ):
+        return table[ids]
+    if shard_axis in batch_axes:
+        raise ValueError(
+            f"batch axes {batch_axes} must not include the table shard "
+            f"axis {shard_axis!r} (the vocab-parallel psum would mix "
+            "different batch shards)"
+        )
+    batch_axes = tuple(
+        a for a in batch_axes
+        if a in mesh.axis_names and axis_size(mesh, a) > 1
+    )
+
+    def body(tbl, local_ids):
+        # tbl: [rows_local, dim]; local_ids: [b_local, features]
+        rows = tbl.shape[0]
+        lo = jax.lax.axis_index(shard_axis) * rows
+        local = local_ids - lo
+        mask = (local >= 0) & (local < rows)
+        emb = tbl[jnp.clip(local, 0, rows - 1)]
+        emb = jnp.where(mask[..., None], emb, jnp.zeros((), emb.dtype))
+        if _cpu_backend() and emb.dtype != jnp.float32:
+            return jax.lax.psum(
+                emb.astype(jnp.float32), shard_axis
+            ).astype(emb.dtype)
+        return jax.lax.psum(emb, shard_axis)
+
+    batch_spec = batch_axes if batch_axes else None
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(shard_axis, None), P(batch_spec, None)),
+        out_specs=P(batch_spec, None, None),
+        check_vma=False,
+    )(table, ids)
+
+
+def feature_offsets(vocab_sizes: Tuple[int, ...]) -> jnp.ndarray:
+    """Per-feature starting row in the stacked table."""
+    import numpy as np
+
+    return jnp.asarray(
+        np.concatenate([[0], np.cumsum(vocab_sizes[:-1])]),
+        dtype=jnp.int32,
+    )
+
+
+def stack_ids(per_feature_ids: jax.Array,
+              offsets: jax.Array) -> jax.Array:
+    """[batch, features] per-feature indices -> global stacked-table
+    row ids. Callers must clip ids into each feature's own vocab first
+    (models/dlrm.py forward does) — an unclipped id would land in a
+    neighboring feature's row range, not out of the table."""
+    return per_feature_ids + offsets[None, :]

@@ -125,6 +125,18 @@ def zero2_rules() -> Rules:
     return {"batch": (DATA_AXIS, FSDP_AXIS)}
 
 
+def rowwise_rules() -> Rules:
+    """Sparse-embedding (DLRM-class) layout: table rows over fsdp,
+    batch over data ONLY — the vocab-parallel lookup psums over the
+    table axis, so every table shard must see the same batch slice
+    (parallel/embedding.py). Dense MLPs stay replicated (tiny); their
+    grads all-reduce over data as in DDP."""
+    return {
+        "batch": DATA_AXIS,
+        "vocab": FSDP_AXIS,
+    }
+
+
 STRATEGIES = {
     "ddp": ddp_rules,
     "zero1": zero1_rules,
@@ -134,6 +146,7 @@ STRATEGIES = {
     "tp_fsdp": tp_fsdp_rules,
     "sequence": sequence_rules,
     "pipeline": pipeline_rules,
+    "rowwise": rowwise_rules,
 }
 
 # strategies whose optimizer state is sharded differently from params.
