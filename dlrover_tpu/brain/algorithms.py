@@ -125,6 +125,93 @@ def plan_worker_resource(
     return planned
 
 
+def node_blacklist(events: List[Dict],
+                   window_seconds: float = 6 * 3600.0,
+                   min_events: int = 2,
+                   now: Optional[float] = None) -> List[str]:
+    """Cluster-wide repeat offenders from the node-event log.
+
+    A host that was evicted as a straggler or hard-failed in
+    ``min_events`` or more DISTINCT jobs-or-incidents within the window
+    is blacklisted — one bad probe in one job is noise, the same host
+    degrading two different jobs is a hardware problem (parity role:
+    the Go Brain's cluster-scoped node status algorithms; the reference
+    README's 'fault detection' cluster learning)."""
+    import time as _time
+
+    now = _time.time() if now is None else now
+    cutoff = now - window_seconds
+    by_host: Dict[str, set] = {}
+    for e in events:
+        try:
+            ts = float(e.get("timestamp", 0) or 0)
+        except (TypeError, ValueError):
+            continue  # defense in depth: a bad entry is skipped,
+            # never allowed to break every future computation
+        if ts < cutoff:
+            continue
+        host = e.get("host") or ""
+        if not host:
+            continue
+        # distinct incidents: (job, kind) pairs — N samples of the same
+        # straggler verdict in one job count once
+        by_host.setdefault(host, set()).add(
+            (e.get("job_name", ""), e.get("kind", ""))
+        )
+    out = sorted(
+        h for h, incidents in by_host.items()
+        if len(incidents) >= min_events
+    )
+    if out:
+        logger.info("Brain node blacklist: %s", out)
+    return out
+
+
+def job_family(job_name: str) -> str:
+    """Family key for sibling-job lookup: strip trailing run/attempt
+    decorations (``llama7b-20260731``, ``llama7b-run3``, ``llama7b-2``
+    → ``llama7b``) so recurring jobs share history."""
+    import re
+
+    return re.sub(
+        r"([-_.](run|attempt|try)?\d+)+$", "", job_name,
+        flags=re.IGNORECASE,
+    ) or job_name
+
+
+def plan_from_sibling_jobs(
+    client, job_name: str, base: Optional[NodeResource] = None
+) -> Optional[NodeResource]:
+    """Create-stage resource plan for a job with NO history of its own,
+    from archived runs of sibling jobs in the same family (parity:
+    optimize_job_worker_create_resource.go — first-run jobs provision
+    from similar jobs' stats instead of a blind default)."""
+    import dataclasses
+
+    base = base or NodeResource()
+    family = job_family(job_name)
+    predicted = 0.0
+    source = ""
+    for sibling in client.get_job_names():
+        if sibling == job_name or job_family(sibling) != family:
+            continue
+        for uuid in client.get_job_runs(sibling):
+            _, pred = predict_peak_memory_mb(
+                client.get_runtime_stats(sibling, uuid)
+            )
+            if pred > predicted:
+                predicted, source = pred, f"{sibling}/{uuid}"
+    if predicted <= 0:
+        return None
+    mem = int(max(predicted * MEMORY_MARGIN, base.memory))
+    planned = dataclasses.replace(base, memory=mem)
+    logger.info(
+        "Brain sibling plan for %s: %d MB from %s (family %s)",
+        job_name, mem, source, family,
+    )
+    return planned
+
+
 def warm_start_strategies(client, job_name: str) -> List[Dict]:
     """Archived winning acceleration strategies for ``job_name``,
     best-measured first (each: {"strategy_json", "measured_seconds"})."""

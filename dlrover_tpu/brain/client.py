@@ -7,15 +7,24 @@ MySQL-backed service itself).
 
 TPU-native redesign: the Brain's two jobs — persist job metrics beyond
 one master's lifetime, and answer "how should the NEXT run of this job
-be configured" — need a durable store and a query, not a standalone
-gRPC deployment. Both ride the pluggable state store (util/state_store
-.py): with the file backend the archive survives master restarts and is
-shared by every job on the reservation; the optimize query replays the
-archived speed-vs-worker-num samples of previous runs of the same job
-name and recommends the historically best worker count. The reporter
-seam (master/stats/reporter.py new_stats_reporter) keeps the reference's
-shape: reporter="brain" swaps persistence in without touching the
-collector.
+be configured" — ride a durable store and a query surface. Two
+deployments of the SAME surface:
+
+- in-process (:class:`BrainClient`): the archive is the pluggable state
+  store (util/state_store.py); with the file backend it survives master
+  restarts and is shared by every job on the reservation.
+- cluster service (:class:`RemoteBrainClient` → brain/service.py): a
+  standalone process owning the datastore, spoken to over the shared
+  retried REST transport (scheduler/rest.py) — the reference's
+  cluster-scoped Brain deployment (dlrover/go/brain/cmd/brain/main.go)
+  whose point is MULTI-JOB learning: every master archives into one
+  store and provisions from every sibling's history.
+
+All writes go through two primitives (``put_doc`` / ``append_doc``) so
+the algorithms (brain/algorithms.py) and the reporter work identically
+against either deployment. The reporter seam (master/stats/reporter.py
+new_stats_reporter) keeps the reference's shape: reporter="brain" swaps
+persistence in without touching the collector.
 """
 
 import dataclasses
@@ -31,6 +40,13 @@ from dlrover_tpu.master.stats.training_metrics import (
     TrainingHyperParams,
 )
 from dlrover_tpu.util.state_store import StateBackend, build_state_store
+
+#: cap on appended sample lists (runtime metrics per run)
+MAX_SAMPLES = 500
+#: cap on the cluster-wide node event log
+MAX_EVENTS = 2000
+#: key of the cluster-scoped (cross-job) node event log
+CLUSTER_EVENTS_KEY = "brain/_cluster/node_events"
 
 
 @dataclasses.dataclass
@@ -48,34 +64,56 @@ class BrainClient:
     def __init__(self, store: Optional[StateBackend] = None):
         self._store = store or build_state_store()
 
+    # -- primitives ------------------------------------------------------
+
+    def put_doc(self, job_name: str, uuid: str, kind: str,
+                doc: Any) -> None:
+        self._store.set(f"brain/{job_name}/{uuid}/{kind}", doc)
+
+    def append_doc(self, job_name: str, uuid: str, kind: str,
+                   doc: Dict, cap: int = MAX_SAMPLES) -> None:
+        key = f"brain/{job_name}/{uuid}/{kind}"
+        # mutate(): cross-process-safe append — the file store is
+        # shared by every master on the reservation
+        self._store.mutate(
+            key, lambda samples: (samples + [doc])[-cap:], default=[]
+        )
+
+    def get_doc(self, job_name: str, uuid: str, kind: str,
+                default: Any = None) -> Any:
+        return self._store.get(
+            f"brain/{job_name}/{uuid}/{kind}", default
+        )
+
     # -- persist (parity: report_metrics RPCs) ---------------------------
 
-    def _key(self, job: JobMeta, kind: str) -> str:
-        return f"brain/{job.name or job.uuid}/{job.uuid}/{kind}"
+    @staticmethod
+    def _names(job: JobMeta):
+        return (job.name or job.uuid), job.uuid
 
     def report_job_meta(self, job: JobMeta) -> None:
-        self._store.set(
-            self._key(job, "meta"),
+        name, uuid = self._names(job)
+        self.put_doc(
+            name, uuid, "meta",
             {**dataclasses.asdict(job), "updated_at": time.time()},
         )
 
     def report_hyper_params(self, job: JobMeta,
                             params: TrainingHyperParams) -> None:
-        self._store.set(
-            self._key(job, "hyper_params"), dataclasses.asdict(params)
+        name, uuid = self._names(job)
+        self.put_doc(
+            name, uuid, "hyper_params", dataclasses.asdict(params)
         )
 
     def report_model_metric(self, job: JobMeta,
                             metric: ModelMetric) -> None:
-        self._store.set(
-            self._key(job, "model"), dataclasses.asdict(metric)
-        )
+        name, uuid = self._names(job)
+        self.put_doc(name, uuid, "model", dataclasses.asdict(metric))
 
     def report_runtime_stats(self, job: JobMeta,
                              stats: RuntimeMetric) -> None:
-        key = self._key(job, "runtime")
-        samples: List[Dict] = self._store.get(key, [])
-        samples.append({
+        name, uuid = self._names(job)
+        self.append_doc(name, uuid, "runtime", {
             "worker_num": stats.worker_num,
             "global_step": stats.global_step,
             "speed": stats.speed,
@@ -90,7 +128,6 @@ class BrainClient:
                 default=0,
             ),
         })
-        self._store.set(key, samples[-500:])
 
     def report_strategy(self, job: JobMeta, strategy_json: str,
                         measured_seconds: Optional[float]) -> None:
@@ -98,18 +135,59 @@ class BrainClient:
         next run of the job name warm-starts (brain/algorithms.py
         warm_start_strategies; parity role: the Brain feeding the
         acceleration engine's initial candidate)."""
-        self._store.set(self._key(job, "strategy"), {
+        name, uuid = self._names(job)
+        self.put_doc(name, uuid, "strategy", {
             "strategy_json": strategy_json,
             "measured_seconds": measured_seconds,
             "timestamp": time.time(),
         })
 
     def report_exit_reason(self, job: JobMeta, reason: str) -> None:
-        self._store.set(self._key(job, "exit"), {
+        name, uuid = self._names(job)
+        self.put_doc(name, uuid, "exit", {
             "reason": reason, "timestamp": time.time(),
         })
 
+    # -- cluster-wide node events (blacklist feed) -----------------------
+
+    def report_node_event(self, host: str, kind: str,
+                          job_name: str = "",
+                          timestamp: Optional[float] = None) -> None:
+        """Feed the cross-job node-health log: straggler evictions and
+        hard failures, keyed by HOST so repeat offenders are visible
+        across jobs (the blacklist algorithm's input)."""
+        event = {
+            "host": host, "kind": kind, "job_name": job_name,
+            "timestamp": time.time() if timestamp is None else timestamp,
+        }
+        self._store.mutate(
+            CLUSTER_EVENTS_KEY,
+            lambda events: (events + [event])[-MAX_EVENTS:],
+            default=[],
+        )
+
+    def get_node_events(self) -> List[Dict]:
+        return self._store.get(CLUSTER_EVENTS_KEY, [])
+
+    def get_node_blacklist(self, window_seconds: float = 6 * 3600.0,
+                           min_events: int = 2) -> List[str]:
+        from dlrover_tpu.brain.algorithms import node_blacklist
+
+        return node_blacklist(
+            self.get_node_events(), window_seconds=window_seconds,
+            min_events=min_events,
+        )
+
     # -- query (parity: get_job_metrics / get_optimization_plan) ---------
+
+    def get_job_names(self) -> List[str]:
+        """Archived job names (cluster view — sibling-job planning)."""
+        names = set()
+        for key in self._store.keys("brain/"):
+            parts = key.split("/")
+            if len(parts) >= 3 and not parts[1].startswith("_"):
+                names.add(parts[1])
+        return sorted(names)
 
     def get_job_runs(self, job_name: str) -> List[str]:
         """Archived run uuids of a job name, oldest first."""
@@ -122,19 +200,35 @@ class BrainClient:
 
     def get_runtime_stats(self, job_name: str,
                           uuid: str) -> List[Dict]:
-        return self._store.get(
-            f"brain/{job_name}/{uuid}/runtime", []
-        )
+        return self.get_doc(job_name, uuid, "runtime", [])
 
     def get_exit_reason(self, job_name: str, uuid: str) -> str:
-        doc = self._store.get(f"brain/{job_name}/{uuid}/exit", {})
-        return doc.get("reason", "")
+        return (self.get_doc(job_name, uuid, "exit", {}) or {}).get(
+            "reason", ""
+        )
 
     def get_strategy(self, job_name: str,
                      uuid: str) -> Optional[Dict]:
-        return self._store.get(
-            f"brain/{job_name}/{uuid}/strategy", None
+        return self.get_doc(job_name, uuid, "strategy", None)
+
+    def plan_resource(self, job_name: str, base=None):
+        """Create-stage resource plan: (NodeResource | None, source).
+        Own archived history first, then sibling jobs of the same
+        family. The REMOTE client overrides this with ONE service call
+        — the service runs the same two algorithms next to the data
+        instead of the master paging every sibling's runs over REST."""
+        from dlrover_tpu.brain.algorithms import (
+            plan_from_sibling_jobs,
+            plan_worker_resource,
         )
+
+        planned = plan_worker_resource(self, job_name, base)
+        if planned is not None:
+            return planned, "own_history"
+        planned = plan_from_sibling_jobs(self, job_name, base)
+        if planned is not None:
+            return planned, "sibling_jobs"
+        return None, ""
 
     def get_optimization_plan(self, job_name: str) -> Optional[
             OptimizePlan]:
@@ -164,6 +258,138 @@ class BrainClient:
         return best
 
 
+class RemoteBrainClient(BrainClient):
+    """The same archive/optimize surface spoken to the standalone Brain
+    service (brain/service.py) over the retried REST transport — one
+    cluster-scoped datastore shared by every master (parity:
+    dlrover/python/brain/client.py BrainClient → the Go service).
+
+    Only the two write primitives and the read queries touch the wire;
+    every report_* method and every algorithm runs unchanged on top.
+    """
+
+    def __init__(self, addr: str, timeout: float = 10.0,
+                 retries: int = 3):
+        from dlrover_tpu.scheduler.rest import RestClient
+
+        if "://" not in addr:
+            addr = f"http://{addr}"
+        self._rest = RestClient(
+            addr, timeout=timeout, retries=retries
+        )
+        self._store = None  # no local store: the service owns it
+
+    # -- primitives over the wire ---------------------------------------
+
+    def put_doc(self, job_name, uuid, kind, doc):
+        self._rest.request("POST", "api/v1/archive", {
+            "job_name": job_name, "uuid": uuid, "kind": kind,
+            "doc": doc, "append": False,
+        })
+
+    def append_doc(self, job_name, uuid, kind, doc, cap=MAX_SAMPLES):
+        self._rest.request("POST", "api/v1/archive", {
+            "job_name": job_name, "uuid": uuid, "kind": kind,
+            "doc": doc, "append": True, "cap": cap,
+        })
+
+    def get_doc(self, job_name, uuid, kind, default=None):
+        from dlrover_tpu.scheduler.rest import NotFound
+
+        try:
+            resp = self._rest.request(
+                "GET", f"api/v1/archive/{job_name}/{uuid}/{kind}"
+            )
+        except NotFound:
+            return default
+        doc = resp.get("doc")
+        return default if doc is None else doc
+
+    def report_node_event(self, host, kind, job_name="",
+                          timestamp=None):
+        self._rest.request("POST", "api/v1/events", {
+            "host": host, "kind": kind, "job_name": job_name,
+            "timestamp": timestamp,
+        })
+
+    def get_node_events(self):
+        return self._rest.request("GET", "api/v1/events").get(
+            "events", []
+        )
+
+    def get_node_blacklist(self, window_seconds=6 * 3600.0,
+                           min_events=2):
+        resp = self._rest.request(
+            "GET",
+            "api/v1/blacklist?window_seconds="
+            f"{window_seconds}&min_events={min_events}",
+        )
+        return resp.get("hosts", [])
+
+    def get_job_names(self):
+        return self._rest.request("GET", "api/v1/jobs").get(
+            "names", []
+        )
+
+    def get_job_runs(self, job_name):
+        return self._rest.request(
+            "GET", f"api/v1/archive/{job_name}/runs"
+        ).get("runs", [])
+
+    # query-heavy algorithms run SERVER-SIDE (next to the data) — the
+    # inherited implementations would page every job's every run over
+    # the wire on the master's startup path
+
+    def get_optimization_plan(self, job_name):
+        resp = self._rest.request(
+            "GET", f"api/v1/optimize/{job_name}/plan"
+        )
+        if not resp.get("worker_num"):
+            return None
+        return OptimizePlan(
+            worker_num=int(resp["worker_num"]),
+            speed=float(resp.get("speed", 0.0)),
+            source_job=resp.get("source_job", ""),
+        )
+
+    def plan_resource(self, job_name, base=None):
+        import urllib.parse
+
+        from dlrover_tpu.common.node import NodeResource
+
+        params = {}
+        if base is not None:
+            if getattr(base, "memory", 0):
+                params["memory"] = str(base.memory)
+            if getattr(base, "cpu", 0):
+                params["cpu"] = str(base.cpu)
+        path = f"api/v1/optimize/{job_name}/resource"
+        if params:
+            path += "?" + urllib.parse.urlencode(params)
+        resp = self._rest.request("GET", path)
+        if not resp:
+            return None, ""
+        import dataclasses as _dc
+
+        planned = _dc.replace(
+            base or NodeResource(),
+            cpu=float(resp.get("cpu", 0.0)),
+            memory=int(resp.get("memory", 0)),
+        )
+        return planned, resp.get("source", "")
+
+
+def build_brain_client(addr: str = "",
+                       store_path: str = "") -> Optional[BrainClient]:
+    """brain_addr → the cluster service; brain_store_path → in-process
+    file archive; neither → None (brain disabled)."""
+    if addr:
+        return RemoteBrainClient(addr)
+    if store_path:
+        return BrainClient(build_state_store("file", store_path))
+    return None
+
+
 class BrainReporter(StatsReporter):
     """StatsReporter writing through the BrainClient archive (parity:
     reporter.py's BrainReporter), so master restarts and future runs see
@@ -173,12 +399,22 @@ class BrainReporter(StatsReporter):
                  client: Optional[BrainClient] = None):
         super().__init__(job_meta)
         self._client = client or BrainClient()
-        self._client.report_job_meta(job_meta)
+        try:
+            # best-effort like every other archive write: a Brain
+            # outage must not crash MASTER STARTUP for an optional
+            # feature (TeeStatsReporter guards per-report calls, but
+            # this one runs in the constructor)
+            self._client.report_job_meta(job_meta)
+        except Exception as e:
+            logger.warning("brain job-meta report failed: %s", e)
+
+    def _names(self):
+        return BrainClient._names(self._job_meta)
 
     def report_dataset_metric(self, metric: DatasetMetric):
-        self._client._store.set(
-            self._client._key(self._job_meta, "dataset"),
-            dataclasses.asdict(metric),
+        name, uuid = self._names()
+        self._client.put_doc(
+            name, uuid, "dataset", dataclasses.asdict(metric)
         )
 
     def report_training_hyper_params(self, params: TrainingHyperParams):
@@ -194,6 +430,5 @@ class BrainReporter(StatsReporter):
         self._client.report_exit_reason(self._job_meta, reason)
 
     def report_customized_data(self, data):
-        self._client._store.set(
-            self._client._key(self._job_meta, "custom"), data
-        )
+        name, uuid = self._names()
+        self._client.put_doc(name, uuid, "custom", data)

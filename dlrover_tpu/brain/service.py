@@ -1,0 +1,277 @@
+"""The Brain as a standalone cluster service (G2 service-hood).
+
+Parity reference: dlrover/go/brain/cmd/brain/main.go — a cluster-scoped
+deployment owning a datastore (pkg/datastore/, MySQL) behind an RPC
+surface, so EVERY job master archives into one place and new jobs
+provision from every sibling's history. That cross-job learning is the
+Brain's entire point; an in-process archive can only learn from runs
+that happened to share a filesystem.
+
+TPU-native shape: a small threaded HTTP service over the pluggable
+state store (util/state_store.py FileStore — schema-versioned, see
+``_ensure_schema``), speaking JSON to :class:`~dlrover_tpu.brain.client.
+RemoteBrainClient` through the same retried REST transport the platform
+clients use (scheduler/rest.py). The optimize endpoints run the SAME
+algorithm library (brain/algorithms.py) the in-process fallback runs —
+deployment changes, decisions don't.
+
+Surface (all JSON):
+  GET  /healthz                                liveness + schema version
+  POST /api/v1/archive                         {job_name, uuid, kind, doc,
+                                                append, cap} write-through
+  GET  /api/v1/jobs                            archived job names
+  GET  /api/v1/archive/{job}/runs              run uuids
+  GET  /api/v1/archive/{job}/{uuid}/{kind}     one doc (404 if absent)
+  GET  /api/v1/optimize/{job}/plan             historically-best workers
+  GET  /api/v1/optimize/{job}/resource?memory= create-stage resource plan
+                                               (own history, then
+                                               sibling jobs)
+  POST /api/v1/events                          {host, kind, job_name}
+  GET  /api/v1/events                          raw node-event log
+  GET  /api/v1/blacklist?window_seconds=&min_events=
+                                               repeat-offender hosts
+
+Run:  python -m dlrover_tpu.brain.service --port 8600 --store_path /var/brain
+"""
+
+import argparse
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from dlrover_tpu.brain.client import BrainClient, MAX_SAMPLES
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.util.state_store import StateBackend, build_state_store
+
+SCHEMA_VERSION = 1
+SCHEMA_KEY = "brain/_schema"
+
+#: keys may only use these characters — the store maps keys to paths
+_NAME_RE = re.compile(r"^[A-Za-z0-9._\-]{1,128}$")
+
+
+def _ensure_schema(store: StateBackend) -> None:
+    """Version the datastore: a service must refuse a store written by
+    a NEWER schema (fields it would misread) and stamp fresh stores."""
+    doc = store.get(SCHEMA_KEY)
+    if doc is None:
+        store.set(SCHEMA_KEY, {"version": SCHEMA_VERSION})
+        return
+    version = (doc or {}).get("version", 0)
+    if version > SCHEMA_VERSION:
+        raise RuntimeError(
+            f"brain store schema v{version} is newer than this "
+            f"service's v{SCHEMA_VERSION}; upgrade the service"
+        )
+
+
+class BrainService:
+    """Threaded HTTP server wrapping a BrainClient over one store."""
+
+    def __init__(self, store: Optional[StateBackend] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._client = BrainClient(store or build_state_store())
+        _ensure_schema(self._client._store)
+        self._write_lock = threading.Lock()
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet http.server
+                logger.debug("brain http: " + fmt, *args)
+
+            def _send(self, code: int, doc: Dict):
+                body = json.dumps(doc).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    code, doc = service._get(self.path)
+                except Exception as e:  # never kill the server thread
+                    logger.exception("brain GET %s failed", self.path)
+                    code, doc = 500, {"error": str(e)}
+                self._send(code, doc)
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n) if n else b"{}"
+                    body = json.loads(raw.decode("utf-8"))
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                    code, doc = service._post(self.path, body)
+                except (ValueError, UnicodeDecodeError) as e:
+                    code, doc = 400, {"error": str(e)}
+                except Exception as e:
+                    logger.exception("brain POST %s failed", self.path)
+                    code, doc = 500, {"error": str(e)}
+                self._send(code, doc)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="brain-service",
+        )
+        self._thread.start()
+        logger.info("Brain service on %s", self.addr)
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- routing --------------------------------------------------------
+
+    @staticmethod
+    def _check_name(value: str, what: str) -> str:
+        if not _NAME_RE.match(value or ""):
+            raise ValueError(f"invalid {what}: {value!r}")
+        return value
+
+    def _get(self, path: str):
+        parsed = urllib.parse.urlparse(path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        parts = [p for p in parsed.path.split("/") if p]
+        if parts == ["healthz"]:
+            return 200, {"ok": True, "schema_version": SCHEMA_VERSION}
+        if parts[:2] != ["api", "v1"]:
+            return 404, {"error": "unknown path"}
+        rest = parts[2:]
+        if rest == ["jobs"]:
+            return 200, {"names": self._client.get_job_names()}
+        if rest == ["events"]:
+            return 200, {"events": self._client.get_node_events()}
+        if rest == ["blacklist"]:
+            return 200, {"hosts": self._client.get_node_blacklist(
+                window_seconds=float(
+                    query.get("window_seconds", 6 * 3600.0)
+                ),
+                min_events=int(query.get("min_events", 2)),
+            )}
+        if len(rest) == 3 and rest[0] == "archive" and rest[2] == "runs":
+            job = self._check_name(rest[1], "job_name")
+            return 200, {"runs": self._client.get_job_runs(job)}
+        if len(rest) == 4 and rest[0] == "archive":
+            job = self._check_name(rest[1], "job_name")
+            uuid = self._check_name(rest[2], "uuid")
+            kind = self._check_name(rest[3], "kind")
+            doc = self._client.get_doc(job, uuid, kind, None)
+            if doc is None:
+                return 404, {"error": "no such doc"}
+            return 200, {"doc": doc}
+        if len(rest) == 3 and rest[0] == "optimize":
+            job = self._check_name(rest[1], "job_name")
+            if rest[2] == "plan":
+                plan = self._client.get_optimization_plan(job)
+                if plan is None:
+                    return 200, {}
+                return 200, {
+                    "worker_num": plan.worker_num, "speed": plan.speed,
+                    "source_job": plan.source_job,
+                }
+            if rest[2] == "resource":
+                return 200, self._plan_resource(job, query)
+        return 404, {"error": "unknown path"}
+
+    def _plan_resource(self, job: str, query: Dict[str, str]) -> Dict:
+        """Create-stage resource plan, computed next to the data
+        (BrainClient.plan_resource: own history, then sibling jobs)."""
+        from dlrover_tpu.common.node import NodeResource
+
+        base = NodeResource(
+            cpu=float(query.get("cpu", 0) or 0),
+            memory=int(query.get("memory", 0) or 0),
+        )
+        planned, source = self._client.plan_resource(job, base)
+        if planned is None:
+            return {}
+        return {
+            "cpu": planned.cpu, "memory": planned.memory,
+            "source": source,
+        }
+
+    def _post(self, path: str, body: Dict[str, Any]):
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if parts[:2] != ["api", "v1"]:
+            return 404, {"error": "unknown path"}
+        rest = parts[2:]
+        if rest == ["archive"]:
+            job = self._check_name(
+                str(body.get("job_name", "")), "job_name"
+            )
+            uuid = self._check_name(str(body.get("uuid", "")), "uuid")
+            kind = self._check_name(str(body.get("kind", "")), "kind")
+            doc = body.get("doc")
+            with self._write_lock:  # append is read-modify-write
+                if body.get("append"):
+                    if not isinstance(doc, dict):
+                        raise ValueError("append doc must be an object")
+                    self._client.append_doc(
+                        job, uuid, kind, doc,
+                        cap=int(body.get("cap", MAX_SAMPLES)),
+                    )
+                else:
+                    self._client.put_doc(job, uuid, kind, doc)
+            return 200, {"ok": True}
+        if rest == ["events"]:
+            host = str(body.get("host", ""))
+            kind = str(body.get("kind", ""))
+            if not host or not kind:
+                raise ValueError("events need host and kind")
+            ts = body.get("timestamp")
+            if ts is not None:
+                try:
+                    ts = float(ts)
+                except (TypeError, ValueError):
+                    # one poisoned timestamp would break every later
+                    # blacklist computation — reject at the boundary
+                    raise ValueError(f"bad timestamp {ts!r}")
+            with self._write_lock:
+                self._client.report_node_event(
+                    host, kind, str(body.get("job_name", "")),
+                    timestamp=ts,
+                )
+            return 200, {"ok": True}
+        return 404, {"error": "unknown path"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8600)
+    ap.add_argument(
+        "--store_path", required=True,
+        help="directory of the versioned file datastore",
+    )
+    args = ap.parse_args(argv)
+    service = BrainService(
+        build_state_store("file", args.store_path),
+        host=args.host, port=args.port,
+    )
+    service.start()
+    print(f"brain service listening on {args.host}:{service.port}",
+          flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        service.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

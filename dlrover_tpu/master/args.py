@@ -44,4 +44,9 @@ def parse_master_args(argv=None):
                         help="directory for the durable cross-run "
                              "stats archive (brain/client.py); enables "
                              "warm-started resource plans")
+    parser.add_argument("--brain_addr", type=str, default="",
+                        help="host:port of the standalone Brain service "
+                             "(brain/service.py) — the cluster-scoped "
+                             "archive shared by every master; takes "
+                             "precedence over --brain_store_path")
     return parser.parse_args(argv)

@@ -56,16 +56,21 @@ class DistributedJobMaster:
         self.stats_reporter = LocalStatsReporter(job_meta)
         collector_reporter = self.stats_reporter
         brain_client = None
-        if getattr(job_args, "brain_store_path", None):
-            # durable archive: collected stats tee into the brain store
-            # so future runs of this job warm-start from history
-            from dlrover_tpu.brain.client import BrainClient, BrainReporter
+        brain_addr = getattr(job_args, "brain_addr", "") or ""
+        brain_path = getattr(job_args, "brain_store_path", "") or ""
+        if brain_addr or brain_path:
+            # durable archive: collected stats tee into the brain so
+            # future runs (and, via the service, SIBLING jobs) provision
+            # from history. brain_addr -> the cluster service
+            # (brain/service.py); brain_store_path -> in-process file
+            # archive fallback
+            from dlrover_tpu.brain.client import (
+                BrainReporter,
+                build_brain_client,
+            )
             from dlrover_tpu.master.stats.reporter import TeeStatsReporter
-            from dlrover_tpu.util.state_store import build_state_store
 
-            brain_client = BrainClient(build_state_store(
-                "file", job_args.brain_store_path
-            ))
+            brain_client = build_brain_client(brain_addr, brain_path)
             collector_reporter = TeeStatsReporter(job_meta, [
                 self.stats_reporter,
                 BrainReporter(job_meta, client=brain_client),

@@ -28,6 +28,10 @@ def build_job_args(args) -> JobArgs:
             job_args.heartbeat_timeout = args.heartbeat_timeout
         if args.namespace != "default":
             job_args.namespace = args.namespace
+        if getattr(args, "brain_addr", ""):
+            job_args.brain_addr = args.brain_addr
+        if getattr(args, "brain_store_path", ""):
+            job_args.brain_store_path = args.brain_store_path
         return job_args
     return JobArgs(
         job_name=args.job_name,
@@ -37,6 +41,8 @@ def build_job_args(args) -> JobArgs:
         distribution_strategy=args.distribution_strategy,
         heartbeat_timeout=args.heartbeat_timeout,
         relaunch_always=args.relaunch_always,
+        brain_addr=getattr(args, "brain_addr", "") or "",
+        brain_store_path=getattr(args, "brain_store_path", "") or "",
     )
 
 

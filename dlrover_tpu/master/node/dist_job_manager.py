@@ -257,6 +257,16 @@ class DistributedJobManager:
         return self._failed_reason
 
     def _maybe_relaunch(self, node: Node):
+        # any failure exit feeds the brain's cluster-wide node-health
+        # log (blacklist input) when a brain is configured. Keyed by
+        # the PHYSICAL host when known — pod names embed the job name,
+        # so cross-job repeat offenders only aggregate under the host
+        if node.exit_reason and hasattr(
+            self._job_optimizer, "report_node_event"
+        ):
+            self._job_optimizer.report_node_event(
+                node.host_name or node.name, node.exit_reason
+            )
         if not self._should_relaunch(node):
             if node.critical and not node.is_released:
                 # a critical node that will not come back: fail fast

@@ -104,6 +104,14 @@ class AllreduceTrainingAutoScaler:
                 monitor.reduce_target_worker_num(
                     [(n.type, n.id) for n in executed.remove_nodes]
                 )
+            # evicted stragglers feed the brain's cluster-wide
+            # node-health log (blacklist input across jobs), keyed by
+            # physical host when known (pod names embed the job name)
+            if hasattr(self._job_optimizer, "report_node_event"):
+                for n in executed.remove_nodes:
+                    self._job_optimizer.report_node_event(
+                        n.host_name or n.name, "straggler"
+                    )
 
     def execute_job_optimization_plan(self, plan: ResourcePlan):
         """Diff the plan against current bookkeeping and scale. A plan

@@ -73,15 +73,31 @@ class TPULocalOptimizer(ResourceOptimizer):
         if not job_name:
             return resource
         try:
-            from dlrover_tpu.brain.algorithms import plan_worker_resource
-
-            planned = plan_worker_resource(
-                self._brain_client, job_name, resource
+            # own history first, then sibling jobs of the same family
+            # (parity role: optimize_job_worker_create_resource.go);
+            # against the cluster service this is ONE call computed
+            # next to the data
+            planned, _source = self._brain_client.plan_resource(
+                job_name, resource
             )
         except Exception as e:
             logger.warning("brain memory plan failed: %s", e)
             return resource
         return planned or resource
+
+    def report_node_event(self, host: str, kind: str) -> None:
+        """Feed the brain's cluster-wide node-health log (straggler
+        evictions, failure exits) so repeat-offender hosts surface in
+        ``get_node_blacklist`` across jobs. No-op without a brain."""
+        if self._brain_client is None or not host:
+            return
+        try:
+            self._brain_client.report_node_event(
+                host, kind,
+                getattr(self._job_args, "job_name", "") or "",
+            )
+        except Exception as e:
+            logger.warning("brain node event failed: %s", e)
 
     def _brain_warm_start(self, node_num: int) -> int:
         """Start at the historically fastest worker count of previous

@@ -66,6 +66,13 @@ class PodRecord(dict):
         return self.get("phase", PodPhase.PENDING)
 
 
+class StaleResourceVersion(Exception):
+    """The apiserver expired the watch bookmark (410 Gone): the caller
+    must RE-LIST to get a fresh resourceVersion before watching again —
+    events between the stale bookmark and the new list are re-derived
+    by diffing, never silently lost."""
+
+
 class K8sApi(ABC):
     """parity: scheduler/kubernetes.py:84 k8sClient (pods subset)."""
 
@@ -84,6 +91,22 @@ class K8sApi(ABC):
             if rec.name == name:
                 return rec
         return None
+
+    # -- watch support (event-driven watchers; poll is the fallback) ----
+
+    def supports_watch(self) -> bool:
+        return False
+
+    def list_pods_with_version(self):
+        """(records, resourceVersion) — the version is the watch
+        bookmark; "" when the backend has no watch support."""
+        return self.list_pods(), ""
+
+    def watch_pods(self, resource_version: str,
+                   timeout_seconds: int = 300):
+        """Yield (event_type, PodRecord) from the apiserver watch
+        stream; raises StaleResourceVersion on 410 Gone."""
+        raise NotImplementedError
 
 
 class FakeK8sApi(K8sApi):
@@ -304,10 +327,14 @@ class RestK8sApi(K8sApi):
             return False
 
     def list_pods(self) -> List[PodRecord]:
+        return self.list_pods_with_version()[0]
+
+    def list_pods_with_version(self):
         from dlrover_tpu.scheduler.rest import RestError
 
         out: List[PodRecord] = []
         cont = ""
+        version = ""
         while True:
             path = f"api/v1/namespaces/{self._ns}/pods"
             params = {}
@@ -321,12 +348,66 @@ class RestK8sApi(K8sApi):
                 resp = self._client.request("GET", path)
             except RestError as e:
                 logger.error("list pods failed: %s", e)
-                return []
+                return [], ""
             for item in resp.get("items", []):
                 out.append(self._to_record(item))
-            cont = resp.get("metadata", {}).get("continue", "")
+            meta = resp.get("metadata", {})
+            version = meta.get("resourceVersion", version)
+            cont = meta.get("continue", "")
             if not cont:
-                return out
+                return out, version
+
+    def supports_watch(self) -> bool:
+        return True
+
+    def watch_pods(self, resource_version: str,
+                   timeout_seconds: int = 300):
+        """Consume the apiserver watch stream (parity:
+        dlrover/python/master/watcher/k8s_watcher.py:145
+        ``watch.Watch().stream``): chunked JSON lines
+        ``{"type": ADDED|MODIFIED|DELETED|BOOKMARK|ERROR, "object"}``.
+        Yields (type, PodRecord) for pod events and ("BOOKMARK", rv)
+        for resume bookmarks; a 410 (start-of-stream status or ERROR
+        event) raises StaleResourceVersion so the watcher re-lists."""
+        from dlrover_tpu.scheduler.rest import RestError
+
+        params = {
+            "watch": "1",
+            "allowWatchBookmarks": "true",
+            "timeoutSeconds": str(int(timeout_seconds)),
+        }
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        if self._job_name:
+            params["labelSelector"] = f"dlrover-job={self._job_name}"
+        path = (
+            f"api/v1/namespaces/{self._ns}/pods?"
+            + urllib.parse.urlencode(params)
+        )
+        try:
+            for event in self._client.stream_lines(
+                path, timeout=timeout_seconds + 30
+            ):
+                etype = event.get("type", "")
+                obj = event.get("object", {}) or {}
+                if etype == "ERROR":
+                    if obj.get("code") == 410:
+                        raise StaleResourceVersion(obj.get("message", ""))
+                    logger.warning("watch ERROR event: %s", obj)
+                    return
+                if etype == "BOOKMARK":
+                    rv = obj.get("metadata", {}).get(
+                        "resourceVersion", ""
+                    )
+                    yield "BOOKMARK", rv
+                    continue
+                if etype in ("ADDED", "MODIFIED", "DELETED"):
+                    yield etype, self._to_record(obj)
+        except RestError as e:
+            if e.status == 410:
+                raise StaleResourceVersion(str(e))
+            logger.warning("watch stream failed: %s", e)
+            return
 
     @staticmethod
     def _to_record(item: Dict) -> PodRecord:
@@ -339,6 +420,12 @@ class RestK8sApi(K8sApi):
             phase=status.get("phase", PodPhase.PENDING),
             labels=meta.get("labels", {}),
             env={},
+            # each event's version advances the watch bookmark
+            resource_version=meta.get("resourceVersion", ""),
+            # the PHYSICAL host: what cross-job node-health learning
+            # keys on (pod names embed the job name and never repeat)
+            host_name=item.get("spec", {}).get("nodeName", ""),
+            host_ip=status.get("hostIP", ""),
         )
         for cs in status.get("containerStatuses", []):
             term = cs.get("state", {}).get("terminated")
@@ -516,21 +603,36 @@ def pod_to_node(rec: PodRecord) -> Optional[Node]:
         status=status,
         rank_index=int(labels.get("dlrover-rank", node_id)),
     )
+    node.update_info(
+        host_name=rec.get("host_name") or None,
+        host_ip=rec.get("host_ip") or None,
+    )
     if exit_reason:
         node.set_exit_reason(exit_reason)
     return node
 
 
 class GkePodWatcher(NodeWatcher):
-    """Polling diff watcher over the pod fleet (parity: PodWatcher —
-    the apiserver watch verb becomes a poll against the same seam the
-    scaler mutates, so fake-API tests drive both ends)."""
+    """Pod-fleet watcher (parity: PodWatcher, k8s_watcher.py:139-152).
+
+    With a watch-capable api (RestK8sApi) this consumes apiserver WATCH
+    STREAMS: list once for the resourceVersion bookmark, then react to
+    ADDED/MODIFIED/DELETED events as they arrive — reaction latency is
+    the event's network hop, not a poll interval, and the apiserver is
+    not asked to re-serialize the whole fleet every few seconds. Stream
+    end (server timeout, disconnect) resumes from the last bookmark;
+    410 Gone re-lists and re-derives missed transitions by diffing.
+    Backends without watch (FakeK8sApi) keep the polling diff loop —
+    the same seam the scaler mutates, so fake-API tests drive both ends.
+    """
 
     def __init__(self, job_name: str, api: K8sApi,
-                 poll_interval: float = 5.0):
+                 poll_interval: float = 5.0,
+                 watch_timeout: int = 300):
         self._job_name = job_name
         self._api = api
         self._poll = poll_interval
+        self._watch_timeout = watch_timeout
         self._stopped = threading.Event()
         self._last: Dict[str, str] = {}  # name -> phase fingerprint
 
@@ -556,19 +658,100 @@ class GkePodWatcher(NodeWatcher):
                         NodeEvent(NodeEventType.MODIFIED, node)
                     )
         for name in set(self._last) - set(seen):
-            parts = name.rsplit("-", 2)
-            if len(parts) == 3 and parts[2].isdigit():
-                gone = Node(parts[1], int(parts[2]), name=name,
-                            status=NodeStatus.DELETED)
+            gone = self._deleted_node(name)
+            if gone is not None:
                 events.append(NodeEvent(NodeEventType.DELETED, gone))
         self._last = seen
         return events
 
     def watch(self) -> Iterator[NodeEvent]:
+        if self._api.supports_watch():
+            yield from self._watch_stream()
+            return
         while not self._stopped.is_set():
             for event in self.poll_events():
                 yield event
             self._stopped.wait(self._poll)
+
+    def _watch_stream(self) -> Iterator[NodeEvent]:
+        while not self._stopped.is_set():
+            # (re-)list: sync state, emit missed transitions as diff
+            # events, and take the watch bookmark
+            records, version = self._api.list_pods_with_version()
+            if not version:
+                # list FAILED (empty version is the failure signal):
+                # do NOT diff — an empty result against known state
+                # would read as the whole fleet deleted. Back off and
+                # re-list; self._last stays authoritative.
+                self._stopped.wait(self._poll)
+                continue
+            seen: Dict[str, str] = {}
+            for rec in records:
+                if rec.get("labels", {}).get(
+                    "dlrover-job"
+                ) != self._job_name:
+                    continue
+                fp = self._fingerprint(rec)
+                seen[rec.name] = fp
+                if self._last.get(rec.name) != fp:
+                    node = pod_to_node(rec)
+                    if node is not None:
+                        yield NodeEvent(NodeEventType.MODIFIED, node)
+            for name in set(self._last) - set(seen):
+                gone = self._deleted_node(name)
+                if gone is not None:
+                    yield NodeEvent(NodeEventType.DELETED, gone)
+            self._last = seen
+            try:
+                for etype, payload in self._api.watch_pods(
+                    version, timeout_seconds=self._watch_timeout
+                ):
+                    if self._stopped.is_set():
+                        return
+                    if etype == "BOOKMARK":
+                        version = payload or version
+                        continue
+                    rec = payload
+                    version = rec.get("resource_version") or version
+                    if rec.get("labels", {}).get(
+                        "dlrover-job"
+                    ) != self._job_name:
+                        continue
+                    if etype == "DELETED":
+                        self._last.pop(rec.name, None)
+                        node = pod_to_node(rec)
+                        if node is not None:
+                            node.status = NodeStatus.DELETED
+                            yield NodeEvent(
+                                NodeEventType.DELETED, node
+                            )
+                        continue
+                    fp = self._fingerprint(rec)
+                    if self._last.get(rec.name) == fp:
+                        continue
+                    self._last[rec.name] = fp
+                    node = pod_to_node(rec)
+                    if node is not None:
+                        yield NodeEvent(NodeEventType.MODIFIED, node)
+                # stream ended normally (server timeout): resume via
+                # a fresh WATCH from the advanced bookmark — the loop's
+                # re-list keeps state exact even if events were missed
+            except StaleResourceVersion:
+                # keep self._last: the re-list diff emits MODIFIED for
+                # changes and DELETED for pods that vanished during the
+                # gap — wiping the baseline would swallow exactly those
+                # DELETED events
+                logger.info(
+                    "watch bookmark expired; re-listing %s",
+                    self._job_name,
+                )
+
+    def _deleted_node(self, name: str) -> Optional[Node]:
+        parts = name.rsplit("-", 2)
+        if len(parts) == 3 and parts[2].isdigit():
+            return Node(parts[1], int(parts[2]), name=name,
+                        status=NodeStatus.DELETED)
+        return None
 
     def list(self) -> List[Node]:
         out = []

@@ -121,6 +121,10 @@ class JobArgs:
     evaluator_resource: NodeResource = dataclasses.field(
         default_factory=NodeResource
     )
+    # cross-run/cross-job learning (brain/): the cluster service's
+    # address wins over the in-process file-archive path
+    brain_addr: str = ""
+    brain_store_path: str = ""
 
     @property
     def worker_group(self) -> NodeGroupResource:
@@ -167,6 +171,8 @@ class JobArgs:
                 int(worker.get("maxRelaunchCount", 3)),
                 int(worker.get("replicas", 1)),
             ),
+            brain_addr=spec.get("brainAddr", ""),
+            brain_store_path=spec.get("brainStorePath", ""),
         )
         evaluator = spec.get("evaluator", {})
         if evaluator:

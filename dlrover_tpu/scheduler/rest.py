@@ -120,3 +120,54 @@ class RestClient:
         if isinstance(last_err, RestError):
             raise last_err
         raise RestError(0, f"transport failure: {last_err}")
+
+    def stream_lines(self, path: str, timeout: Optional[float] = None):
+        """GET a chunked line-delimited JSON stream (the k8s watch
+        verb's wire format), yielding one decoded object per line.
+
+        NO retry loop here: a watch stream ending (server timeout,
+        disconnect) is NORMAL protocol — the caller re-lists/resumes
+        with its bookmarked resourceVersion. HTTP-level errors map like
+        ``request`` (404 -> NotFound, else RestError); a malformed line
+        ends the stream (the resume path re-syncs state anyway).
+        """
+        url = f"{self._base}/{path.lstrip('/')}"
+        headers = dict(self._headers)
+        if self._token_provider is not None:
+            headers["Authorization"] = f"Bearer {self._token_provider()}"
+        req = urllib.request.Request(url, headers=headers)
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout or self._timeout,
+                context=self._ssl_context,
+            )
+        except urllib.error.HTTPError as e:
+            text = ""
+            try:
+                text = e.read().decode(errors="replace")
+            except Exception:
+                pass
+            if e.code == 404:
+                raise NotFound(e.code, str(e.reason), text)
+            raise RestError(e.code, str(e.reason), text)
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise RestError(0, f"transport failure: {e}")
+        try:
+            with resp:
+                for raw in resp:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line.decode("utf-8"))
+                    except (UnicodeDecodeError,
+                            json.JSONDecodeError) as e:
+                        logger.warning(
+                            "watch stream line unparsable (%s); "
+                            "ending stream for re-sync", e,
+                        )
+                        return
+        except (OSError, TimeoutError) as e:
+            # mid-stream disconnect: normal — caller resumes
+            logger.debug("watch stream ended: %s", e)
+            return

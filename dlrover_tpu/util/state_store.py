@@ -35,11 +35,27 @@ class StateBackend(ABC):
     @abstractmethod
     def keys(self, prefix: str = "") -> List[str]: ...
 
+    def mutate(self, key: str, fn, default: Any = None) -> Any:
+        """Atomic read-modify-write: ``set(key, fn(get(key, default)))``
+        under whatever exclusion the backend can provide. Backends
+        shared ACROSS PROCESSES (FileStore) must make this safe against
+        concurrent mutators — a plain get+set from two masters loses
+        one side's update."""
+        value = fn(self.get(key, default))
+        self.set(key, value)
+        return value
+
 
 class MemoryStore(StateBackend):
     def __init__(self):
         self._lock = threading.Lock()
         self._data: Dict[str, Any] = {}
+
+    def mutate(self, key, fn, default=None):
+        with self._lock:
+            value = fn(self._data.get(key, default))
+            self._data[key] = value
+            return value
 
     def set(self, key, value):
         with self._lock:
@@ -109,6 +125,25 @@ class FileStore(StateBackend):
                 if key.startswith(prefix):
                     out.append(key)
         return sorted(out)
+
+    def mutate(self, key, fn, default=None):
+        """Cross-PROCESS atomic read-modify-write via an fcntl lock on
+        a per-key sidecar: the store is advertised as shared by every
+        master on the reservation, and threading.Lock is invisible to
+        sibling processes (two masters appending to the cluster event
+        log must not lose each other's entries)."""
+        import fcntl
+
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".lock", "a+") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                value = fn(self.get(key, default))
+                self.set(key, value)
+                return value
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
 _singletons: Dict[str, StateBackend] = {}

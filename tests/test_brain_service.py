@@ -1,0 +1,332 @@
+"""Brain service-hood (VERDICT r3 Missing #2 / item #3): a standalone
+process owning a schema-versioned datastore behind a REST surface, the
+same algorithm library answering on both deployments, cross-JOB
+learning (sibling provisioning, cluster-wide node blacklist), and the
+master wiring (brain_addr beats brain_store_path, in-process fallback
+kept)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.brain import algorithms
+from dlrover_tpu.brain.client import (
+    BrainClient,
+    RemoteBrainClient,
+    build_brain_client,
+)
+from dlrover_tpu.brain.service import (
+    SCHEMA_KEY,
+    SCHEMA_VERSION,
+    BrainService,
+)
+from dlrover_tpu.common.node import NodeResource
+from dlrover_tpu.master.stats.reporter import JobMeta
+from dlrover_tpu.util.state_store import FileStore
+
+
+@pytest.fixture()
+def service(tmp_path):
+    svc = BrainService(FileStore(str(tmp_path / "brain")))
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+def _remote(service) -> RemoteBrainClient:
+    return RemoteBrainClient(service.addr, timeout=5, retries=2)
+
+
+def _archive_run(client, job, uuid, worker_speeds, mem_curve=()):
+    meta = JobMeta(uuid=uuid, name=job)
+    client.report_job_meta(meta)
+    for i, (workers, speed) in enumerate(worker_speeds):
+        client.append_doc(job, uuid, "runtime", {
+            "worker_num": workers, "global_step": 10 * (i + 1),
+            "speed": speed, "timestamp": time.time(),
+            "max_used_memory_mb": (
+                mem_curve[i] if i < len(mem_curve) else 0
+            ),
+        })
+
+
+def test_round_trip_and_404(service):
+    remote = _remote(service)
+    remote.put_doc("jobA", "run1", "meta", {"x": 1})
+    assert remote.get_doc("jobA", "run1", "meta") == {"x": 1}
+    assert remote.get_doc("jobA", "run1", "missing", "dflt") == "dflt"
+    remote.append_doc("jobA", "run1", "runtime", {"speed": 1.0})
+    remote.append_doc("jobA", "run1", "runtime", {"speed": 2.0})
+    assert [s["speed"] for s in remote.get_runtime_stats(
+        "jobA", "run1"
+    )] == [1.0, 2.0]
+    assert remote.get_job_runs("jobA") == ["run1"]
+    assert remote.get_job_names() == ["jobA"]
+
+
+def test_job2_provisions_from_job1_archive_via_service(service):
+    """The e2e criterion: master 1 archives through the service; a
+    SECOND master (fresh process state, only the service address)
+    warm-starts its worker count and memory plan from that archive."""
+    from dlrover_tpu.master.resource.local_optimizer import (
+        TPULocalOptimizer,
+    )
+    from dlrover_tpu.scheduler.job_spec import JobArgs
+
+    # job run 1 measured 4 workers clearly faster than 8 (throughput
+    # plateau), and an upward memory trend
+    _archive_run(
+        _remote(service), "bert-ctr", "run-1",
+        [(4, 5.0), (4, 5.2), (8, 3.0), (8, 3.1)],
+        mem_curve=[8000, 9000, 10000, 11000],
+    )
+
+    # "job 2": a brand-new master process — all it shares is brain_addr
+    job_args = JobArgs(
+        job_name="bert-ctr", node_num=8, min_node_num=2, node_unit=2,
+        brain_addr=service.addr,
+    )
+    client2 = build_brain_client(job_args.brain_addr)
+    assert isinstance(client2, RemoteBrainClient)
+    opt = TPULocalOptimizer(
+        job_args=job_args, node_unit=2, brain_client=client2
+    )
+    plan = opt.init_job_resource()
+    group = plan.node_group_resources["worker"]
+    # warm start shrinks toward the historically fastest count (the
+    # spec stays the ceiling — history never grows past it)
+    assert group.count == 4
+    assert group.node_resource.memory >= 11000  # trend + margin
+
+
+def test_sibling_job_resource_plan(service):
+    """A job with NO history of its own provisions from a sibling in
+    the same family (optimize_job_worker_create_resource.go role)."""
+    remote = _remote(service)
+    _archive_run(
+        remote, "llama7b-20260730", "run-1",
+        [(4, 1.0)] * 4, mem_curve=[4000, 4500, 5000, 5500],
+    )
+    resp = remote._rest.request(
+        "GET", "api/v1/optimize/llama7b-20260731/resource?memory=1000"
+    )
+    assert resp["source"] == "sibling_jobs"
+    assert resp["memory"] >= 5500
+    # unrelated family gets nothing
+    assert remote._rest.request(
+        "GET", "api/v1/optimize/gpt-oss/resource"
+    ) == {}
+
+
+def test_cluster_blacklist_across_jobs(service):
+    """One bad probe in one job is noise; the same host degrading two
+    different jobs is a hardware problem."""
+    remote = _remote(service)
+    remote.report_node_event("host-7", "straggler", job_name="job-a")
+    assert remote.get_node_blacklist() == []  # one incident: not yet
+    remote.report_node_event("host-7", "straggler", job_name="job-b")
+    remote.report_node_event("host-3", "oom", job_name="job-a")
+    assert remote.get_node_blacklist() == ["host-7"]
+    # repeated samples of the SAME (job, kind) incident count once
+    remote.report_node_event("host-3", "oom", job_name="job-a")
+    assert remote.get_node_blacklist() == ["host-7"]
+
+
+def test_blacklist_window_expiry():
+    now = time.time()
+    events = [
+        {"host": "h", "kind": "straggler", "job_name": "a",
+         "timestamp": now - 10},
+        {"host": "h", "kind": "straggler", "job_name": "b",
+         "timestamp": now - 7 * 3600},  # outside the 6h window
+    ]
+    assert algorithms.node_blacklist(events, now=now) == []
+    events[1]["timestamp"] = now - 60
+    assert algorithms.node_blacklist(events, now=now) == ["h"]
+
+
+def test_job_family_normalization():
+    assert algorithms.job_family("llama7b-20260731") == "llama7b"
+    assert algorithms.job_family("llama7b-run3") == "llama7b"
+    assert algorithms.job_family("llama7b_2-1") == "llama7b"
+    assert algorithms.job_family("bert-ctr") == "bert-ctr"
+    assert algorithms.job_family("123") == "123"  # never empties
+
+
+def test_schema_version_guard(tmp_path):
+    store = FileStore(str(tmp_path / "brain"))
+    store.set(SCHEMA_KEY, {"version": SCHEMA_VERSION + 1})
+    with pytest.raises(RuntimeError, match="newer"):
+        BrainService(store)
+    # a fresh store gets stamped
+    store2 = FileStore(str(tmp_path / "brain2"))
+    svc = BrainService(store2)
+    assert store2.get(SCHEMA_KEY)["version"] == SCHEMA_VERSION
+    svc._server.server_close()
+
+
+def test_malformed_requests_rejected(service):
+    from dlrover_tpu.scheduler.rest import RestError
+
+    remote = _remote(service)
+    with pytest.raises(RestError):
+        remote._rest.request("POST", "api/v1/archive", {
+            "job_name": "../escape", "uuid": "u", "kind": "k",
+            "doc": {},
+        })
+    with pytest.raises(RestError):
+        remote._rest.request("POST", "api/v1/events", {"host": ""})
+
+
+def test_in_process_fallback_kept(tmp_path):
+    client = build_brain_client("", str(tmp_path / "archive"))
+    assert isinstance(client, BrainClient)
+    assert not isinstance(client, RemoteBrainClient)
+    assert build_brain_client("", "") is None
+
+
+def test_master_cli_carries_brain_addr(tmp_path):
+    from dlrover_tpu.master.args import parse_master_args
+    from dlrover_tpu.master.main import build_job_args
+
+    args = parse_master_args([
+        "--job_name", "j", "--brain_addr", "1.2.3.4:8600",
+    ])
+    job_args = build_job_args(args)
+    assert job_args.brain_addr == "1.2.3.4:8600"
+
+
+def test_failure_exits_feed_node_events(service):
+    """The job manager's failure policy reports exits into the brain's
+    cluster log through the optimizer seam."""
+    from dlrover_tpu.master.resource.local_optimizer import (
+        TPULocalOptimizer,
+    )
+    from dlrover_tpu.scheduler.job_spec import JobArgs
+
+    remote = _remote(service)
+    opt = TPULocalOptimizer(
+        job_args=JobArgs(job_name="j1"), brain_client=remote
+    )
+    opt.report_node_event("worker-0", "oom")
+    events = remote.get_node_events()
+    assert events and events[-1]["host"] == "worker-0"
+    assert events[-1]["job_name"] == "j1"
+
+
+def test_standalone_process_cli(tmp_path):
+    """Service-hood proper: a separate PROCESS serving the store."""
+    import json
+    import subprocess
+    import sys
+    import urllib.request
+
+    from dlrover_tpu.common.grpc_utils import find_free_port
+
+    port = find_free_port()
+    proc = subprocess.Popen([
+        sys.executable, "-m", "dlrover_tpu.brain.service",
+        "--host", "127.0.0.1", "--port", str(port),
+        "--store_path", str(tmp_path / "store"),
+    ], stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 30
+        last = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                ) as resp:
+                    doc = json.loads(resp.read())
+                assert doc["ok"] and doc["schema_version"] == 1
+                break
+            except Exception as e:
+                last = e
+                time.sleep(0.3)
+        else:
+            raise AssertionError(f"service never came up: {last}")
+        remote = RemoteBrainClient(f"127.0.0.1:{port}", timeout=5)
+        remote.put_doc("j", "r", "meta", {"ok": 1})
+        assert remote.get_doc("j", "r", "meta") == {"ok": 1}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_remote_client_plans_server_side(service):
+    """Review fix: the remote client answers optimize queries with ONE
+    service call instead of paging every sibling's runs over REST."""
+    remote = _remote(service)
+    _archive_run(remote, "fam-1", "r1", [(4, 2.0)] * 3,
+                 mem_curve=[1000, 1100, 1200])
+    # count wire requests of a FRESH client during plan_resource
+    probe = _remote(service)
+    calls = []
+    orig = probe._rest.request
+
+    def counting(method, path, body=None):
+        calls.append(path)
+        return orig(method, path, body)
+
+    probe._rest.request = counting
+    planned, source = probe.plan_resource("fam-2")
+    assert planned is not None and source == "sibling_jobs"
+    assert len(calls) == 1 and "optimize/fam-2/resource" in calls[0]
+    plan = probe.get_optimization_plan("fam-1")
+    assert plan is not None and plan.worker_num == 4
+    assert len(calls) == 2 and "optimize/fam-1/plan" in calls[1]
+
+
+def test_event_timestamp_validated_and_tolerated(service):
+    """Review fix: a poisoned timestamp is rejected at the service
+    boundary, and node_blacklist skips (not crashes on) bad entries."""
+    from dlrover_tpu.scheduler.rest import RestError
+
+    remote = _remote(service)
+    with pytest.raises(RestError):
+        remote._rest.request("POST", "api/v1/events", {
+            "host": "h", "kind": "straggler", "timestamp": "yesterday",
+        })
+    assert algorithms.node_blacklist([
+        {"host": "h", "kind": "s", "job_name": "a",
+         "timestamp": "garbage"},
+        {"host": "h", "kind": "s", "job_name": "b",
+         "timestamp": time.time()},
+    ]) == []
+
+
+def test_file_store_mutate_survives_concurrent_processes(tmp_path):
+    """Review fix: two masters appending to the shared file archive
+    must not lose each other's entries (fcntl-locked mutate)."""
+    import subprocess
+    import sys
+
+    root = str(tmp_path / "store")
+    script = (
+        "import sys\n"
+        "from dlrover_tpu.util.state_store import FileStore\n"
+        f"store = FileStore({root!r})\n"
+        "for i in range(50):\n"
+        "    store.mutate('events',"
+        " lambda v: v + [sys.argv[1]], default=[])\n"
+    )
+    procs = [
+        subprocess.Popen([sys.executable, "-c", script, name])
+        for name in ("a", "b")
+    ]
+    for p in procs:
+        assert p.wait(timeout=60) == 0
+    events = FileStore(root).get("events")
+    assert len(events) == 100
+    assert events.count("a") == 50 and events.count("b") == 50
+
+
+def test_brain_reporter_survives_dead_service():
+    """Review fix: an unreachable Brain must not crash master startup."""
+    from dlrover_tpu.brain.client import BrainReporter
+
+    dead = RemoteBrainClient("127.0.0.1:1", timeout=1, retries=1)
+    reporter = BrainReporter(
+        JobMeta(uuid="u", name="j"), client=dead
+    )  # must not raise
+    assert reporter is not None

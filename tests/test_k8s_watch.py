@@ -1,0 +1,338 @@
+"""Apiserver watch streams (VERDICT r3 Missing #3 / item #5).
+
+A scripted stub serves the k8s watch wire format — chunked JSON lines
+of ADDED/MODIFIED/DELETED/BOOKMARK/ERROR events — and the tests drive
+RestK8sApi.watch_pods + GkePodWatcher end to end: event mapping,
+bookmark resume across a mid-stream disconnect, 410-Gone re-list, and
+the headline property that reaction latency is the event's arrival,
+not a poll interval. Parity: k8s_watcher.py:139-152
+``watch.Watch().stream``.
+"""
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+)
+from dlrover_tpu.scheduler.gke import (
+    GkePodWatcher,
+    RestK8sApi,
+    StaleResourceVersion,
+)
+
+JOB = "jobx"
+
+
+def _pod(name, phase="Running", rv="", exit_code=None, reason=None):
+    status = {"phase": phase}
+    if exit_code is not None:
+        status["containerStatuses"] = [{
+            "state": {"terminated": {
+                "exitCode": exit_code, "reason": reason or "",
+            }},
+        }]
+    node_id = name.rsplit("-", 1)[-1]
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {
+                "dlrover-job": JOB,
+                "dlrover-id": node_id,
+                "dlrover-type": "worker",
+                "dlrover-rank": node_id,
+            },
+            **({"resourceVersion": rv} if rv else {}),
+        },
+        "status": status,
+    }
+
+
+class WatchStub(BaseHTTPRequestHandler):
+    """Scripted apiserver: ``server.lists`` are popped per LIST call;
+    ``server.watches`` are popped per WATCH call — each a list of event
+    dicts streamed as JSON lines (then the connection closes, which is
+    exactly a server-side watch timeout/disconnect)."""
+
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):
+        q = dict(parse_qsl(urlparse(self.path).query))
+        self.server.requests.append(q)
+        if q.get("watch") == "1":
+            events = (
+                self.server.watches.pop(0)
+                if self.server.watches else []
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for ev in events:
+                if ev == "hang":
+                    # keep the stream open briefly with no events
+                    time.sleep(0.2)
+                    continue
+                line = json.dumps(ev).encode() + b"\n"
+                chunk = f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                try:
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                except OSError:
+                    return
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+            return
+        body = (
+            self.server.lists.pop(0)
+            if self.server.lists
+            else {"items": [], "metadata": {"resourceVersion": "0"}}
+        )
+        data = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def stub():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), WatchStub)
+    server.requests = []
+    server.lists = []
+    server.watches = []
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def _api(server) -> RestK8sApi:
+    return RestK8sApi(
+        namespace="ns", job_name=JOB,
+        base_url=f"http://127.0.0.1:{server.server_address[1]}",
+        token_provider=None,
+    )
+
+
+def test_watch_pods_yields_typed_events_and_bookmarks(stub):
+    stub.watches.append([
+        {"type": "ADDED", "object": _pod(f"{JOB}-worker-0", rv="11")},
+        {"type": "BOOKMARK", "object": {
+            "metadata": {"resourceVersion": "15"},
+        }},
+        {"type": "MODIFIED", "object": _pod(
+            f"{JOB}-worker-0", phase="Failed", rv="16",
+            exit_code=137, reason="OOMKilled",
+        )},
+        {"type": "DELETED", "object": _pod(
+            f"{JOB}-worker-0", phase="Failed", rv="17",
+        )},
+    ])
+    got = list(_api(stub).watch_pods("10", timeout_seconds=5))
+    kinds = [k for k, _ in got]
+    assert kinds == ["ADDED", "BOOKMARK", "MODIFIED", "DELETED"]
+    assert got[1][1] == "15"
+    assert got[2][1]["exit_code"] == 137
+    assert got[2][1]["resource_version"] == "16"
+    # the request carried watch + bookmark + selector params
+    q = stub.requests[0]
+    assert q["watch"] == "1" and q["resourceVersion"] == "10"
+    assert q["labelSelector"] == f"dlrover-job={JOB}"
+
+
+def test_watch_pods_raises_on_410_gone(stub):
+    stub.watches.append([
+        {"type": "ERROR", "object": {
+            "code": 410, "message": "too old resource version",
+        }},
+    ])
+    with pytest.raises(StaleResourceVersion):
+        list(_api(stub).watch_pods("1", timeout_seconds=5))
+
+
+def _collect(watcher, n, timeout=20.0):
+    out: "queue.Queue" = queue.Queue()
+
+    def run():
+        for ev in watcher.watch():
+            out.put(ev)
+
+    threading.Thread(target=run, daemon=True).start()
+    got = []
+    deadline = time.time() + timeout
+    while len(got) < n and time.time() < deadline:
+        try:
+            got.append(out.get(timeout=0.5))
+        except queue.Empty:
+            continue
+    return got
+
+
+def test_watcher_streams_events_and_resumes_after_disconnect(stub):
+    """list -> watch; the stream drops mid-way; the watcher re-lists
+    and resumes from the advanced bookmark without losing the
+    transition that happened during the gap."""
+    stub.lists.append({
+        "items": [_pod(f"{JOB}-worker-0", rv="5")],
+        "metadata": {"resourceVersion": "5"},
+    })
+    # first watch: one healthy event, then the server drops the stream
+    stub.watches.append([
+        {"type": "MODIFIED", "object": _pod(
+            f"{JOB}-worker-1", phase="Running", rv="8",
+        )},
+    ])
+    # the re-list reflects a failure that happened during the gap
+    stub.lists.append({
+        "items": [
+            _pod(f"{JOB}-worker-0", rv="5"),
+            _pod(f"{JOB}-worker-1", phase="Failed", rv="9",
+                 exit_code=137, reason="OOMKilled"),
+        ],
+        "metadata": {"resourceVersion": "9"},
+    })
+    stub.watches.append(["hang"])
+
+    watcher = GkePodWatcher(JOB, _api(stub), watch_timeout=5)
+    events = _collect(watcher, 3)
+    watcher.stop()
+    assert len(events) >= 3
+    # initial list: worker-0 running
+    assert events[0].node.name == f"{JOB}-worker-0"
+    # stream: worker-1 appears
+    assert events[1].node.name == f"{JOB}-worker-1"
+    assert events[1].node.status == NodeStatus.RUNNING
+    # after the drop, the re-list diff surfaces the missed OOM failure
+    assert events[2].node.name == f"{JOB}-worker-1"
+    assert events[2].node.exit_reason == NodeExitReason.OOM
+    # the second watch resumed with the re-listed version (the watch
+    # request is issued when the consumer pulls the next event — give
+    # the generator thread a beat)
+    deadline = time.time() + 5
+    watch_reqs = []
+    while time.time() < deadline:
+        watch_reqs = [
+            r for r in stub.requests if r.get("watch") == "1"
+        ]
+        if len(watch_reqs) >= 2:
+            break
+        time.sleep(0.05)
+    assert len(watch_reqs) >= 2
+    assert watch_reqs[1]["resourceVersion"] == "9"
+
+
+def test_watcher_recovers_from_stale_bookmark(stub):
+    stub.lists.append({
+        "items": [_pod(f"{JOB}-worker-0", rv="5")],
+        "metadata": {"resourceVersion": "5"},
+    })
+    stub.watches.append([
+        {"type": "ERROR", "object": {"code": 410, "message": "gone"}},
+    ])
+    stub.lists.append({
+        "items": [_pod(f"{JOB}-worker-0", phase="Succeeded", rv="30")],
+        "metadata": {"resourceVersion": "30"},
+    })
+    stub.watches.append(["hang"])
+    watcher = GkePodWatcher(JOB, _api(stub), watch_timeout=5)
+    events = _collect(watcher, 2)
+    watcher.stop()
+    assert events[0].node.status == NodeStatus.RUNNING
+    assert events[1].node.status == NodeStatus.SUCCEEDED
+
+
+def test_reaction_latency_is_event_arrival_not_poll_interval(stub):
+    """The whole point: with a 1000s poll interval the event still
+    lands in well under a second of its emission."""
+    stub.lists.append({
+        "items": [], "metadata": {"resourceVersion": "1"},
+    })
+    stub.watches.append([
+        {"type": "ADDED", "object": _pod(f"{JOB}-worker-0", rv="2")},
+        "hang",
+    ])
+    watcher = GkePodWatcher(
+        JOB, _api(stub), poll_interval=1000.0, watch_timeout=5
+    )
+    t0 = time.time()
+    events = _collect(watcher, 1, timeout=10.0)
+    elapsed = time.time() - t0
+    watcher.stop()
+    assert events and events[0].event_type == NodeEventType.MODIFIED
+    assert elapsed < 5.0, elapsed
+
+
+def test_deleted_event_maps_to_deleted_node(stub):
+    stub.lists.append({
+        "items": [_pod(f"{JOB}-worker-3", rv="5")],
+        "metadata": {"resourceVersion": "5"},
+    })
+    stub.watches.append([
+        {"type": "DELETED", "object": _pod(
+            f"{JOB}-worker-3", phase="Running", rv="6",
+        )},
+        "hang",
+    ])
+    watcher = GkePodWatcher(JOB, _api(stub), watch_timeout=5)
+    events = _collect(watcher, 2)
+    watcher.stop()
+    assert events[1].event_type == NodeEventType.DELETED
+    assert events[1].node.status == NodeStatus.DELETED
+
+
+def test_physical_host_captured_for_blacklist(stub):
+    """Review fix: node events key on spec.nodeName (the physical
+    host), which _to_record must surface — pod names embed the job
+    name and can never repeat across jobs."""
+    body = _pod(f"{JOB}-worker-0", rv="5")
+    body["spec"] = {"nodeName": "gke-node-abc"}
+    body["status"]["hostIP"] = "10.0.0.7"
+    rec = RestK8sApi._to_record(body)
+    assert rec["host_name"] == "gke-node-abc"
+    assert rec["host_ip"] == "10.0.0.7"
+    from dlrover_tpu.scheduler.gke import pod_to_node
+
+    node = pod_to_node(rec)
+    assert node.host_name == "gke-node-abc"
+
+
+def test_transient_list_failure_does_not_mass_delete(stub):
+    """Review fix: a failed list (empty version) must not be diffed
+    against known state — that would read as the fleet being deleted."""
+    watcher = GkePodWatcher(
+        JOB, _api(stub), poll_interval=0.05, watch_timeout=5
+    )
+    watcher._last = {f"{JOB}-worker-0": "Running//"}
+    # simulate the api failing the list
+    watcher._api.list_pods_with_version = lambda: ([], "")
+    gen = watcher._watch_stream()
+    collected = []
+
+    def run():
+        for ev in gen:
+            collected.append(ev)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    watcher.stop()
+    assert collected == []  # no phantom DELETED events
+    assert watcher._last  # baseline preserved
