@@ -1,0 +1,276 @@
+"""Measured per-fusion profile of the flagship single-chip train step.
+
+VERDICT r3 Weak #2: the 50.8% MFU plateau was asserted from a step-time
+decomposition, never proven op-by-op. This script produces the proof
+artifact: it runs the EXACT bench.py flagship step (llama-1b, batch 4,
+seq 2048, dots remat, Pallas flash attention, adamw) under
+``jax.profiler.start_trace``, parses the Chrome trace's TPU lane for
+per-op device durations, classifies every op against the compiled HLO
+(matmul fusion / Pallas attention custom-call / other-elementwise /
+copy), and writes ``PROFILE_STEP_r04.json`` with:
+
+  * top-K ops by device time (per step), each with its HLO kind;
+  * the compute-bound share: device time in matmul+attention vs total
+    device busy time;
+  * device busy vs step wall time (dispatch/idle gap);
+  * the verdict: ``plateau_proven`` when matmul+attention holds >= the
+    threshold share of device busy time — i.e. there is no fusible
+    elementwise gap left for a hand-written kernel to close.
+
+Run on the real chip:  python benchmarks/profile_fusions.py
+"""
+
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TOP_K = 25
+COMPUTE_BOUND_THRESHOLD = 0.90
+STEPS = 10
+
+
+def build_step():
+    import optax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.mesh import create_mesh
+    from dlrover_tpu.trainer.sharded import make_trainer_for_llama
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = llama.llama_1b(remat="dots")
+        batch, seq = 4, 2048
+    else:  # dev smoke
+        cfg = llama.llama_tiny()
+        batch, seq = 8, 128
+    mesh = create_mesh([("data", 1)], devices=[dev])
+    trainer = make_trainer_for_llama(
+        cfg, mesh, strategy="ddp", accum_steps=1,
+        optimizer=optax.adamw(1e-4, b1=0.9, b2=0.95),
+    )
+    params, opt_state = trainer.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    mb = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
+    return trainer, params, opt_state, mb, cfg, batch, seq, on_tpu
+
+
+def classify_hlo(hlo_text: str):
+    """fusion/op name -> kind, from the compiled module text.
+
+    A fusion is 'matmul' if its computation contains a dot; 'attention'
+    if it wraps the Pallas custom-call; 'collective', 'copy', or
+    'elementwise' otherwise."""
+    kinds = {}
+    # computations look like: "%fused_computation.N (...) { ... }";
+    # instructions like "%fusion.N = ... fusion(...), calls=%fused_computation.N"
+    comp_bodies = {}
+    cur = None
+    for line in hlo_text.splitlines():
+        m = re.match(r"\s*%?([\w.\-]+)\s+\([^)]*\)\s+->.*{\s*$", line)
+        if m:
+            cur = m.group(1)
+            comp_bodies[cur] = []
+            continue
+        if cur is not None:
+            if line.strip().startswith("}"):
+                cur = None
+            else:
+                comp_bodies[cur].append(line)
+    calls_re = re.compile(r"%?([\w.\-]+)\s*=.*fusion\(.*calls=%?([\w.\-]+)")
+    fusion_to_comp = {}
+    for line in hlo_text.splitlines():
+        m = calls_re.search(line)
+        if m:
+            fusion_to_comp[m.group(1)] = m.group(2)
+
+    def body_kind(body_lines):
+        body = "\n".join(body_lines)
+        if "tpu_custom_call" in body:
+            return "attention_pallas"
+        # the TPU backend lowers matmuls to convolution(...,
+        # dim_labels=0bf_oi0) — "dot(" rarely survives optimization
+        if re.search(r"\b(dot|convolution)\(", body):
+            return "matmul"
+        if "all-reduce" in body or "all-gather" in body or (
+            "reduce-scatter" in body
+        ):
+            return "collective"
+        if "dynamic-update-slice" in body:
+            return "copy"  # scan-carry / remat buffer writes
+        return "elementwise"
+
+    for fusion, comp in fusion_to_comp.items():
+        kinds[fusion] = body_kind(comp_bodies.get(comp, []))
+    return kinds
+
+
+def name_kind(name: str, hlo_kinds) -> str:
+    base = name.split("(")[0]
+    if base in hlo_kinds:
+        return hlo_kinds[base]
+    low = name.lower()
+    # Pallas kernels keep their python name on the custom-call
+    # instruction (flash_attention.N)
+    if "flash_attention" in low or "custom-call" in low or (
+        "custom_call" in low
+    ):
+        return "attention_pallas"
+    if low.startswith(("copy", "copy-done", "copy-start")) or (
+        "dynamic-update-slice" in low
+    ):
+        return "copy"
+    if "fusion" in low:
+        return hlo_kinds.get(base, "elementwise")
+    if any(k in low for k in ("dot", "convolution", "einsum")):
+        return "matmul"
+    if any(k in low for k in ("all-reduce", "all-gather",
+                              "reduce-scatter", "collective")):
+        return "collective"
+    return "other"
+
+
+def main():
+    trainer, params, opt_state, mb, cfg, batch, seq, on_tpu = build_step()
+
+    # compiled HLO for fusion classification
+    lowered = trainer.train_step.lower(params, opt_state, mb)
+    compiled = lowered.compile()
+    hlo_kinds = {}
+    try:
+        hlo_kinds = classify_hlo(compiled.as_text())
+    except Exception as e:
+        print(f"HLO classification degraded: {e}", file=sys.stderr)
+
+    # warmup (compile + cache)
+    for _ in range(3):
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, mb
+        )
+    float(loss)
+
+    trace_dir = tempfile.mkdtemp(prefix="profile_fusions_")
+    t0 = time.perf_counter()
+    jax.profiler.start_trace(trace_dir)
+    for _ in range(STEPS):
+        params, opt_state, loss = trainer.train_step(
+            params, opt_state, mb
+        )
+    loss_val = float(loss)  # hard sync (axon tunnel quirk)
+    jax.profiler.stop_trace()
+    wall = (time.perf_counter() - t0) / STEPS
+
+    traces = glob.glob(
+        trace_dir + "/**/*.trace.json.gz", recursive=True
+    )
+    if not traces:
+        print(json.dumps({"error": "no trace produced"}))
+        return 1
+    doc = json.load(gzip.open(traces[0]))
+    events = doc["traceEvents"]
+    pids, tids = {}, {}
+    for e in events:
+        if e.get("ph") == "M":
+            if e.get("name") == "process_name":
+                pids[e["pid"]] = e["args"].get("name", "")
+            elif e.get("name") == "thread_name":
+                tids[(e["pid"], e["tid"])] = e["args"].get("name", "")
+    tpu_pids = {p for p, n in pids.items() if "TPU" in n}
+
+    # leaf device ops live on the "XLA Ops" lane; "XLA Modules" carries
+    # the jit_* envelopes, and while/conditional on the ops lane are
+    # CONTAINERS spanning their children — counting them double-counts
+    dur_us = collections.Counter()
+    envelope_us = 0.0
+    containers = ("while", "conditional", "call")
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in tpu_pids:
+            continue
+        lane = tids.get((e.get("pid"), e.get("tid")), "")
+        name = e.get("name", "")
+        if lane == "XLA Modules":
+            envelope_us += e.get("dur", 0)
+            continue
+        if lane != "XLA Ops":
+            continue
+        base = name.split("(")[0].split(".")[0]
+        if base in containers:
+            continue
+        dur_us[name] += e.get("dur", 0)
+
+    total_busy_us = sum(dur_us.values())
+    by_kind = collections.Counter()
+    top = []
+    for name, us in dur_us.most_common():
+        kind = name_kind(name, hlo_kinds)
+        by_kind[kind] += us
+        if len(top) < TOP_K:
+            top.append({
+                "op": name[:120],
+                "kind": kind,
+                "us_per_step": round(us / STEPS, 1),
+                "share_of_busy": round(us / max(total_busy_us, 1), 4),
+            })
+
+    compute_us = by_kind["matmul"] + by_kind["attention_pallas"]
+    compute_share = compute_us / max(total_busy_us, 1)
+    busy_per_step_ms = total_busy_us / STEPS / 1e3
+    result = {
+        "config": {
+            "model": "llama_1b" if on_tpu else "llama_tiny",
+            "batch": batch, "seq": seq, "remat": cfg.remat,
+            "steps_traced": STEPS,
+        },
+        "wall_ms_per_step": round(wall * 1e3, 1),
+        "device_busy_ms_per_step": round(busy_per_step_ms, 1),
+        "device_idle_or_dispatch_ms_per_step": round(
+            wall * 1e3 - busy_per_step_ms, 1
+        ),
+        "share_by_kind": {
+            k: round(v / max(total_busy_us, 1), 4)
+            for k, v in sorted(
+                by_kind.items(), key=lambda kv: -kv[1]
+            )
+        },
+        "compute_bound_share": round(compute_share, 4),
+        "threshold": COMPUTE_BOUND_THRESHOLD,
+        "plateau_proven": bool(
+            compute_share >= COMPUTE_BOUND_THRESHOLD
+        ),
+        "top_ops": top,
+        "final_loss": round(loss_val, 4),
+        "note": (
+            "device op durations from jax.profiler Chrome trace (TPU "
+            "lane); kinds from the compiled HLO's fusion bodies. "
+            "plateau_proven means matmul+Pallas-attention hold >= "
+            f"{COMPUTE_BOUND_THRESHOLD:.0%} of device busy time: no "
+            "fusible elementwise gap remains for a hand-written kernel"
+        ),
+    }
+    out = os.path.join(
+        os.path.dirname(__file__), "..", "PROFILE_STEP_r04.json"
+    )
+    with open(os.path.abspath(out), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({
+        k: result[k] for k in (
+            "wall_ms_per_step", "device_busy_ms_per_step",
+            "share_by_kind", "compute_bound_share", "plateau_proven",
+        )
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

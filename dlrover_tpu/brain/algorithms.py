@@ -131,12 +131,13 @@ def node_blacklist(events: List[Dict],
                    now: Optional[float] = None) -> List[str]:
     """Cluster-wide repeat offenders from the node-event log.
 
-    A host that was evicted as a straggler or hard-failed in
-    ``min_events`` or more DISTINCT jobs-or-incidents within the window
-    is blacklisted — one bad probe in one job is noise, the same host
-    degrading two different jobs is a hardware problem (parity role:
-    the Go Brain's cluster-scoped node status algorithms; the reference
-    README's 'fault detection' cluster learning)."""
+    A host that degraded ``min_events`` or more DISTINCT JOBS within
+    the window is blacklisted — one job's own misbehavior (a data-skew
+    straggler plus an OOM from its misconfigured memory request can
+    land several event kinds on one healthy host) is noise; the same
+    host degrading two different jobs is a hardware problem (parity
+    role: the Go Brain's cluster-scoped node status algorithms; the
+    reference README's 'fault detection' cluster learning)."""
     import time as _time
 
     now = _time.time() if now is None else now
@@ -153,11 +154,9 @@ def node_blacklist(events: List[Dict],
         host = e.get("host") or ""
         if not host:
             continue
-        # distinct incidents: (job, kind) pairs — N samples of the same
-        # straggler verdict in one job count once
-        by_host.setdefault(host, set()).add(
-            (e.get("job_name", ""), e.get("kind", ""))
-        )
+        # distinct incidents = distinct JOBS: N events of any kind
+        # from one job count once
+        by_host.setdefault(host, set()).add(e.get("job_name", ""))
     out = sorted(
         h for h, incidents in by_host.items()
         if len(incidents) >= min_events
@@ -168,13 +167,17 @@ def node_blacklist(events: List[Dict],
 
 
 def job_family(job_name: str) -> str:
-    """Family key for sibling-job lookup: strip trailing run/attempt
-    decorations (``llama7b-20260731``, ``llama7b-run3``, ``llama7b-2``
-    → ``llama7b``) so recurring jobs share history."""
+    """Family key for sibling-job lookup: strip trailing run
+    decorations so recurring jobs share history — but ONLY segments
+    that are unambiguously run-shaped: ``runN``/``attemptN``/``tryN``
+    or long (6+ digit) date/timestamp suffixes. A short trailing
+    number stays (``llama-7`` vs ``llama-70``, ``resnet-50``: that
+    digit encodes the MODEL, and a wrong sibling transfer would hand a
+    small job a 70B-sized memory plan)."""
     import re
 
     return re.sub(
-        r"([-_.](run|attempt|try)?\d+)+$", "", job_name,
+        r"([-_.]((run|attempt|try)\d+|\d{6,}))+$", "", job_name,
         flags=re.IGNORECASE,
     ) or job_name
 

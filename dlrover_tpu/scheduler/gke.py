@@ -28,6 +28,7 @@ onto the Node status/exit-reason model:
 
 import queue
 import threading
+import time
 import urllib.parse
 from abc import ABC, abstractmethod
 from typing import Dict, Iterator, List, Optional
@@ -702,6 +703,7 @@ class GkePodWatcher(NodeWatcher):
                 if gone is not None:
                     yield NodeEvent(NodeEventType.DELETED, gone)
             self._last = seen
+            watch_started = time.monotonic()
             try:
                 for etype, payload in self._api.watch_pods(
                     version, timeout_seconds=self._watch_timeout
@@ -735,7 +737,12 @@ class GkePodWatcher(NodeWatcher):
                         yield NodeEvent(NodeEventType.MODIFIED, node)
                 # stream ended normally (server timeout): resume via
                 # a fresh WATCH from the advanced bookmark — the loop's
-                # re-list keeps state exact even if events were missed
+                # re-list keeps state exact even if events were missed.
+                # A stream that died FAST (watch verb rejected — RBAC,
+                # proxy without chunking) must not tight-loop full-fleet
+                # LISTs against the apiserver: back off first
+                if time.monotonic() - watch_started < 1.0:
+                    self._stopped.wait(self._poll)
             except StaleResourceVersion:
                 # keep self._last: the re-list diff emits MODIFIED for
                 # changes and DELETED for pods that vanished during the

@@ -148,9 +148,14 @@ def test_blacklist_window_expiry():
 def test_job_family_normalization():
     assert algorithms.job_family("llama7b-20260731") == "llama7b"
     assert algorithms.job_family("llama7b-run3") == "llama7b"
-    assert algorithms.job_family("llama7b_2-1") == "llama7b"
+    assert algorithms.job_family("job-try2-20260731") == "job"
     assert algorithms.job_family("bert-ctr") == "bert-ctr"
-    assert algorithms.job_family("123") == "123"  # never empties
+    # short trailing numbers encode the MODEL, not the run: kept
+    # (review fix: llama-7 must never inherit llama-70's memory plan)
+    assert algorithms.job_family("llama-7") == "llama-7"
+    assert algorithms.job_family("llama-70") == "llama-70"
+    assert algorithms.job_family("resnet-50") == "resnet-50"
+    assert algorithms.job_family("123456789") == "123456789"  # never empties
 
 
 def test_schema_version_guard(tmp_path):
@@ -257,7 +262,7 @@ def test_remote_client_plans_server_side(service):
     """Review fix: the remote client answers optimize queries with ONE
     service call instead of paging every sibling's runs over REST."""
     remote = _remote(service)
-    _archive_run(remote, "fam-1", "r1", [(4, 2.0)] * 3,
+    _archive_run(remote, "fam-run1", "r1", [(4, 2.0)] * 3,
                  mem_curve=[1000, 1100, 1200])
     # count wire requests of a FRESH client during plan_resource
     probe = _remote(service)
@@ -269,12 +274,12 @@ def test_remote_client_plans_server_side(service):
         return orig(method, path, body)
 
     probe._rest.request = counting
-    planned, source = probe.plan_resource("fam-2")
+    planned, source = probe.plan_resource("fam-run2")
     assert planned is not None and source == "sibling_jobs"
-    assert len(calls) == 1 and "optimize/fam-2/resource" in calls[0]
-    plan = probe.get_optimization_plan("fam-1")
+    assert len(calls) == 1 and "optimize/fam-run2/resource" in calls[0]
+    plan = probe.get_optimization_plan("fam-run1")
     assert plan is not None and plan.worker_num == 4
-    assert len(calls) == 2 and "optimize/fam-1/plan" in calls[1]
+    assert len(calls) == 2 and "optimize/fam-run1/plan" in calls[1]
 
 
 def test_event_timestamp_validated_and_tolerated(service):
@@ -330,3 +335,20 @@ def test_brain_reporter_survives_dead_service():
         JobMeta(uuid="u", name="j"), client=dead
     )  # must not raise
     assert reporter is not None
+
+
+def test_single_job_cannot_blacklist_a_host():
+    """Review fix: two event KINDS from ONE job (its own data skew +
+    its own OOM) must not blacklist a healthy host; distinct JOBS are
+    the incident unit."""
+    now = time.time()
+    events = [
+        {"host": "h", "kind": "straggler", "job_name": "solo",
+         "timestamp": now},
+        {"host": "h", "kind": "oom", "job_name": "solo",
+         "timestamp": now},
+    ]
+    assert algorithms.node_blacklist(events, now=now) == []
+    events.append({"host": "h", "kind": "oom", "job_name": "other",
+                   "timestamp": now})
+    assert algorithms.node_blacklist(events, now=now) == ["h"]
