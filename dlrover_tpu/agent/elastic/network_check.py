@@ -31,17 +31,26 @@ import jax
 import jax.numpy as jnp
 
 # fault-injection hook (drill grammar, tests/test_four_node_drill.py):
-# "rank:seconds[,rank:seconds]" delays THIS node's probe so the master
-# records it as a straggler (rdzv_manager.get_straggler_nodes)
+# "rank:seconds[:gate_file][,rank:seconds[:gate_file]]" delays THIS
+# node's probe so the master records it as a straggler
+# (rdzv_manager.get_straggler_nodes). With a gate_file, the delay only
+# applies while that file exists — lets a soak drill turn a straggler
+# ON mid-run instead of from the first rendezvous.
 _delay_spec = os.environ.get("DLROVER_TPU_PROBE_DELAY", "")
 _own_rank = os.environ.get("DLROVER_TPU_NODE_RANK", "")
 for _part in _delay_spec.split(","):
-    _r, _, _secs = _part.partition(":")
+    _fields = _part.split(":")
+    if len(_fields) < 2:
+        continue
+    _r, _secs = _fields[0], _fields[1]
+    _gate = _fields[2] if len(_fields) > 2 else ""
     try:
         _delay = float(_secs)
     except ValueError:
         continue  # malformed entry must not fail the probe itself
-    if _r and _r == _own_rank:
+    if _r and _r == _own_rank and (
+        not _gate or os.path.exists(_gate)
+    ):
         time.sleep(_delay)
 
 coordinator = os.environ.get("{COORD}")

@@ -31,6 +31,7 @@ from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.constants import (
     NodeAction,
     NodeEnv,
+    NodeExitReason,
     NodeStatus,
     RendezvousConstant,
     RendezvousName,
@@ -263,6 +264,25 @@ class ElasticTrainingAgent:
                 return result
             if result.state == WorkerState.FAILED:
                 self._report_failure(result)
+                if result.return_code in (137, -9):
+                    # OOM-class death: a LOCAL relaunch cannot help —
+                    # the same memory limit kills it again. Escalate to
+                    # the master (parity: the reference never restarts
+                    # an OOM pod in place; the job manager relaunches
+                    # the NODE with a grown allocation,
+                    # dist_job_manager adjust_oom_resource): report the
+                    # reason and exit with the OOM code so the platform
+                    # scaler maps it (process_scaler.py rc 137 -> OOM)
+                    logger.error(
+                        "Worker died with OOM-class rc=%d; escalating "
+                        "to the master for a grown relaunch",
+                        result.return_code,
+                    )
+                    self._client.update_node_status(
+                        NodeStatus.FAILED, NodeExitReason.OOM,
+                        self._restart_count,
+                    )
+                    return result
                 if self._remaining_restarts > 0:
                     self._remaining_restarts -= 1
                     logger.info(
@@ -383,7 +403,20 @@ def launch_agent(config: ElasticLaunchConfig,
                  master_client: MasterClient) -> RunResult:
     """Run network check (optional) then the elastic agent
     (parity: launch_agent training.py:465)."""
-    if config.network_check:
+    relaunched = int(os.getenv(NodeEnv.RESTART_COUNT, "0")) > 0
+    if config.network_check and relaunched:
+        # a REPLACEMENT node joining a running job skips the
+        # pre-flight check: the check rendezvous needs min_nodes
+        # simultaneous checkers, and the healthy survivors (who
+        # already passed pre-flight) will never re-join it — a solo
+        # checker would deadlock the recovery until joint_timeout.
+        # Runtime monitoring (speed window + straggler verdicts)
+        # covers a bad replacement once it trains.
+        logger.info(
+            "Replacement node (relaunch %s): skipping pre-flight "
+            "network check", os.getenv(NodeEnv.RESTART_COUNT),
+        )
+    elif config.network_check:
         from dlrover_tpu.agent.elastic.network_check import (
             NetworkCheckElasticAgent,
         )

@@ -227,6 +227,12 @@ class MasterClient:
         req = self._fill(comm.RunningNodesRequest())
         return self._call("query_running_nodes", req).nodes
 
+    def request_scale(self, node_num: int) -> bool:
+        """Operator-requested manual scaling (parity: manualScaling)."""
+        req = self._fill(comm.ScaleRequest(node_num=node_num))
+        resp = self._call("request_scale", req)
+        return bool(getattr(resp, "success", False))
+
     # -------------------------------------------------------------- metrics
 
     def report_global_step(self, step: int,

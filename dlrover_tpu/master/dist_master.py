@@ -103,6 +103,7 @@ class DistributedJobMaster:
                 RendezvousName.NETWORK_CHECK
             ].get_straggler_nodes,
             min_nodes=getattr(job_args, "min_node_num", 0) or 0,
+            max_nodes=getattr(job_args, "node_num", 0) or 0,
         )
         self._server, self.servicer = create_master_service(
             port,
@@ -113,6 +114,7 @@ class DistributedJobMaster:
             sync_service=self.sync_service,
             error_monitor=self.error_monitor,
             job_metric_collector=self.job_metric_collector,
+            auto_scaler=self.auto_scaler,
         )
         self.port = self._server.port
         self._exit_code = 0

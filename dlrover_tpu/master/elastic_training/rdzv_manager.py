@@ -230,6 +230,11 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._node_groups: List[Dict[int, int]] = []
         self._singleton_nodes: set = set()
         self._check_round = 2
+        #: probe-evidence rounds kept for straggler localization. A
+        #: verdict therefore DECAYS after this many later rounds the
+        #: node did not participate in — deliberate: evidence from a
+        #: long-gone epoch of the job should not evict a node forever.
+        self.MAX_ROUNDS_KEPT = 64
         # per-round probe evidence for straggler localization: the
         # probe is COLLECTIVE, so a slow node drags its whole group's
         # elapsed time — one round cannot tell the straggler from its
@@ -252,14 +257,24 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             if world is not None:
                 self._rdzv_round += 1
                 self._rdzv_nodes = dict(sorted(world.items()))
-                if (self._rdzv_round - 1) % self._check_round == 0:
-                    # a fresh check cycle: evidence from a previous
-                    # incarnation (different membership/pairings) must
-                    # not be intersected with this one — stale sets
-                    # could mislocalize a healthy node, and the dicts
-                    # would grow for the master's lifetime
-                    self._round_times.clear()
-                    self._round_groups.clear()
+                # bounded history, NOT a cycle clear: a new cohort's
+                # check (replacement/restored nodes probing each
+                # other) must not wipe other nodes' verdicts — a
+                # localized straggler would be forgotten the moment
+                # fresh capacity ran its own pre-flight. Verdict
+                # correctness across cohorts is handled per
+                # participant (get_straggler_nodes: a node's own last
+                # two informative participations), so old rounds only
+                # need pruning for memory.
+                # prune the UNION of keys: a round whose probers died
+                # before reporting exists only in _round_groups and
+                # would otherwise leak for the master's lifetime
+                all_rounds = sorted(
+                    set(self._round_times) | set(self._round_groups)
+                )
+                for stale in all_rounds[: -self.MAX_ROUNDS_KEPT]:
+                    self._round_times.pop(stale, None)
+                    self._round_groups.pop(stale, None)
                 self._node_groups = self._group_nodes(
                     self._rdzv_round, self._rdzv_nodes
                 )
@@ -306,6 +321,14 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 r for r in ranks
                 if not self._node_status.get(r, True) or r in suspects
             ]
+            logger.info(
+                "Re-pair round %d: suspects=%s abnormal=%s "
+                "times=%s", round_num, sorted(suspects), abnormal,
+                {
+                    rnd: {k: round(v, 1) for k, v in ts.items()}
+                    for rnd, ts in self._round_times.items()
+                },
+            )
             normal = [r for r in ranks if r not in abnormal]
             for a in abnormal:
                 if normal:
@@ -361,11 +384,12 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 if not self._node_status.get(r, True)
             ]
 
-    def _slow_sets(self, ratio: float) -> List[set]:
-        """Per recorded round: the union of members of probe groups
-        whose elapsed time exceeds ratio x the round's fastest group.
-        Rounds with fewer than two timed groups carry no signal."""
-        out: List[set] = []
+    def _slow_sets(self, ratio: float) -> List[Tuple[set, set]]:
+        """Per recorded round: ``(participants, slow_members)`` where
+        slow_members are the probe groups whose elapsed time exceeds
+        ratio x the round's fastest group. Rounds with fewer than two
+        timed groups carry no signal."""
+        out: List[Tuple[set, set]] = []
         for rnd in sorted(self._round_times):
             times = self._round_times[rnd]
             groups = self._round_groups.get(rnd) or [
@@ -381,16 +405,18 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             fastest = min(t for _, t in gtimes)
             if fastest <= 0:
                 continue
+            participants: set = set()
             slow: set = set()
             for g, t in gtimes:
+                participants |= g
                 if t > ratio * fastest:
                     slow |= g
-            out.append(slow)
+            out.append((participants, slow))
         return out
 
     def _straggler_suspects(self, ratio: float = 2.0) -> set:
         """Union of slow-group members so far (round-1 re-pairing)."""
-        sets = self._slow_sets(ratio)
+        sets = [slow for _, slow in self._slow_sets(ratio)]
         return set().union(*sets) if sets else set()
 
     def get_straggler_nodes(self, ratio: float = 2.0) -> List[int]:
@@ -400,18 +426,38 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         member's elapsed time; localization needs two rounds with
         DIFFERENT pairings — the straggler is the common member of its
         slow groups (parity role: rdzv_manager.py:368's two-round
-        fault localization, applied to slowness). When the probes were
-        collective (any recorded group has >=2 members), a single
-        informative round CANNOT localize — blame would smear over the
-        whole slow group and a shrink could evict a healthy victim —
-        so this returns [] until two informative rounds exist. The
-        per-node median threshold applies only when times are
-        genuinely per-node (solo probes, no group bookkeeping)."""
+        fault localization, applied to slowness). Verdicts are scoped
+        PER PARTICIPANT: a node is a straggler when its own last two
+        informative PARTICIPATIONS both found it slow — a later check
+        round over a different node subset (a relaunched slice probing
+        itself) must neither clear nor smear verdicts for nodes it
+        never probed. When the probes were collective (any recorded
+        group has >=2 members), a single informative round CANNOT
+        localize — blame would smear over the whole slow group and a
+        shrink could evict a healthy victim — so a node needs two
+        participations. The per-node median threshold applies only
+        when times are genuinely per-node (solo probes, no group
+        bookkeeping)."""
         with self._lock:
-            sets = self._slow_sets(ratio)
-            if len(sets) >= 2:
-                localized = set.intersection(*sets[-2:])
-                return sorted(localized)
+            rounds = self._slow_sets(ratio)
+            if rounds:
+                all_participants = set().union(
+                    *(p for p, _ in rounds)
+                )
+                localized = set()
+                for node in all_participants:
+                    mine = [
+                        slow for participants, slow in rounds
+                        if node in participants
+                    ]
+                    if len(mine) >= 2 and all(
+                        node in slow for slow in mine[-2:]
+                    ):
+                        localized.add(node)
+                if localized:
+                    return sorted(localized)
+                # nothing localized: fall through — the grouped guard
+                # below returns [] while group-level evidence exists
             grouped = any(
                 any(len(g) >= 2 for g in groups)
                 for groups in self._round_groups.values()

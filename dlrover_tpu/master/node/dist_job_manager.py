@@ -431,7 +431,15 @@ class DistributedJobManager:
         if node is None:
             node = Node(node_type, node_id, status=NodeStatus.INITIAL)
             mgr.add_node(node)
-        node.relaunch_count = max(node.relaunch_count, restart_count)
+        # the agent's restart_count counts its WORKER-process restarts —
+        # including healthy membership-change re-rendezvous — and must
+        # NOT be merged into the node's relaunch budget: elastic churn
+        # would exhaust max_relaunch_count and block the relaunch (and
+        # the OOM grow-and-relaunch) of a node that never failed.
+        # Recorded separately for observability only.
+        node.worker_restart_count = max(
+            getattr(node, "worker_restart_count", 0), restart_count
+        )
         event_type = (
             NodeEventType.DELETED if status == NodeStatus.DELETED
             else NodeEventType.MODIFIED

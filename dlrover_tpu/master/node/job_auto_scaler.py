@@ -11,6 +11,7 @@ from typing import Optional
 
 from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
 from dlrover_tpu.master.resource.optimizer import (
     ResourceOptimizer,
     ResourcePlan,
@@ -30,6 +31,7 @@ class AllreduceTrainingAutoScaler:
         interval: float = 60.0,
         straggler_fn=None,
         min_nodes: int = 0,
+        max_nodes: int = 0,
     ):
         self._job_manager = job_manager
         self._job_optimizer = job_optimizer
@@ -39,6 +41,7 @@ class AllreduceTrainingAutoScaler:
         #: network-check rendezvous manager by the master)
         self._straggler_fn = straggler_fn
         self._min_nodes = min_nodes
+        self._max_nodes = max_nodes  # 0 = no ceiling
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -113,6 +116,33 @@ class AllreduceTrainingAutoScaler:
                         n.host_name or n.name, "straggler"
                     )
 
+    def manual_scale(self, node_num: int) -> bool:
+        """Operator-requested scale (parity: the ScalePlan CRD's
+        manualScaling): align to node_unit, floor at min_nodes,
+        retarget the speed monitor (so the periodic restore loop
+        respects the new size instead of growing back), and reconcile
+        immediately."""
+        unit = max(
+            1, getattr(self._job_optimizer, "_node_unit", 1) or 1
+        )
+        aligned = (max(node_num, 0) // unit) * unit
+        aligned = max(aligned, self._min_nodes)
+        if self._max_nodes > 0:
+            # one bad RPC must not provision past the job's declared
+            # ceiling (agents rendezvous with --nnodes min:max anyway)
+            aligned = min(aligned, self._max_nodes)
+        monitor = getattr(self._job_optimizer, "_speed_monitor", None)
+        if monitor is not None:
+            monitor.set_target_worker_num(aligned)
+        plan = ResourcePlan(comment=f"manual scale to {aligned}")
+        plan.node_group_resources[NodeType.WORKER] = (
+            NodeGroupResource(aligned, NodeResource())
+        )
+        logger.info("Manual scale request: %d -> %d workers",
+                    node_num, aligned)
+        self.execute_job_optimization_plan(plan)
+        return True
+
     def execute_job_optimization_plan(self, plan: ResourcePlan):
         """Diff the plan against current bookkeeping and scale. A plan
         carrying ``remove_ranks`` (straggler shrink) removes exactly
@@ -162,9 +192,10 @@ class AllreduceTrainingAutoScaler:
 
 def new_job_auto_scaler(job_manager, job_optimizer, scaler=None,
                         interval: float = 60.0, straggler_fn=None,
-                        min_nodes: int = 0):
+                        min_nodes: int = 0, max_nodes: int = 0):
     """parity: job_auto_scaler.py:40."""
     return AllreduceTrainingAutoScaler(
         job_manager, job_optimizer, scaler, interval,
         straggler_fn=straggler_fn, min_nodes=min_nodes,
+        max_nodes=max_nodes,
     )

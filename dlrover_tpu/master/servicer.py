@@ -36,6 +36,7 @@ class MasterServicer:
         sync_service=None,
         error_monitor=None,
         job_metric_collector=None,
+        auto_scaler=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -44,6 +45,7 @@ class MasterServicer:
         self._sync_service = sync_service
         self._error_monitor = error_monitor
         self._job_metric_collector = job_metric_collector
+        self._auto_scaler = auto_scaler
         self._kv_store = KVStoreService()
         self._start_training_time = 0.0
         self.run_configs = {}
@@ -194,6 +196,16 @@ class MasterServicer:
     def rpc_get_straggler_nodes(self, req: comm.BaseRequest):
         mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
         return mgr.get_straggler_nodes() if mgr else []
+
+    def rpc_request_scale(self, req: comm.ScaleRequest) -> comm.Response:
+        """Operator-requested manual scaling (parity: the ScalePlan
+        CRD's manualScaling consumed by the reference master)."""
+        if self._auto_scaler is None:
+            return comm.Response(
+                success=False, reason="no auto scaler (local master?)"
+            )
+        ok = self._auto_scaler.manual_scale(req.node_num)
+        return comm.Response(success=bool(ok))
 
     # ------------------------------------------------------------- kv store
 
@@ -354,6 +366,7 @@ def create_master_service(
     sync_service=None,
     error_monitor=None,
     job_metric_collector=None,
+    auto_scaler=None,
 ):
     """Build the gRPC server around a MasterServicer
     (parity: servicer.py:478)."""
@@ -365,6 +378,7 @@ def create_master_service(
         sync_service=sync_service,
         error_monitor=error_monitor,
         job_metric_collector=job_metric_collector,
+        auto_scaler=auto_scaler,
     )
     server = GenericRpcServer(servicer.handle, port=port)
     return server, servicer

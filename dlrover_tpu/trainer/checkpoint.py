@@ -322,13 +322,67 @@ class FlashCheckpointer:
         candidates = [s for s in (ram_step, persist_step) if s is not None]
         return max(candidates) if candidates else None
 
+    def _consensus_step(self, local_steps) -> Optional[int]:
+        """The newest step EVERY process can restore.
+
+        After elastic world changes, hosts can hold different RAM-tier
+        histories (a returning host's tmpfs still has files from an
+        older incarnation). Each process restoring its own latest step
+        would silently mix training states — the collectives still
+        shape-match, so nothing crashes, the run is just wrong. With a
+        multi-process world, allgather the per-process candidate sets
+        and take the max step present EVERYWHERE."""
+        if not local_steps:
+            local_steps = set()
+        if self._n_processes <= 1:
+            return max(local_steps) if local_steps else None
+        try:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            k = 16
+            mine = sorted(local_steps)[-k:]
+            arr = np.full((k,), -1, dtype=np.int64)
+            arr[: len(mine)] = mine
+            gathered = multihost_utils.process_allgather(arr)
+            sets = [
+                {int(s) for s in row if s >= 0} for row in gathered
+            ]
+            common = set.intersection(*sets) if sets else set()
+            if common:
+                return max(common)
+            return None
+        except Exception as e:
+            logger.warning(
+                "cross-process checkpoint consensus failed (%s); "
+                "using the local latest", e,
+            )
+            return max(local_steps) if local_steps else None
+
     def restore(self, target: Any = None, step: Optional[int] = None):
         """Restore (state, step), preferring the RAM tier.
 
         ``target``: pytree of arrays with desired shardings (abstract or
         concrete); restored values take the target's shardings so restore
-        works after mesh re-formation.
+        works after mesh re-formation. In auto mode (``step=None``) on a
+        multi-process world, the outcome is AGREED across processes:
+        either every process restores the consensus step or every
+        process starts fresh — never a mix.
         """
+        auto_mode = step is None
+        state, got = self._restore_once(target, step)
+        if auto_mode and self._n_processes > 1:
+            if not self._agree_restored(state is not None):
+                if state is not None:
+                    logger.warning(
+                        "A peer failed to restore step %s; starting "
+                        "fresh everywhere for a consistent world", got,
+                    )
+                return None, None
+        return state, got
+
+    def _restore_once(self, target: Any = None,
+                      step: Optional[int] = None):
         ram = dict(self._list_ram())
         auto_step = step is None
         # one store scan serves both step selection and the fallback
@@ -341,18 +395,17 @@ class FlashCheckpointer:
             )
         if step is None:
             if self._manager is not None:
-                step = self.latest_step()
+                # the Orbax path needs the same cross-process agreement
+                # as the store path: a returning host's stale RAM tier
+                # must not out-vote the shared persistent steps
+                try:
+                    orbax_steps = set(self._manager.all_steps() or [])
+                except Exception:
+                    orbax_steps = set()
+                step = self._consensus_step(set(ram) | orbax_steps)
             else:
-                candidates_for_latest = [
-                    s for s in (
-                        max(ram) if ram else None,
-                        avail[-1] if avail else None,
-                    ) if s is not None
-                ]
-                step = (
-                    max(candidates_for_latest)
-                    if candidates_for_latest else None
-                )
+                local_steps = set(ram) | set(avail or [])
+                step = self._consensus_step(local_steps)
         if step is None:
             return None, None
         if step in ram:
@@ -392,9 +445,13 @@ class FlashCheckpointer:
         # (e.g. a RAM-tier step never persisted): fall back down the
         # restorable persist steps rather than restarting from scratch.
         # An EXPLICITLY requested step never falls back — the caller
-        # asked for that step, not "the best available".
+        # asked for that step, not "the best available". In a
+        # MULTI-PROCESS world the solo walk is disabled: one host
+        # quietly restoring an older step than its peers is the mixed
+        # state the consensus exists to prevent — all processes agree
+        # on the outcome instead (``_agree_restored``).
         candidates = [step]
-        if auto_step:
+        if auto_step and self._n_processes <= 1:
             candidates += [
                 s for s in reversed(avail or []) if s < step
             ]
@@ -420,6 +477,25 @@ class FlashCheckpointer:
                 )
             return _restore_shards(snapshot, target), cand
         return None, None
+
+    def _agree_restored(self, ok: bool) -> bool:
+        """All-process agreement on a restore outcome (auto mode): True
+        only when EVERY process succeeded — one host silently dropping
+        to scratch (or an older step) while peers restore is a mixed
+        world."""
+        if self._n_processes <= 1:
+            return ok
+        try:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(
+                np.asarray([1 if ok else 0], dtype=np.int32)
+            )
+            return bool(np.all(flags))
+        except Exception as e:
+            logger.warning("restore agreement check failed: %s", e)
+            return ok
 
     def close(self):
         self.wait()

@@ -137,7 +137,18 @@ def run(args) -> int:
     result = launch_agent(config, client)
     if master_proc is not None:
         master_proc.terminate()
-    return result.return_code if result.state != "succeeded" else 0
+    if result.state == "succeeded":
+        return 0
+    rc = result.return_code
+    if rc < 0:
+        # signal deaths propagate shell-style (SIGKILL -> 137): a raw
+        # negative rc would be truncated mod 256 by the OS (-9 -> 247)
+        # and the platform scaler's OOM/KILLED exit mapping
+        # (process_scaler.py, pod exit codes) would read UNKNOWN —
+        # silently disabling the master's OOM grow-and-relaunch for
+        # the real kernel-OOM-killer case
+        rc = 128 - rc
+    return rc
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,219 @@
+"""Two-slice elastic workload: live hybrid ICI x DCN mesh (soak drill).
+
+Run by tests/test_slice_soak_drill.py under the elastic launcher: each
+process is one "host" of a mocked TPU slice (slice id = node_rank //
+DLROVER_TPU_SLICE_SIZE). Every incarnation builds the hybrid mesh LIVE
+over the re-formed jax.distributed world — the DCN axis spans slices,
+the ICI axis spans hosts within a slice — so killing a whole slice
+shrinks the DCN axis from 2 to 1 in the next incarnation's mesh, while
+gradients keep psum-ing over BOTH axes every step.
+
+Fault surface:
+  * DLROVER_TPU_DEAD_SLICE_FILE — while the file exists, processes
+    whose slice id appears in it exit(43) immediately (a preempted
+    slice has no capacity: relaunches die until the master prunes it);
+  * the master-KV fault injector (fault_tolerance/injection.py) is
+    polled every step, so the drill can target one rank with
+    ``crash@now:137`` (OOM-class death -> the agent escalates to the
+    master's grow-and-relaunch path) without touching the others.
+
+Progress lines: ``step,world,dcn,loss,unix_ts``.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=400)
+    parser.add_argument("--per-proc-batch", type=int, default=8)
+    parser.add_argument("--dim", type=int, default=16)
+    parser.add_argument("--ckpt-dir", type=str, required=True)
+    parser.add_argument("--progress", type=str, required=True)
+    parser.add_argument("--step-time", type=float, default=0.25)
+    args = parser.parse_args()
+
+    from dlrover_tpu.common.constants import NodeEnv
+
+    node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+    slice_size = int(os.getenv("DLROVER_TPU_SLICE_SIZE", "4"))
+    slice_id = node_rank // slice_size
+    dead_file = os.getenv("DLROVER_TPU_DEAD_SLICE_FILE", "")
+
+    def slice_dead() -> bool:
+        if not dead_file or not os.path.exists(dead_file):
+            return False
+        try:
+            dead = {
+                int(x) for x in open(dead_file).read().split() if x
+            }
+        except ValueError:
+            return False
+        return slice_id in dead
+
+    if slice_dead():
+        print(f"SLICE {slice_id} DEAD: exiting", flush=True)
+        os._exit(43)
+
+    # one device per mocked host: the drill env may carry the test
+    # suite's 8-virtual-device setting, which would explode the world
+    # to 64 devices of collectives on one core
+    os.environ["JAX_NUM_CPU_DEVICES"] = "1"
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", 1)
+    except Exception:
+        pass
+
+    from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+    from dlrover_tpu.trainer.distributed import init_from_env
+
+    init_from_env()
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.parallel.mesh import create_hybrid_mesh
+
+    world = jax.process_count()
+    n_slices = max(1, (world + slice_size - 1) // slice_size)
+    # LIVE hybrid mesh over the re-formed world: data over DCN
+    # (slices), fsdp over ICI (the devices within a slice)
+    n_dev = len(jax.devices())
+    mesh = create_hybrid_mesh(
+        [("fsdp", n_dev // n_slices)], [("data", n_slices)]
+    )
+    dcn = mesh.shape["data"]
+    print(
+        f"HYBRID MESH world={world} dcn={dcn} ici={mesh.shape['fsdp']}"
+        f" slice={slice_id}", flush=True,
+    )
+    repl = NamedSharding(mesh, P())
+    # batch over BOTH axes: every grad psum crosses DCN and ICI
+    batch_sh = NamedSharding(mesh, P(("data", "fsdp")))
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(args.dim, 1).astype(np.float32)
+
+    import optax
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"] + params["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    opt = optax.adam(0.05)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = {"w": jnp.zeros((args.dim, 1)), "b": jnp.zeros((1,))}
+    opt_state = opt.init(params)
+    params = jax.device_put(params, repl)
+
+    ckpt = FlashCheckpointer(
+        persist_dir=os.path.join(args.ckpt_dir, "persist"),
+        ram_dir=os.path.join(args.ckpt_dir, "ram"),
+        persist_interval=0, use_orbax=False,
+    )
+    state = {"params": params, "opt_state": opt_state,
+             "step": jnp.array(0)}
+    restored, _ = ckpt.restore(target=state)
+    start_step = 0
+    if restored is not None:
+        state = restored
+        start_step = int(state["step"])
+        print(f"RESTORED from step {start_step}", flush=True)
+    params = jax.device_put(jax.device_get(state["params"]), repl)
+    opt_state = jax.device_put(jax.device_get(state["opt_state"]), repl)
+
+    # master plumbing: rank 0 feeds the speed monitor; EVERY process
+    # polls the KV fault injector so the drill can target one rank
+    client = None
+    injector = None
+    if os.getenv(NodeEnv.MASTER_ADDR):
+        try:
+            from dlrover_tpu.agent.master_client import (
+                build_master_client,
+            )
+            from dlrover_tpu.fault_tolerance.injection import (
+                FaultInjector,
+            )
+
+            client = build_master_client()
+            injector = FaultInjector(
+                "", master_client=client, node_rank=node_rank,
+                poll_every=2,
+            )
+        except Exception:
+            client = injector = None
+
+    n_local = args.per_proc_batch * jax.local_device_count()
+    global_batch = n_local * world
+    step = start_step
+    loss_val = float("nan")
+    while step < args.steps:
+        t0 = time.time()
+        if slice_dead():
+            print(f"SLICE {slice_id} DEAD at step {step}", flush=True)
+            os._exit(43)
+        seed = 1000 * step + jax.process_index()
+        r = np.random.RandomState(seed)
+        xl = r.randn(n_local, args.dim).astype(np.float32)
+        yl = (xl @ w_true).astype(np.float32)
+        x = jax.make_array_from_process_local_data(
+            batch_sh, xl, (global_batch, args.dim))
+        y = jax.make_array_from_process_local_data(
+            batch_sh, yl, (global_batch, 1))
+        params, opt_state, loss = train_step(params, opt_state, (x, y))
+        loss_val = float(loss)
+        step += 1
+        if injector is not None:
+            injector.maybe_inject(step)
+        # drill determinism: the auto-scaler gates straggler action on
+        # reported training progress; the drill opens the report gate
+        # only after the master's node view has settled, sequencing
+        # the transitions (slice kill first, straggler policy second)
+        report_gate = os.getenv("DLROVER_TPU_REPORT_GATE", "")
+        if client is not None and jax.process_index() == 0 and (
+            step % 5 == 0
+            and (not report_gate or os.path.exists(report_gate))
+        ):
+            try:
+                client.report_global_step(step)
+            except Exception:
+                pass
+        if jax.process_index() == 0:
+            with open(args.progress, "a") as f:
+                f.write(
+                    f"{step},{world},{dcn},{loss_val:.6f},{time.time()}\n"
+                )
+        if step % 5 == 0 or step == args.steps:
+            ckpt.save(
+                step,
+                {"params": jax.device_get(params),
+                 "opt_state": jax.device_get(opt_state),
+                 "step": jnp.array(step)},
+            )
+        dt = time.time() - t0
+        # simulated data-parallel speedup: a bigger world steps faster,
+        # so the speed monitor sees real per-worker throughput (the
+        # plateau veto must not block restoring a preempted slice)
+        floor = args.step_time * 8.0 / max(world, 1)
+        if dt < floor:
+            time.sleep(floor - dt)
+
+    print(f"FINAL step={step} loss={loss_val:.6f} world={world}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

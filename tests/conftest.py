@@ -32,6 +32,7 @@ DRILL_MODULES = {
     "test_e2e_elastic_run",
     "test_operator",
     "test_four_node_drill",
+    "test_slice_soak_drill",
 }
 HEAVY_MODULES = {
     "test_auto",

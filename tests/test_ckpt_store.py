@@ -290,3 +290,46 @@ def test_exists_is_metadata_only(tmp_path, monkeypatch):
         ),
     )
     assert store.exists("k") and not store.exists("missing")
+
+
+def test_restore_consensus_across_processes(tmp_path, monkeypatch):
+    """After elastic world changes, hosts can hold different RAM-tier
+    histories; each restoring its own latest would silently mix
+    training states. The checkpointer must pick the newest step EVERY
+    process can restore (allgather + intersect), or none."""
+    import numpy as np
+
+    ckpt = FlashCheckpointer(
+        persist_dir=str(tmp_path / "p"), ram_dir=str(tmp_path / "r"),
+        persist_interval=0, use_orbax=False,
+    )
+    ckpt._n_processes = 3
+
+    def fake_allgather(arr):
+        # this process has {5, 140}; peers returned {5} and {5, 140}
+        rows = [np.asarray(arr)]
+        a = np.full_like(arr, -1)
+        a[0] = 5
+        rows.append(a)
+        rows.append(np.asarray(arr))
+        return np.stack(rows)
+
+    import jax.experimental.multihost_utils as mhu
+
+    monkeypatch.setattr(mhu, "process_allgather", fake_allgather)
+    assert ckpt._consensus_step({5, 140}) == 5  # newest COMMON step
+
+    def empty_peer(arr):
+        rows = [np.asarray(arr), np.full_like(arr, -1)]
+        rows.append(np.asarray(arr))
+        return np.stack(rows)
+
+    monkeypatch.setattr(mhu, "process_allgather", empty_peer)
+    # one peer has nothing restorable: nobody restores (consistent
+    # fresh start beats a silently mixed world)
+    assert ckpt._consensus_step({5, 140}) is None
+
+    # single process: plain local latest
+    ckpt._n_processes = 1
+    assert ckpt._consensus_step({5, 140}) == 140
+    assert ckpt._consensus_step(set()) is None

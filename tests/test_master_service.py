@@ -179,3 +179,48 @@ def test_local_master_client_fallback():
     t = c.get_task("d")
     assert t.task_id == 0
     c.report_task_result("d", t.task_id)
+
+
+def test_manual_scale_rpc_retargets_and_reconciles():
+    """The ScalePlan CRD's manualScaling verb (reference master
+    consumes it; VERDICT soak drill uses it to stop restore churn into
+    a dead pool): aligns to node_unit, floors at min_nodes, retargets
+    the speed monitor, and reconciles immediately."""
+    from dlrover_tpu.common import comm
+    from dlrover_tpu.master.node.job_auto_scaler import (
+        AllreduceTrainingAutoScaler,
+    )
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    class FakeOptimizer:
+        _node_unit = 4
+
+        def __init__(self, monitor):
+            self._speed_monitor = monitor
+
+    class FakeMonitor:
+        target = None
+
+        def set_target_worker_num(self, n):
+            self.target = n
+
+    class FakeJobManager:
+        _node_managers = {}
+
+    monitor = FakeMonitor()
+    scaler = AllreduceTrainingAutoScaler(
+        FakeJobManager(), FakeOptimizer(monitor), scaler=None,
+        min_nodes=4,
+    )
+    servicer = MasterServicer(auto_scaler=scaler)
+    resp = servicer.handle(
+        "request_scale", comm.ScaleRequest(node_num=6)
+    )
+    assert resp.success
+    assert monitor.target == 4  # 6 aligned down to node_unit, >= min
+
+    # local master (no auto scaler): rejected, not crashed
+    resp = MasterServicer().handle(
+        "request_scale", comm.ScaleRequest(node_num=2)
+    )
+    assert not resp.success

@@ -333,3 +333,37 @@ def test_ha_master_restart_relearns_params_from_rejoin():
         m.join_rendezvous(rank, 1)
     _, _, world = m.get_comm_world(0)
     assert world == {0: 1, 1: 1}
+
+
+def test_subset_check_rounds_do_not_clear_straggler_verdicts():
+    """Soak-drill regression: a relaunched slice's own check rounds
+    (probing only themselves) must neither clear nor smear an earlier
+    straggler verdict for nodes they never probed."""
+    from dlrover_tpu.master.elastic_training.rdzv_manager import (
+        NetworkCheckRendezvousManager,
+    )
+
+    mgr = NetworkCheckRendezvousManager()
+    # round 1: pairwise groups; rank 2's group slow (collective probe)
+    mgr._round_groups[1] = [{0, 1}, {2, 3}, {4, 5}, {6, 7}]
+    mgr._round_times[1] = {0: 1.0, 1: 1.1, 2: 26.0, 3: 25.5,
+                           4: 1.0, 5: 1.2, 6: 0.9, 7: 1.0}
+    # round 2: re-pair — rank 2 slow with a known-good partner,
+    # rank 3 fast with another: rank 2 localized
+    mgr._round_groups[2] = [{2, 0}, {3, 1}, {4, 5, 6, 7}]
+    mgr._round_times[2] = {0: 26.0, 2: 26.0, 1: 1.0, 3: 1.1,
+                           4: 1.0, 5: 1.0, 6: 1.0, 7: 1.0}
+    assert mgr.get_straggler_nodes() == [2]
+
+    # rounds 3-4: a relaunched slice (ranks 4-7) probes ITSELF — rank
+    # 2 is not a participant; its verdict must survive
+    mgr._round_groups[3] = [{4, 5}, {6, 7}]
+    mgr._round_times[3] = {4: 1.0, 5: 1.1, 6: 0.9, 7: 1.0}
+    mgr._round_groups[4] = [{4, 6}, {5, 7}]
+    mgr._round_times[4] = {4: 1.0, 6: 1.1, 5: 0.9, 7: 1.0}
+    assert mgr.get_straggler_nodes() == [2]
+
+    # a later round where rank 2 participates and is FAST clears it
+    mgr._round_groups[5] = [{2, 4}, {5, 6}]
+    mgr._round_times[5] = {2: 1.0, 4: 1.1, 5: 0.9, 6: 1.0}
+    assert mgr.get_straggler_nodes() == []
