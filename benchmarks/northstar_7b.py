@@ -72,10 +72,11 @@ MODELS = {
         ],
     },
 }
-#: single-chip compute efficiency measured on real TPU in round 2
-#: (BENCH_r02.json: 50.66% MFU, llama-1b, dots remat, Pallas flash
-#: attention) — the prior the step-time model extrapolates from
-MEASURED_MFU_PRIOR = 0.5066
+#: single-chip compute efficiency measured on real TPU in round 4
+#: (52.7% MFU, llama-1b, dots remat, Pallas flash attention, bf16
+#: rope — PROFILE_STEP_r04.json) — the prior the step-time model
+#: extrapolates from
+MEASURED_MFU_PRIOR = 0.527
 
 
 

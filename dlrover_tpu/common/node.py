@@ -117,6 +117,10 @@ class Node:
         self.host_ip: Optional[str] = None
         self.hang = False
         self.heartbeat_time: float = 0.0
+        # the agent's self-reported WORKER-process restart count
+        # (observability only — healthy membership-change restarts
+        # increment it, so it must never feed the relaunch budget)
+        self.worker_restart_count: int = 0
 
     def update_info(
         self,

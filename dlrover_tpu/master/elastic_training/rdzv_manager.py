@@ -321,13 +321,18 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 r for r in ranks
                 if not self._node_status.get(r, True) or r in suspects
             ]
+            # log only the PREVIOUS round's times (what this re-pair
+            # decided from) — dumping all 64 retained rounds per
+            # grouping would flood master logs on long-lived jobs
+            prev = max(self._round_times) if self._round_times else None
             logger.info(
                 "Re-pair round %d: suspects=%s abnormal=%s "
-                "times=%s", round_num, sorted(suspects), abnormal,
+                "prev_round_times=%s", round_num, sorted(suspects),
+                abnormal,
                 {
-                    rnd: {k: round(v, 1) for k, v in ts.items()}
-                    for rnd, ts in self._round_times.items()
-                },
+                    k: round(v, 1)
+                    for k, v in self._round_times.get(prev, {}).items()
+                } if prev is not None else {},
             )
             normal = [r for r in ranks if r not in abnormal]
             for a in abnormal:

@@ -438,7 +438,7 @@ class DistributedJobManager:
         # the OOM grow-and-relaunch) of a node that never failed.
         # Recorded separately for observability only.
         node.worker_restart_count = max(
-            getattr(node, "worker_restart_count", 0), restart_count
+            node.worker_restart_count, restart_count
         )
         event_type = (
             NodeEventType.DELETED if status == NodeStatus.DELETED

@@ -44,6 +44,12 @@ class AllreduceTrainingAutoScaler:
         self._max_nodes = max_nodes  # 0 = no ceiling
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # plan generation + execution must be atomic: manual_scale
+        # arrives on the gRPC servicer thread while the periodic loop
+        # may hold a plan computed against the OLD target — without
+        # exclusion the stale plan would undo the manual request (or
+        # both paths double-launch from the same bookkeeping read)
+        self._plan_lock = threading.Lock()
 
     def start_auto_scaling(self):
         if self._thread is None:
@@ -59,10 +65,13 @@ class AllreduceTrainingAutoScaler:
     def _periodic_optimize(self):
         while not self._stopped.wait(self._interval):
             try:
-                plan = self._job_optimizer.generate_job_resource_plan()
-                if plan and not plan.empty():
-                    self.execute_job_optimization_plan(plan)
-                self._maybe_shrink_stragglers()
+                with self._plan_lock:
+                    plan = (
+                        self._job_optimizer.generate_job_resource_plan()
+                    )
+                    if plan and not plan.empty():
+                        self.execute_job_optimization_plan(plan)
+                    self._maybe_shrink_stragglers()
             except Exception as e:
                 logger.error("auto-scale iteration failed: %s", e)
 
@@ -131,16 +140,19 @@ class AllreduceTrainingAutoScaler:
             # one bad RPC must not provision past the job's declared
             # ceiling (agents rendezvous with --nnodes min:max anyway)
             aligned = min(aligned, self._max_nodes)
-        monitor = getattr(self._job_optimizer, "_speed_monitor", None)
-        if monitor is not None:
-            monitor.set_target_worker_num(aligned)
-        plan = ResourcePlan(comment=f"manual scale to {aligned}")
-        plan.node_group_resources[NodeType.WORKER] = (
-            NodeGroupResource(aligned, NodeResource())
-        )
-        logger.info("Manual scale request: %d -> %d workers",
-                    node_num, aligned)
-        self.execute_job_optimization_plan(plan)
+        with self._plan_lock:
+            monitor = getattr(
+                self._job_optimizer, "_speed_monitor", None
+            )
+            if monitor is not None:
+                monitor.set_target_worker_num(aligned)
+            plan = ResourcePlan(comment=f"manual scale to {aligned}")
+            plan.node_group_resources[NodeType.WORKER] = (
+                NodeGroupResource(aligned, NodeResource())
+            )
+            logger.info("Manual scale request: %d -> %d workers",
+                        node_num, aligned)
+            self.execute_job_optimization_plan(plan)
         return True
 
     def execute_job_optimization_plan(self, plan: ResourcePlan):

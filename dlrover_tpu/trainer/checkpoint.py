@@ -370,7 +370,15 @@ class FlashCheckpointer:
         process starts fresh — never a mix.
         """
         auto_mode = step is None
-        state, got = self._restore_once(target, step)
+        try:
+            state, got = self._restore_once(target, step)
+        except Exception as e:
+            # a per-host failure must surface as a FAILED VOTE, never
+            # an exception: peers are (or will be) parked inside the
+            # agreement collective below, and one host skipping it
+            # deadlocks the world
+            logger.warning("restore attempt failed: %s", e)
+            state, got = None, None
         if auto_mode and self._n_processes > 1:
             if not self._agree_restored(state is not None):
                 if state is not None:
