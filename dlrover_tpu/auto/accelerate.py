@@ -68,7 +68,10 @@ def build_trainer(cfg, strategy: Strategy, devices=None,
         attn_fn = make_context_parallel_attn(
             mesh, kind=strategy.context_parallel
         )
-    cfg = dataclasses.replace(cfg, remat=strategy.remat)
+    if hasattr(cfg, "remat"):
+        cfg = dataclasses.replace(cfg, remat=strategy.remat)
+    # families without a remat field (DLRM: lookups + tiny MLPs have
+    # nothing worth rematerializing) keep their config as-is
     from dlrover_tpu.models import make_trainer_for
 
     return make_trainer_for(
@@ -83,12 +86,13 @@ def dryrun_strategy(
     devices=None, steps: int = 3, optimizer=None,
 ) -> float:
     """Compile + time the real train step (parity: DryRunner.profile)."""
+    from dlrover_tpu.models import example_batch
+
     trainer = build_trainer(cfg, strategy, devices, optimizer)
     params, opt_state = trainer.init(jax.random.key(0))
-    tokens = np.random.randint(
-        0, cfg.vocab_size, (global_batch, seq_len), dtype=np.int32
-    )
-    batch = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
+    batch = trainer.shard_batch(trainer.microbatch(
+        example_batch(cfg, global_batch, seq_len)
+    ))
     params, opt_state, loss = trainer.train_step(
         params, opt_state, batch
     )
@@ -134,13 +138,16 @@ def dryrun_abstract(
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         abs_opt, opt_shardings,
     )
+    from dlrover_tpu.models import example_batch
+
     mb = global_batch // max(strategy.accum_steps, 1)
+    # example_batch is zero-filled (shapes/dtypes are all this needs)
     abs_batch = jax.tree.map(
-        lambda _: jax.ShapeDtypeStruct(
-            (strategy.accum_steps, mb, seq_len), np.int32,
+        lambda x: jax.ShapeDtypeStruct(
+            (strategy.accum_steps, mb) + x.shape[1:], x.dtype,
             sharding=trainer.microbatch_sharding,
         ),
-        (0, 0),
+        example_batch(cfg, mb, seq_len),
     )
     compiled = (
         trainer.train_step.lower(abs_params, abs_opt, abs_batch)
@@ -193,6 +200,48 @@ def auto_accelerate(
         len(devices), global_batch,
         num_experts=getattr(cfg, "num_experts", 0),
     )
+    if not hasattr(cfg, "remat") and not strategies:
+        # remat variants build IDENTICAL trainers for families without
+        # a remat field — keep one per effective layout, or the top-k
+        # dryrun slots fill with twins measuring the same program
+        seen_eff = set()
+        collapsed = []
+        for s in candidates:
+            key = (s.mesh_spec, s.sharding, s.accum_steps,
+                   s.context_parallel)
+            if key in seen_eff:
+                continue
+            seen_eff.add(key)
+            collapsed.append(s)
+        candidates = collapsed
+    if type(cfg).__name__ == "DLRMConfig":
+        # the recommender family's natural layout: table rows over
+        # fsdp, batch over data only (parallel/sharding.rowwise_rules)
+        # — add it for every (data, fsdp) mesh in the candidate set
+        from dlrover_tpu.auto.strategy import Strategy as _S
+
+        extra = []
+        seen = {
+            (s.mesh_spec, s.sharding, s.remat, s.accum_steps)
+            for s in candidates
+        }
+        for s in candidates:
+            sizes = dict(s.mesh_spec)
+            if sizes.get("tensor", 1) > 1 or s.sharding == "rowwise":
+                continue
+            spec = tuple(
+                (n, v) for n, v in s.mesh_spec if n != "tensor"
+            ) or (("data", len(devices)),)
+            cand = _S(
+                mesh_spec=spec, sharding="rowwise",
+                remat=s.remat, accum_steps=s.accum_steps,
+            )
+            key = (cand.mesh_spec, cand.sharding, cand.remat,
+                   cand.accum_steps)
+            if key not in seen:
+                seen.add(key)
+                extra.append(cand)
+        candidates = list(candidates) + extra
     reports: List[CandidateReport] = []
     for s in candidates:
         if s.num_devices != len(devices):

@@ -31,6 +31,23 @@ def model_module_for(cfg):
     )
 
 
+def example_batch(cfg, global_batch: int, seq_len: int = 1):
+    """Family-shaped synthetic batch for dryruns/compile checks (the
+    models contract does not fix batch structure: LMs take
+    (tokens, tokens), CNN (images, labels), DLRM (dense, cat, labels)).
+    Zero-filled — shapes and dtypes are what dryruns need; content-free
+    batches cost no RNG or fill time. Families own their shape via a
+    module-level ``example_batch``; LM token pairs are the default for
+    modules without one."""
+    import numpy as np
+
+    mod = model_module_for(cfg)
+    if hasattr(mod, "example_batch"):
+        return mod.example_batch(cfg, global_batch, seq_len)
+    tokens = np.zeros((global_batch, seq_len), dtype=np.int32)
+    return tokens, tokens
+
+
 def make_trainer_for(cfg, mesh=None, strategy: str = "fsdp",
                      accum_steps: int = 1, optimizer=None,
                      attn_fn=None):

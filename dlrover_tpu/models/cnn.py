@@ -164,3 +164,16 @@ def make_trainer(cfg: CNNConfig, mesh=None, strategy: str = "ddp",
         param_axes(cfg), mesh, strategy=strategy,
         optimizer=optimizer, accum_steps=accum_steps,
     )
+
+
+def example_batch(cfg: CNNConfig, global_batch: int, seq_len: int = 1):
+    """Zero-filled (images, labels) for dryruns (models contract hook;
+    see models/__init__.example_batch)."""
+    import numpy as np
+
+    images = np.zeros(
+        (global_batch, cfg.image_size, cfg.image_size, cfg.channels),
+        np.float32,
+    )
+    labels = np.zeros((global_batch,), np.int32)
+    return images, labels

@@ -313,3 +313,15 @@ def make_trainer(cfg: DLRMConfig, mesh=None, strategy: str = "rowwise",
         accum_steps=accum_steps,
         batch_extra_axes=(),
     )
+
+
+def example_batch(cfg: DLRMConfig, global_batch: int,
+                  seq_len: int = 1):
+    """Zero-filled (dense, cat_ids, labels) for dryruns (models
+    contract hook; see models/__init__.example_batch)."""
+    import numpy as np
+
+    dense = np.zeros((global_batch, cfg.dense_dim), np.float32)
+    cat = np.zeros((global_batch, cfg.num_features), np.int32)
+    labels = np.zeros((global_batch,), np.int32)
+    return dense, cat, labels

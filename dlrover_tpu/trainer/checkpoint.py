@@ -370,6 +370,11 @@ class FlashCheckpointer:
         process starts fresh — never a mix.
         """
         auto_mode = step is None
+        if not (auto_mode and self._n_processes > 1):
+            # no agreement collective on this path: let failures
+            # SURFACE — downgrading a single-host restore error to a
+            # fresh start would silently bury a recoverable checkpoint
+            return self._restore_once(target, step)
         try:
             state, got = self._restore_once(target, step)
         except Exception as e:
