@@ -9,6 +9,13 @@ hack — gradient accumulation is a ``lax.scan`` *inside* the jitted train
 step, so the whole accumulate-then-update loop compiles to one XLA program
 per world size (no per-microbatch dispatch overhead, and XLA fuses the
 accumulation adds into the backward).
+
+The reference's ``_ElasticLRScheduler`` (elastic.py:139 — step the LR
+schedule only on sync boundaries so world changes don't skew it) is
+n/a-by-design here: one ``train_step`` call IS one optimizer update at
+every world size, and optax schedules key off the update count carried
+in ``opt_state`` — which rides the flash checkpoint across world
+changes, so the schedule position is exact by construction.
 """
 
 import time
