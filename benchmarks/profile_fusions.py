@@ -49,8 +49,8 @@ def build_step():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     if on_tpu:
-        cfg = llama.llama_1b(remat="dots")
-        batch, seq = 4, 2048
+        cfg = llama.llama_1b(remat="dots_attn_out")
+        batch, seq = 3, 2048
     else:  # dev smoke
         cfg = llama.llama_tiny()
         batch, seq = 8, 128

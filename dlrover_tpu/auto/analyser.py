@@ -22,12 +22,22 @@ BYTES = {"bf16": 2, "fp32": 4}
 # activation bytes per (token x hidden) per layer, by remat policy —
 # calibrated on the v5e llama-1b runs (dots saves matmul outputs ~10x
 # hidden per token-layer; minimal keeps only layer inputs)
-ACT_FACTOR = {"off": 30.0, "dots": 12.0, "minimal": 2.5}
+# "dots_attn_out" = dots plus the attention custom_vjp residuals
+# (q,k,v,o,lse) saved outside the checkpointed segments — more live
+# activation bytes than dots, but the backward never re-runs the
+# attention forward kernel (measured on v5e: 52.99% -> 56.8% MFU at
+# the same batch; see bench.py / PROFILE_STEP_r04.json)
+ACT_FACTOR = {
+    "off": 30.0, "dots": 12.0, "dots_attn_out": 16.0, "minimal": 2.5,
+}
 
 # step-FLOPs multiplier from rematerialization: fwd+bwd ~ 3x fwd; full
 # recompute of the forward in the backward adds ~1 fwd (4/3); "dots"
 # saves matmul outputs so only the cheap elementwise work is redone
-REMAT_COMPUTE = {"off": 1.0, "dots": 1.08, "minimal": 4.0 / 3.0}
+REMAT_COMPUTE = {
+    "off": 1.0, "dots": 1.08, "dots_attn_out": 1.02,
+    "minimal": 4.0 / 3.0,
+}
 
 
 @dataclasses.dataclass

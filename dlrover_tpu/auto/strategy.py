@@ -15,7 +15,7 @@ import dataclasses
 import json
 from typing import List, Optional, Tuple
 
-REMAT_POLICIES = ("off", "dots", "minimal")
+REMAT_POLICIES = ("off", "dots", "dots_attn_out", "minimal")
 PRECISIONS = ("bf16", "fp32")
 
 
@@ -107,7 +107,7 @@ def enumerate_strategies(
             else:
                 names = ["ddp"]
             for name in names:
-                for remat in ("dots", "minimal"):
+                for remat in ("dots", "dots_attn_out", "minimal"):
                     out.append(Strategy(
                         mesh_spec=tuple(specs), sharding=name,
                         remat=remat,

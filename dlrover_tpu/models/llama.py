@@ -40,9 +40,12 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    # activation checkpointing per block: "dots" saves matmul outputs
-    # (fastest, most memory), "minimal" recomputes everything (fits big
-    # models on small HBM), "off" disables remat
+    # activation checkpointing per block: "dots" saves matmul outputs;
+    # "dots_attn_out" additionally keeps the attention call OUTSIDE the
+    # checkpointed segments so its kernel residuals are saved and the
+    # backward never re-runs the forward kernel (fastest, most memory —
+    # the single-chip bench champion); "minimal" recomputes everything
+    # (fits big models on small HBM); "off" disables remat
     remat: str = "dots"
     # chunked cross-entropy: compute logits + log-softmax over sequence
     # chunks of this many tokens inside a rematerialized scan, so the
@@ -54,6 +57,13 @@ class LlamaConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+
+    def __post_init__(self):
+        if self.remat not in ("off", "dots", "dots_attn_out",
+                              "minimal"):
+            # unknown strings would silently fall through the remat
+            # if/elif chains as "off" — an unexplained OOM, not an error
+            raise ValueError(f"unknown remat policy {self.remat!r}")
 
     @property
     def head_dim(self) -> int:
@@ -245,22 +255,23 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     )
 
 
-def _block(cfg: LlamaConfig, x, layer_params, cos, sin, attn_fn):
-    """One decoder block. x: [batch, seq, hidden]. Returns (x, aux_loss)
-    where aux_loss is the MoE balance loss (0 for dense)."""
+def _pre_attn(cfg: LlamaConfig, x, layer_params, cos, sin):
+    """Block segment 1: attn-norm + q/k/v projections + rope."""
     b, s, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     p = layer_params
-
     y = rms_norm(x, p["attn_norm"], cfg.norm_eps)
     q = (y @ p["wq"]).reshape(b, s, nh, hd)
     k = (y @ p["wk"]).reshape(b, s, nkv, hd)
     v = (y @ p["wv"]).reshape(b, s, nkv, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
-    attn = attn_fn(q, k, v)
-    x = x + attn.reshape(b, s, nh * hd) @ p["wo"]
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
+
+def _post_attn(cfg: LlamaConfig, x, attn, layer_params):
+    """Block segment 2: output projection + residual + MLP."""
+    b, s, h = x.shape
+    p = layer_params
+    x = x + attn.reshape(b, s, -1) @ p["wo"]
     y = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
     if cfg.num_experts > 0:
         from dlrover_tpu.parallel.moe import moe_mlp
@@ -274,6 +285,14 @@ def _block(cfg: LlamaConfig, x, layer_params, cos, sin, attn_fn):
     gate = jax.nn.silu(y @ p["w_gate"])
     x = x + (gate * (y @ p["w_up"])) @ p["w_down"]
     return x, jnp.zeros((), jnp.float32)
+
+
+def _block(cfg: LlamaConfig, x, layer_params, cos, sin, attn_fn):
+    """One decoder block. x: [batch, seq, hidden]. Returns (x, aux_loss)
+    where aux_loss is the MoE balance loss (0 for dense)."""
+    q, k, v = _pre_attn(cfg, x, layer_params, cos, sin)
+    attn = attn_fn(q, k, v)
+    return _post_attn(cfg, x, attn, layer_params)
 
 
 def hidden_states(
@@ -294,7 +313,31 @@ def hidden_states(
         x, aux = _block(cfg, x, layer_params, cos, sin, attn_fn)
         return (x, aux_sum + aux), None
 
-    if cfg.remat == "dots":
+    if cfg.remat == "dots_attn_out":
+        # "dots" remat on the segments AROUND attention, with the
+        # attention call OUTSIDE any checkpoint: its custom_vjp
+        # residuals (q, k, v, o, lse) are then kept like ordinary
+        # activations, so the backward pass never re-runs the forward
+        # kernel (under plain "dots" the re-fwd is ~7% of the step).
+        # Costs the saved residuals' HBM (~q+k+v+o+lse per layer).
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+        pre = jax.checkpoint(
+            partial(_pre_attn, cfg), policy=policy,
+        )
+        post = jax.checkpoint(
+            partial(_post_attn, cfg), policy=policy,
+        )
+
+        def body(carry, layer_params):  # noqa: F811
+            x, aux_sum = carry
+            q, k, v = pre(x, layer_params, cos, sin)
+            attn = attn_fn(q, k, v)
+            x, aux = post(x, attn, layer_params)
+            return (x, aux_sum + aux), None
+
+    elif cfg.remat == "dots":
         body = jax.checkpoint(
             body,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,

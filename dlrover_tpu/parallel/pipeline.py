@@ -337,8 +337,12 @@ def pipeline_llama_forward(
         return llama._block(cfg, x, layer_params, cos, sin, attn_fn)
 
     # honor the config's activation-checkpointing policy per block, same
-    # as the un-pipelined llama.forward
-    if cfg.remat == "dots":
+    # as the un-pipelined llama.forward. "dots_attn_out" maps to "dots"
+    # here: under pipelining the activation budget scales with in-flight
+    # microbatches, so saving the attention residuals (its single-chip
+    # throughput win) is the wrong trade — and silently running with NO
+    # remat would be worse than either.
+    if cfg.remat in ("dots", "dots_attn_out"):
         block_fn = jax.checkpoint(
             block_fn,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
@@ -347,6 +351,8 @@ def pipeline_llama_forward(
         block_fn = jax.checkpoint(
             block_fn, policy=jax.checkpoint_policies.nothing_saveable
         )
+    elif cfg.remat != "off":
+        raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
     if num_chunks > 1:
         x, aux = interleaved_pipeline_apply(
