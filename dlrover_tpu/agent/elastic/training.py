@@ -324,6 +324,18 @@ class ElasticTrainingAgent:
         env[NodeEnv.RESTART_COUNT] = str(self._restart_count)
         env[NodeEnv.RDZV_ROUND] = str(rdzv_round)
         env[NodeEnv.MASTER_ADDR] = self._client.master_addr
+        # every worker this agent spawns shares one host-local
+        # compilation cache that OUTLIVES the worker process: a
+        # same-topology restart (crash, hang recovery, preemption
+        # resume) re-jits from disk instead of re-compiling — the warm
+        # half of the <60s failover budget (trainer/compile_cache.py)
+        from dlrover_tpu.trainer.compile_cache import (
+            default_cache_dir,
+        )
+
+        env.setdefault(
+            NodeEnv.COMPILE_CACHE_DIR, default_cache_dir()
+        )
         # Make the framework importable in the spawned process even when it
         # is not pip-installed and the entrypoint lives in another directory
         # (``python script.py`` puts the script's dir on sys.path, not cwd).

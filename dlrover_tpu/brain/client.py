@@ -28,6 +28,7 @@ persistence in without touching the collector.
 """
 
 import dataclasses
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -269,13 +270,16 @@ class RemoteBrainClient(BrainClient):
     """
 
     def __init__(self, addr: str, timeout: float = 10.0,
-                 retries: int = 3):
+                 retries: int = 3, token: Optional[str] = None):
         from dlrover_tpu.scheduler.rest import RestClient
 
         if "://" not in addr:
             addr = f"http://{addr}"
         self._rest = RestClient(
-            addr, timeout=timeout, retries=retries
+            addr, timeout=timeout, retries=retries,
+            # the service's optional shared-secret check
+            # (brain/service.py --token_file)
+            token_provider=(lambda: token) if token else None,
         )
         self._store = None  # no local store: the service owns it
 
@@ -382,12 +386,30 @@ class RemoteBrainClient(BrainClient):
 def build_brain_client(addr: str = "",
                        store_path: str = "") -> Optional[BrainClient]:
     """brain_addr → the cluster service; brain_store_path → in-process
-    file archive; neither → None (brain disabled)."""
+    file archive; neither → None (brain disabled).
+
+    When the service runs with ``--token_file`` (brain/service.py),
+    in-framework clients pick the shared secret up from
+    ``DLROVER_TPU_BRAIN_TOKEN_FILE`` (a mounted secret, preferred) or
+    ``DLROVER_TPU_BRAIN_TOKEN`` — the same env every master/operator
+    process already carries its platform credentials in.
+    """
     if addr:
-        return RemoteBrainClient(addr)
+        return RemoteBrainClient(addr, token=_token_from_env())
     if store_path:
         return BrainClient(build_state_store("file", store_path))
     return None
+
+
+def _token_from_env() -> Optional[str]:
+    path = os.getenv("DLROVER_TPU_BRAIN_TOKEN_FILE", "")
+    if path:
+        try:
+            with open(path) as f:
+                return f.read().strip() or None
+        except OSError as e:
+            logger.warning("brain token file unreadable: %s", e)
+    return os.getenv("DLROVER_TPU_BRAIN_TOKEN") or None
 
 
 class BrainReporter(StatsReporter):

@@ -32,9 +32,19 @@ Surface (all JSON):
                                                repeat-offender hosts
 
 Run:  python -m dlrover_tpu.brain.service --port 8600 --store_path /var/brain
+
+Security: the service authenticates nothing by default (matching the
+reference's in-cluster Brain), but its writes steer CLUSTER-WIDE
+decisions — a reachable port lets any pod poison the cross-job archive
+or blacklist healthy hosts. Deployments MUST either (a) scope access
+with a NetworkPolicy admitting only job-master pods to the port, or
+(b) pass ``--token_file``: every request (except /healthz) must then
+carry ``Authorization: Bearer <token>``, which RemoteBrainClient sends
+when given the same token.
 """
 
 import argparse
+import hmac
 import json
 import re
 import threading
@@ -72,10 +82,12 @@ class BrainService:
     """Threaded HTTP server wrapping a BrainClient over one store."""
 
     def __init__(self, store: Optional[StateBackend] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None):
         self._client = BrainClient(store or build_state_store())
         _ensure_schema(self._client._store)
         self._write_lock = threading.Lock()
+        self._token = token or None
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -90,15 +102,35 @@ class BrainService:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _authorized(self) -> bool:
+                if service._token is None:
+                    return True
+                if self.path.split("?")[0].rstrip("/") == "/healthz":
+                    return True  # liveness probes carry no secrets
+                got = self.headers.get("Authorization", "")
+                return hmac.compare_digest(
+                    got, f"Bearer {service._token}"
+                )
+
             def do_GET(self):
+                if not self._authorized():
+                    self._send(401, {"error": "missing or bad token"})
+                    return
                 try:
                     code, doc = service._get(self.path)
+                except ValueError as e:
+                    # client input (bad query value, bad name) — not a
+                    # server fault; no stack trace, no 500
+                    code, doc = 400, {"error": str(e)}
                 except Exception as e:  # never kill the server thread
                     logger.exception("brain GET %s failed", self.path)
                     code, doc = 500, {"error": str(e)}
                 self._send(code, doc)
 
             def do_POST(self):
+                if not self._authorized():
+                    self._send(401, {"error": "missing or bad token"})
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     raw = self.rfile.read(n) if n else b"{}"
@@ -258,10 +290,19 @@ def main(argv=None) -> int:
         "--store_path", required=True,
         help="directory of the versioned file datastore",
     )
+    ap.add_argument(
+        "--token_file", default=None,
+        help="path to a shared-secret file; when set, requests must "
+             "send Authorization: Bearer <token> (see module doc)",
+    )
     args = ap.parse_args(argv)
+    token = None
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
     service = BrainService(
         build_state_store("file", args.store_path),
-        host=args.host, port=args.port,
+        host=args.host, port=args.port, token=token,
     )
     service.start()
     print(f"brain service listening on {args.host}:{service.port}",

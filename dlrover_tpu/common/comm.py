@@ -21,13 +21,20 @@ Wire forms (all JSON):
   bytes     -> {"__b64__": "<base64>"}
   dict      -> {"__map__": [[key, value], ...]}   (preserves int keys)
   list/tuple-> [ ... ]        primitives -> as-is
+
+Container contract: the only sequence type on the wire is ``list`` —
+tuples are ACCEPTED on encode but always DECODE as lists (JSON has one
+array type). A message field typed ``Tuple[...]``, or any code that
+``is``-compares / unpacks a tuple-valued metric, would silently change
+type after one RPC hop; declare sequence fields as ``List`` and compare
+by value.
 """
 
 import base64
 import dataclasses
 import json
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 
 class WireError(ValueError):

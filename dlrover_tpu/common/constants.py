@@ -143,6 +143,10 @@ class NodeEnv:
     RDZV_ROUND = "DLROVER_TPU_RDZV_ROUND"
     # data sharding
     AUTO_SHARDING = "DLROVER_TPU_AUTO_SHARDING"
+    # host-local persistent XLA compilation cache directory shared by
+    # every worker incarnation on this host (trainer/compile_cache.py);
+    # "off" disables
+    COMPILE_CACHE_DIR = "DLROVER_TPU_COMPILE_CACHE_DIR"
 
 
 class TaskType:

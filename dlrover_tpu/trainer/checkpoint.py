@@ -264,21 +264,27 @@ class FlashCheckpointer:
                         self._store, step, self._process_index,
                         payload.pop(), attempt=self._attempt,
                     )
-                committed = True
-                if self._process_index == 0:
-                    committed = ckpt_store.commit_step(
-                        self._store, step, self._n_processes,
-                        attempt=self._attempt,
-                        timeout=self.commit_timeout,
+                if self._process_index != 0:
+                    # only rank 0 knows whether the step COMMITs;
+                    # claiming "done" here misleads incident triage
+                    # when the commit barrier later times out
+                    logger.info(
+                        "Persistent save step %d: shard uploaded "
+                        "(awaiting rank-0 commit)", step,
                     )
-                    if committed:
-                        with self._persist_lock:
-                            # one gc'er: concurrent per-process deletes
-                            # of the same objects race for no benefit
-                            ckpt_store.gc_steps(
-                                self._store, self.max_persist_keep
-                            )
+                    return
+                committed = ckpt_store.commit_step(
+                    self._store, step, self._n_processes,
+                    attempt=self._attempt,
+                    timeout=self.commit_timeout,
+                )
                 if committed:
+                    with self._persist_lock:
+                        # one gc'er: concurrent per-process deletes
+                        # of the same objects race for no benefit
+                        ckpt_store.gc_steps(
+                            self._store, self.max_persist_keep
+                        )
                     logger.info("Persistent save step %d done", step)
                 else:
                     logger.error(
@@ -375,24 +381,58 @@ class FlashCheckpointer:
             # SURFACE — downgrading a single-host restore error to a
             # fresh start would silently bury a recoverable checkpoint
             return self._restore_once(target, step)
-        try:
-            state, got = self._restore_once(target, step)
-        except Exception as e:
-            # a per-host failure must surface as a FAILED VOTE, never
-            # an exception: peers are (or will be) parked inside the
-            # agreement collective below, and one host skipping it
-            # deadlocks the world
-            logger.warning("restore attempt failed: %s", e)
-            state, got = None, None
-        if auto_mode and self._n_processes > 1:
-            if not self._agree_restored(state is not None):
-                if state is not None:
-                    logger.warning(
-                        "A peer failed to restore step %s; starting "
-                        "fresh everywhere for a consistent world", got,
-                    )
-                return None, None
+        # Multi-process auto mode runs a FIXED collective sequence —
+        # consensus allgather, then agreement allgather — on every
+        # host, no matter what fails locally:
+        #   1. candidate listing (never raises: store/Orbax errors
+        #      contribute an empty set, so a host with a broken store
+        #      still reaches the consensus collective; an exception
+        #      here would make its agreement gather pair against
+        #      peers' consensus gather — mismatched collectives)
+        #   2. consensus step selection (collective #1)
+        #   3. the fallible restore attempt; failure = a failed vote
+        #   4. outcome agreement (collective #2)
+        step = self._consensus_step(self._local_candidate_steps())
+        state, got = None, None
+        if step is not None:
+            try:
+                state, got = self._restore_once(target, step)
+            except Exception as e:
+                logger.warning("restore attempt failed: %s", e)
+                state, got = None, None
+        if not self._agree_restored(state is not None):
+            if state is not None:
+                logger.warning(
+                    "A peer failed to restore step %s; starting "
+                    "fresh everywhere for a consistent world", got,
+                )
+            return None, None
         return state, got
+
+    def _local_candidate_steps(self) -> set:
+        """This host's restorable-step candidates; errors yield an
+        empty contribution instead of raising (see ``restore``: every
+        host must reach the consensus collective)."""
+        steps: set = set()
+        try:
+            steps |= set(dict(self._list_ram()))
+        except Exception as e:
+            logger.warning("RAM-tier listing failed: %s", e)
+        if self._manager is not None:
+            try:
+                steps |= set(self._manager.all_steps() or [])
+            except Exception as e:
+                logger.warning("Orbax step listing failed: %s", e)
+        else:
+            try:
+                steps |= set(
+                    ckpt_store.available_steps(
+                        self._store, self._process_index
+                    )
+                )
+            except Exception as e:
+                logger.warning("persist-tier listing failed: %s", e)
+        return steps
 
     def _restore_once(self, target: Any = None,
                       step: Optional[int] = None):
@@ -400,9 +440,11 @@ class FlashCheckpointer:
         auto_step = step is None
         # one store scan serves both step selection and the fallback
         # candidate list (each available_steps call lists the bucket
-        # and HEADs every committed step — don't do it twice)
+        # and HEADs every committed step — don't do it twice); both
+        # consumers are auto-mode only (an explicit step never walks
+        # down), so explicit-step restores skip the scan entirely
         avail: Optional[list] = None
-        if self._manager is None:
+        if self._manager is None and auto_step:
             avail = ckpt_store.available_steps(
                 self._store, self._process_index
             )

@@ -1,0 +1,111 @@
+"""Persistent XLA compilation cache: make warm restarts cheap.
+
+Why this exists (SURVEY §7 hard part #1): the reference's failover
+design restarts training processes in place precisely to avoid paying
+re-setup costs (dlrover/python/elastic_agent/torch/training.py:441
+_restart_workers). On TPU the dominant re-setup cost is neither the
+process fork nor the rendezvous — it is XLA re-compiling the training
+step (tens of seconds at 1B scale, minutes at 7B). A restarted process
+traces the same program over the same mesh, so the compile is 100%
+redundant; JAX's persistent compilation cache turns it into a
+disk read.
+
+Deployment shape: the agent points every worker it spawns at a
+host-local tmpfs directory (``/dev/shm``) that OUTLIVES the worker
+process — a restarted worker hits the executables its predecessor
+compiled. The cache key covers the HLO, the compile options, and the
+device topology, so a world-size change after elasticity simply misses
+the cache and compiles fresh (correct, just cold); a same-topology
+restart — the common failover case: process crash, hang recovery,
+preemption resume on the same hosts — hits it.
+
+Measured effect is recorded in ``FAILOVER_r05.json``
+(benchmarks/failover_warm.py): restart→first-new-step, cold vs warm,
+on the real chip.
+"""
+
+import os
+import tempfile
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+#: env contract (agent -> worker); value "off" disables the cache
+ENV_CACHE_DIR = NodeEnv.COMPILE_CACHE_DIR
+#: compiles faster than this are not cached (jax's default 1s floor
+#: would skip small-but-many programs whose SUM is the restart tax)
+ENV_MIN_COMPILE_SECS = "DLROVER_TPU_COMPILE_CACHE_MIN_SECS"
+
+_DISABLED = ("off", "none", "0", "")
+
+
+def default_cache_dir() -> str:
+    """Host-local tmpfs so the cache survives process restarts but not
+    host replacement (a replacement host has different devices anyway).
+    Per-uid suffix: cache entries are DESERIALIZED EXECUTABLES, so a
+    fixed path under world-writable /dev/shm would let another local
+    user pre-create it and seed attacker-controlled entries
+    (setup_compilation_cache additionally enforces ownership+0700)."""
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else (
+        tempfile.gettempdir()
+    )
+    return os.path.join(
+        base, f"dlrover_tpu_compile_cache_{os.getuid()}"
+    )
+
+
+def setup_compilation_cache(
+    cache_dir: Optional[str] = None,
+) -> Optional[str]:
+    """Enable jax's persistent compilation cache; returns the directory
+    (created if needed) or None when disabled.
+
+    Resolution order: explicit arg > ``DLROVER_TPU_COMPILE_CACHE_DIR``
+    > the tmpfs default. Must run before the first ``jit`` executes —
+    ``init_from_env`` calls it, so agent-launched workers get it for
+    free; standalone scripts can call it directly.
+    """
+    if cache_dir is None:
+        cache_dir = os.getenv(ENV_CACHE_DIR)
+    if cache_dir is None:
+        cache_dir = default_cache_dir()
+    if cache_dir.strip().lower() in _DISABLED:
+        logger.info("persistent compilation cache disabled")
+        return None
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    # entries are executables this process will LOAD: refuse a dir
+    # someone else owns (exist_ok would happily adopt a pre-created
+    # trap under a shared /dev/shm or /tmp) — train cold instead
+    st = os.stat(cache_dir)
+    if st.st_uid != os.getuid():
+        logger.error(
+            "compilation cache dir %s is owned by uid %d (we are %d); "
+            "refusing to load executables from it — cache disabled",
+            cache_dir, st.st_uid, os.getuid(),
+        )
+        return None
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.getenv(ENV_MIN_COMPILE_SECS, "0.1")),
+    )
+    # size floor off: the restart path re-runs EVERY program, small
+    # ones included (the dir lives on tmpfs; jax_compilation_cache_max_size
+    # stays at its default, bounding growth)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    logger.info("persistent compilation cache at %s", cache_dir)
+    return cache_dir
+
+
+def cache_entries(cache_dir: str) -> int:
+    """Number of cached executables (drill/observability helper)."""
+    try:
+        return sum(
+            1 for n in os.listdir(cache_dir)
+            if not n.startswith(".")
+        )
+    except FileNotFoundError:
+        return 0

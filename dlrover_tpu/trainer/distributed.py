@@ -52,6 +52,31 @@ def init_from_env(timeout_s: int = 300) -> DistributedEnv:
     budget.
     """
     env = read_dist_env()
+    # the launcher's platform contract WINS inside the worker: site
+    # hooks (e.g. a TPU-tunnel sitecustomize) may rewrite
+    # jax_platforms to "<plugin>,cpu", and then a worker the agent
+    # launched with JAX_PLATFORMS=cpu still probes the plugin backend
+    # first — a wedged/slow device service stalls a worker that was
+    # never meant to touch it. Re-assert the env value on the config
+    # (must happen before any backend use; init_from_env is the
+    # worker's first call).
+    plat = os.getenv("JAX_PLATFORMS", "")
+    if plat:
+        import jax
+
+        if jax.config.jax_platforms != plat:
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception as e:  # backends already up: keep going
+                logger.warning("could not re-assert %s: %s", plat, e)
+    # before any jit: a restarted process re-traces the same program,
+    # and the persistent cache turns its re-compile into a disk read
+    # (the warm half of the <60s failover budget — compile_cache.py)
+    from dlrover_tpu.trainer.compile_cache import (
+        setup_compilation_cache,
+    )
+
+    setup_compilation_cache()
     if env.is_distributed and env.coordinator_addr:
         import jax
 

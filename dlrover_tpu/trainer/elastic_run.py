@@ -50,6 +50,13 @@ def parse_args(argv=None):
                         help="pre-flight host/chip health check")
     parser.add_argument("--standalone", action="store_true",
                         help="self-host a local master subprocess")
+    parser.add_argument("--compile_cache_dir", type=str,
+                        default=os.getenv(NodeEnv.COMPILE_CACHE_DIR, ""),
+                        help="persistent XLA compilation cache dir "
+                             "(host-local tmpfs; restarted workers "
+                             "re-jit from disk). Default: "
+                             "/dev/shm/dlrover_tpu_compile_cache; "
+                             "'off' disables")
     parser.add_argument("--master_addr", type=str,
                         default=os.getenv(NodeEnv.MASTER_ADDR, ""))
     parser.add_argument("entrypoint", type=str, help="training script/cmd")
@@ -134,6 +141,8 @@ def run(args) -> int:
         args=entry_args,
         env={NodeEnv.MASTER_ADDR: master_addr},
     )
+    if args.compile_cache_dir:
+        config.env[NodeEnv.COMPILE_CACHE_DIR] = args.compile_cache_dir
     result = launch_agent(config, client)
     if master_proc is not None:
         master_proc.terminate()

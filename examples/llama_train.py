@@ -20,6 +20,7 @@ elastic control plane come from one framework.
 import argparse
 import os
 import sys
+import time
 
 import jax
 import numpy as np
@@ -68,8 +69,13 @@ def main():
     parser.add_argument("--ckpt-dir", type=str,
                         default="/tmp/llama_ckpt")
     parser.add_argument("--out", type=str, default="")
+    parser.add_argument("--timing-out", type=str, default="",
+                        help="append '<restart_count>,<secs_to_first_"
+                             "step>' per incarnation (the failover "
+                             "drill's cold/warm compile probe)")
     args = parser.parse_args()
 
+    t_proc_start = time.time()
     env = init_from_env()
     client = build_master_client()
     cfg = llama.llama_tiny()
@@ -115,12 +121,28 @@ def main():
     )
 
     step, loss = start_step, None
+    first_step_done = False
     try:
         for batch in loader:
             mb = jax.tree.map(lambda x: x[None], batch)  # 1 microbatch
             params, opt_state, loss = trainer.train_step(
                 params, opt_state, mb
             )
+            if not first_step_done:
+                # the restart tax this incarnation actually paid:
+                # process start -> first optimizer step retired
+                # (bootstrap + restore + trace + XLA compile or a
+                # persistent-cache read — compile_cache.py)
+                float(loss)  # device sync
+                t_first = time.time() - t_proc_start
+                first_step_done = True
+                print(
+                    f"FIRST_STEP restart={env.restart_count} "
+                    f"secs={t_first:.3f}", flush=True,
+                )
+                if args.timing_out:
+                    with open(args.timing_out, "a") as f:
+                        f.write(f"{env.restart_count},{t_first:.3f}\n")
             step += 1
             reporter.report_step(step)
             if step % 10 == 0 or step >= args.steps:

@@ -352,3 +352,73 @@ def test_single_job_cannot_blacklist_a_host():
     events.append({"host": "h", "kind": "oom", "job_name": "other",
                    "timestamp": now})
     assert algorithms.node_blacklist(events, now=now) == ["h"]
+
+
+def test_malformed_query_is_400_not_500(service):
+    """ADVICE r4: client input errors (bad query value) must map to
+    400, not a stack-traced 500 — the two are indistinguishable in
+    incident triage otherwise."""
+    from dlrover_tpu.scheduler.rest import RestError
+
+    remote = _remote(service)
+    with pytest.raises(RestError) as ei:
+        remote._rest.request(
+            "GET", "api/v1/blacklist?window_seconds=abc"
+        )
+    assert ei.value.status == 400
+
+
+def test_shared_token_auth(tmp_path):
+    """ADVICE r4: the optional shared-secret check. Without the right
+    bearer token every endpoint except /healthz answers 401; with it
+    (RemoteBrainClient token=) everything works."""
+    from dlrover_tpu.scheduler.rest import RestError
+
+    svc = BrainService(
+        FileStore(str(tmp_path / "brain")), token="s3cret"
+    )
+    svc.start()
+    try:
+        anon = RemoteBrainClient(svc.addr, timeout=5, retries=1)
+        # liveness probes stay open (they carry no secrets)
+        assert anon._rest.request("GET", "healthz")["ok"] is True
+        with pytest.raises(RestError) as ei:
+            anon._rest.request("GET", "api/v1/jobs")
+        assert ei.value.status == 401
+        with pytest.raises(RestError) as ei:
+            anon._rest.request(
+                "POST", "api/v1/events",
+                {"host": "h", "kind": "oom", "job_name": "j"},
+            )
+        assert ei.value.status == 401
+
+        authed = RemoteBrainClient(
+            svc.addr, timeout=5, retries=1, token="s3cret"
+        )
+        authed.put_doc("jobA", "run1", "meta", {"x": 1})
+        assert authed.get_doc("jobA", "run1", "meta") == {"x": 1}
+    finally:
+        svc.stop()
+
+
+def test_token_from_env_reaches_in_framework_clients(
+    tmp_path, monkeypatch
+):
+    """Review fix: build_brain_client (the path dist_master actually
+    uses) must pick the shared secret up from the env, or enabling
+    --token_file would 401 every in-framework client."""
+    svc = BrainService(
+        FileStore(str(tmp_path / "brain")), token="s3cret"
+    )
+    svc.start()
+    try:
+        tok_file = tmp_path / "tok"
+        tok_file.write_text("s3cret\n")
+        monkeypatch.setenv(
+            "DLROVER_TPU_BRAIN_TOKEN_FILE", str(tok_file)
+        )
+        client = build_brain_client(svc.addr)
+        client.put_doc("jobZ", "run1", "meta", {"ok": 1})
+        assert client.get_doc("jobZ", "run1", "meta") == {"ok": 1}
+    finally:
+        svc.stop()

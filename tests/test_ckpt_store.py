@@ -333,3 +333,44 @@ def test_restore_consensus_across_processes(tmp_path, monkeypatch):
     ckpt._n_processes = 1
     assert ckpt._consensus_step({5, 140}) == 140
     assert ckpt._consensus_step(set()) is None
+
+
+def test_restore_collective_sequence_survives_store_errors(
+    tmp_path, monkeypatch
+):
+    """ADVICE r4 (medium): a host whose candidate listing raises BEFORE
+    the consensus allgather used to skip that collective while peers
+    entered it — its agreement gather then paired against peers'
+    consensus gather (mismatched shapes/dtypes). The fixed sequence
+    runs BOTH collectives on every host no matter what fails locally:
+    listing errors contribute an empty candidate set."""
+    import numpy as np
+
+    ckpt = FlashCheckpointer(
+        persist_dir=str(tmp_path / "p"), ram_dir=str(tmp_path / "r"),
+        persist_interval=0, use_orbax=False,
+    )
+    ckpt._n_processes = 2
+
+    # the persist store is broken: every list/HEAD raises
+    def boom(*a, **k):
+        raise OSError("store unreachable")
+
+    monkeypatch.setattr(ckpt_store, "available_steps", boom)
+
+    calls = []
+
+    def record_allgather(arr):
+        arr = np.asarray(arr)
+        calls.append((arr.shape, arr.dtype.name))
+        return np.stack([arr, arr])  # peer mirrors this host
+
+    import jax.experimental.multihost_utils as mhu
+
+    monkeypatch.setattr(mhu, "process_allgather", record_allgather)
+
+    state, step = ckpt.restore(target=None)
+    assert (state, step) == (None, None)
+    # the full fixed sequence ran: consensus (16,) int64 gather, then
+    # the agreement (1,) int32 vote — identical to a healthy host's
+    assert calls == [((16,), "int64"), ((1,), "int32")]

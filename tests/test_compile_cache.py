@@ -1,0 +1,57 @@
+"""Persistent compilation cache helper (trainer/compile_cache.py)."""
+
+import os
+
+import pytest
+
+from dlrover_tpu.trainer import compile_cache
+
+
+def test_disabled_values(monkeypatch):
+    for v in ("off", "none", "0"):
+        assert compile_cache.setup_compilation_cache(v) is None
+
+
+def test_env_resolution_and_perms(tmp_path, monkeypatch):
+    d = str(tmp_path / "cc")
+    monkeypatch.setenv(compile_cache.ENV_CACHE_DIR, d)
+    got = compile_cache.setup_compilation_cache()
+    assert got == d and os.path.isdir(d)
+    # executables-only dir: private to this uid
+    assert (os.stat(d).st_mode & 0o777) == 0o700
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir == d
+
+
+def test_foreign_owned_dir_refused(tmp_path, monkeypatch):
+    """Cache entries are deserialized executables: a pre-created dir
+    owned by another uid must be refused, not adopted."""
+    d = str(tmp_path / "trap")
+    os.makedirs(d)
+    real_stat = os.stat
+
+    class FakeStat:
+        def __init__(self, st):
+            self.st_uid = st.st_uid + 1  # someone else
+            self.st_mode = st.st_mode
+
+    monkeypatch.setattr(
+        os, "stat",
+        lambda p, *a, **k: FakeStat(real_stat(p, *a, **k))
+        if p == d else real_stat(p, *a, **k),
+    )
+    assert compile_cache.setup_compilation_cache(d) is None
+
+
+def test_default_dir_is_per_uid():
+    assert str(os.getuid()) in compile_cache.default_cache_dir()
+
+
+def test_cache_entries_counts(tmp_path):
+    d = str(tmp_path)
+    assert compile_cache.cache_entries(d) == 0
+    (tmp_path / "jit_f-abc-cache").mkdir()
+    (tmp_path / ".hidden").write_text("x")
+    assert compile_cache.cache_entries(d) == 1
+    assert compile_cache.cache_entries(d + "/missing") == 0
