@@ -1,0 +1,212 @@
+"""Cluster monitor: a standalone watcher feeding the Brain datastore.
+
+Parity reference: dlrover/go/brain/cmd/k8smonitor/main.go — a
+cluster-scoped process (NOT a job master) that consumes the apiserver
+watch stream for the whole namespace and records node-health incidents
+into the Brain, so cross-job learning (the host blacklist, OOM
+history) does not depend on any single job master surviving to report
+its own failures. A job whose master dies WITH the bad host still
+contributes evidence; the next job provisions around it.
+
+TPU-native shape: the same watch-capable ``K8sApi`` seam the per-job
+watcher uses (scheduler/gke.py — list-once for the bookmark, react to
+events, resume from bookmarks, 410 re-list keeping the diff baseline)
+but with NO job label filter, classifying terminal pod states into the
+Brain's node-event vocabulary keyed by PHYSICAL host
+(``spec.nodeName``):
+
+  exit 137 / OOMKilled           -> "oom"     (memory pressure)
+  status.reason Evicted/Preempt* -> "evicted" (platform reclaimed it)
+  any other non-zero exit        -> "failure" (hardware-suspect)
+
+Clean exits and scheduling churn are NOT incidents. De-dup is by pod
+fingerprint (name + terminal state): watch re-syncs after a stream
+drop replay the same state without double-counting, matching the
+blacklist algorithm's distinct-(job, kind) incident unit
+(brain/algorithms.py node_blacklist).
+
+Run:  python -m dlrover_tpu.brain.monitor \
+          --brain_addr brain:8600 --namespace prod
+"""
+
+import argparse
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from dlrover_tpu.brain.client import BrainClient, build_brain_client
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.scheduler.gke import (
+    K8sApi,
+    PodRecord,
+    StaleResourceVersion,
+)
+
+#: health-event kinds (the blacklist treats kinds uniformly; these
+#: names match what job masters / optimizers already report)
+KIND_OOM = "oom"
+KIND_EVICTED = "evicted"
+KIND_FAILURE = "failure"
+
+
+def classify(rec: PodRecord) -> Optional[str]:
+    """Terminal pod state -> brain event kind, or None for healthy /
+    in-flight / clean-exit states (parity: the exit-reason mapping in
+    dlrover/python/master/watcher/k8s_watcher.py:49)."""
+    reason = (rec.get("reason") or "").lower()
+    exit_code = rec.get("exit_code")
+    if exit_code in (137,) or "oomkill" in reason:
+        return KIND_OOM
+    if reason.startswith("evict") or reason.startswith("preempt"):
+        return KIND_EVICTED
+    if rec.phase == "Failed" or (
+        exit_code is not None and exit_code != 0
+    ):
+        return KIND_FAILURE
+    return None
+
+
+class ClusterMonitor:
+    """Watch the namespace, write incidents through a BrainClient."""
+
+    def __init__(self, api: K8sApi, brain: BrainClient,
+                 poll_interval: float = 5.0,
+                 watch_timeout: int = 300):
+        self._api = api
+        self._brain = brain
+        self._poll = poll_interval
+        self._watch_timeout = watch_timeout
+        self._stopped = threading.Event()
+        #: pod name -> last reported terminal fingerprint
+        self._reported: Dict[str, str] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ events
+
+    def _handle(self, rec: PodRecord) -> Optional[Tuple[str, str]]:
+        """Returns (host, kind) when a NEW incident was recorded."""
+        kind = classify(rec)
+        if kind is None:
+            return None
+        host = rec.get("host_name") or ""
+        if not host:
+            # without the physical host there is nothing to learn —
+            # the blacklist is keyed on hardware, not pod names
+            return None
+        job = rec.get("labels", {}).get("dlrover-job", "")
+        if not job:
+            # only dlrover workloads are evidence: an unlabeled pod's
+            # crash would count as a DISTINCT job in the blacklist's
+            # incident unit, letting one dlrover job's self-inflicted
+            # failure + any bystander crash blacklist a healthy host
+            return None
+        fp = f"{kind}/{rec.get('exit_code')}/{rec.get('reason')}"
+        if self._reported.get(rec.name) == fp:
+            return None  # same terminal state replayed (re-sync)
+        self._reported[rec.name] = fp
+        try:
+            self._brain.report_node_event(host, kind, job_name=job)
+        except Exception as e:  # Brain outage must not kill the watch
+            logger.warning("brain event write failed: %s", e)
+            self._reported.pop(rec.name, None)  # retry on next sight
+            return None
+        logger.info(
+            "cluster incident: host=%s kind=%s job=%s pod=%s",
+            host, kind, job, rec.name,
+        )
+        return host, kind
+
+    # ------------------------------------------------------------- loop
+
+    def run_forever(self):
+        """List + watch, resuming like the per-job watcher (bookmarks,
+        410 re-list with the reported-baseline kept, fast-fail
+        backoff). Polling fallback for watch-less backends."""
+        if not self._api.supports_watch():
+            while not self._stopped.is_set():
+                names = set()
+                for rec in self._api.list_pods():
+                    names.add(rec.name)
+                    self._handle(rec)
+                # prune like the watch branch: a deleted pod's de-dup
+                # entry would otherwise pin memory forever AND swallow
+                # a recreated same-name pod's identical failure
+                for name in set(self._reported) - names:
+                    self._reported.pop(name, None)
+                self._stopped.wait(self._poll)
+            return
+        while not self._stopped.is_set():
+            records, version = self._api.list_pods_with_version()
+            if not version:
+                self._stopped.wait(self._poll)
+                continue
+            names = set()
+            for rec in records:
+                names.add(rec.name)
+                self._handle(rec)
+            # pods gone from the listing can never replay their
+            # terminal state: drop their de-dup entries
+            for name in set(self._reported) - names:
+                self._reported.pop(name, None)
+            watch_started = time.monotonic()
+            try:
+                for etype, payload in self._api.watch_pods(
+                    version, timeout_seconds=self._watch_timeout
+                ):
+                    if self._stopped.is_set():
+                        return
+                    if etype == "BOOKMARK":
+                        version = payload or version
+                        continue
+                    rec = payload
+                    version = rec.get("resource_version") or version
+                    if etype == "DELETED":
+                        self._handle(rec)  # final state rides the event
+                        self._reported.pop(rec.name, None)
+                        continue
+                    self._handle(rec)
+                if time.monotonic() - watch_started < 1.0:
+                    self._stopped.wait(self._poll)
+            except StaleResourceVersion:
+                logger.info("cluster watch bookmark expired; re-listing")
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.run_forever, daemon=True, name="cluster-monitor"
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--brain_addr", required=True,
+                    help="host:port of the Brain service")
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--watch_timeout", type=int, default=300)
+    args = ap.parse_args(argv)
+
+    from dlrover_tpu.scheduler.gke import RestK8sApi
+
+    api = RestK8sApi(namespace=args.namespace, job_name="")
+    brain = build_brain_client(args.brain_addr)
+    monitor = ClusterMonitor(
+        api, brain, watch_timeout=args.watch_timeout
+    )
+    logger.info(
+        "cluster monitor: namespace=%s brain=%s",
+        args.namespace, args.brain_addr,
+    )
+    try:
+        monitor.run_forever()
+    except KeyboardInterrupt:
+        monitor.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,7 +42,8 @@ class DistributedJobMaster:
     """
 
     def __init__(self, port: int = 0, job_args=None, scaler=None,
-                 watcher=None, autoscale_interval: float = 60.0):
+                 watcher=None, autoscale_interval: float = 60.0,
+                 brain_client=None):
         self.speed_monitor = SpeedMonitor()
         self.error_monitor = ErrorMonitor()
         job_name = getattr(job_args, "job_name", "") or "job"
@@ -55,22 +56,23 @@ class DistributedJobMaster:
         )
         self.stats_reporter = LocalStatsReporter(job_meta)
         collector_reporter = self.stats_reporter
-        brain_client = None
         brain_addr = getattr(job_args, "brain_addr", "") or ""
         brain_path = getattr(job_args, "brain_store_path", "") or ""
-        if brain_addr or brain_path:
+        if brain_client is not None or brain_addr or brain_path:
             # durable archive: collected stats tee into the brain so
             # future runs (and, via the service, SIBLING jobs) provision
-            # from history. brain_addr -> the cluster service
-            # (brain/service.py); brain_store_path -> in-process file
-            # archive fallback
+            # from history. An externally built client (master/main.py
+            # shares the factory's) wins; else brain_addr -> the
+            # cluster service (brain/service.py); brain_store_path ->
+            # in-process file archive fallback
             from dlrover_tpu.brain.client import (
                 BrainReporter,
                 build_brain_client,
             )
             from dlrover_tpu.master.stats.reporter import TeeStatsReporter
 
-            brain_client = build_brain_client(brain_addr, brain_path)
+            if brain_client is None:
+                brain_client = build_brain_client(brain_addr, brain_path)
             collector_reporter = TeeStatsReporter(job_meta, [
                 self.stats_reporter,
                 BrainReporter(job_meta, client=brain_client),

@@ -75,12 +75,18 @@ def run(args) -> int:
         # The scaler bakes the master address into worker metadata, so
         # the port must be fixed before the platform is built. Probing a
         # free port then binding is racy, so retry on bind failure.
+        from dlrover_tpu.brain.client import build_brain_client
+
+        brain_client = build_brain_client(
+            job_args.brain_addr, job_args.brain_store_path
+        )
         master = None
         for attempt in range(3):
             port = args.port or find_free_port()
             scaler, watcher = build_platform(
                 job_args,
                 f"{_master_host(args, job_args.platform)}:{port}",
+                brain_client=brain_client,
             )
             try:
                 master = DistributedJobMaster(
@@ -89,6 +95,7 @@ def run(args) -> int:
                     autoscale_interval=getattr(
                         args, "autoscale_interval", 60.0
                     ),
+                    brain_client=brain_client,
                 )
                 break
             except Exception as e:

@@ -21,7 +21,7 @@ from dlrover_tpu.master.watcher.base_watcher import NodeWatcher
 
 
 def build_platform(
-    job_args, master_addr: str
+    job_args, master_addr: str, brain_client=None
 ) -> Tuple[Optional[Scaler], Optional[NodeWatcher]]:
     platform = getattr(job_args, "platform", "local")
     job_name = getattr(job_args, "job_name", "job")
@@ -73,6 +73,21 @@ def build_platform(
                 job_name=job_name,
                 image=getattr(res, "image", "") if res else "",
             )
+        if brain_client is not None:
+            # cross-job node-health learning, closed loop: incidents
+            # recorded by job masters AND the standalone cluster
+            # monitor (brain/monitor.py) keep repeat-offender hosts
+            # out of this job's pod placement (required anti-affinity
+            # in RestK8sApi._pod_manifest)
+            try:
+                bad = brain_client.get_node_blacklist()
+                if bad:
+                    logger.info(
+                        "brain blacklist: scheduling around %s", bad
+                    )
+                    api.set_avoid_hosts(bad)
+            except Exception as e:
+                logger.warning("brain blacklist unavailable: %s", e)
         scaler = GkePodScaler(
             job_name, api, master_addr,
             worker_env=dict(getattr(job_args, "worker_env", {}) or {}),

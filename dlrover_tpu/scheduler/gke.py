@@ -93,6 +93,19 @@ class K8sApi(ABC):
                 return rec
         return None
 
+    # -- placement -------------------------------------------------------
+
+    def set_avoid_hosts(self, hosts: List[str]) -> None:
+        """Physical hosts new pods must not land on (the Brain's
+        cluster blacklist — brain/algorithms.py node_blacklist). The
+        base impl records them; backends that build manifests apply
+        them as required node anti-affinity."""
+        self._avoid_hosts = list(hosts)
+
+    @property
+    def avoid_hosts(self) -> List[str]:
+        return list(getattr(self, "_avoid_hosts", []))
+
     # -- watch support (event-driven watchers; poll is the fallback) ----
 
     def supports_watch(self) -> bool:
@@ -288,6 +301,21 @@ class RestK8sApi(K8sApi):
         }
         if selector:
             spec["nodeSelector"] = selector
+        avoid = self.avoid_hosts
+        if avoid:
+            # the Brain's repeat-offender hosts: required anti-affinity
+            # (the list is short and windowed — algorithms.py caps the
+            # incident window — so it cannot starve scheduling the way
+            # an ever-growing set would)
+            spec["affinity"] = {"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [{
+                        "key": "kubernetes.io/hostname",
+                        "operator": "NotIn",
+                        "values": sorted(avoid),
+                    }]}],
+                },
+            }}
         return {
             "apiVersion": "v1",
             "kind": "Pod",
