@@ -36,11 +36,7 @@ from typing import Dict, Optional, Tuple
 
 from dlrover_tpu.brain.client import BrainClient, build_brain_client
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.scheduler.gke import (
-    K8sApi,
-    PodRecord,
-    StaleResourceVersion,
-)
+from dlrover_tpu.scheduler.gke import K8sApi, PodRecord
 
 #: health-event kinds (the blacklist treats kinds uniformly; these
 #: names match what job masters / optimizers already report)
@@ -79,6 +75,11 @@ class ClusterMonitor:
         self._stopped = threading.Event()
         #: pod name -> last reported terminal fingerprint
         self._reported: Dict[str, str] = {}
+        #: incidents whose Brain write failed, awaiting retry — the
+        #: pod may be GONE by then (a DELETED event carried it), so
+        #: sighting-based retry alone would lose it
+        self._pending: list = []
+        self._last_flush = 0.0
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ events
@@ -107,8 +108,13 @@ class ClusterMonitor:
         try:
             self._brain.report_node_event(host, kind, job_name=job)
         except Exception as e:  # Brain outage must not kill the watch
-            logger.warning("brain event write failed: %s", e)
-            self._reported.pop(rec.name, None)  # retry on next sight
+            # the de-dup entry STAYS (the incident is accounted for);
+            # the write itself queues for retry independent of any
+            # future sighting — a DELETED pod never re-appears
+            logger.warning(
+                "brain event write failed (queued for retry): %s", e
+            )
+            self._pending.append((host, kind, job))
             return None
         logger.info(
             "cluster incident: host=%s kind=%s job=%s pod=%s",
@@ -116,59 +122,66 @@ class ClusterMonitor:
         )
         return host, kind
 
+    def _flush_pending(self) -> None:
+        """Retry queued incident writes, rate-limited to one attempt
+        burst per poll interval so a down Brain is not hammered per
+        stream event."""
+        if not self._pending:
+            return
+        now = time.monotonic()
+        if now - self._last_flush < self._poll:
+            return
+        self._last_flush = now
+        still = []
+        for host, kind, job in self._pending:
+            try:
+                self._brain.report_node_event(host, kind, job_name=job)
+                logger.info(
+                    "cluster incident (retried): host=%s kind=%s "
+                    "job=%s", host, kind, job,
+                )
+            except Exception:
+                still.append((host, kind, job))
+        self._pending = still
+
     # ------------------------------------------------------------- loop
 
+    def _sync(self, records) -> None:
+        """Handle a full listing: report new incidents, prune de-dup
+        entries of pods gone from the listing (they can never replay
+        their terminal state; keeping them would pin memory and
+        swallow a recreated same-name pod's identical failure)."""
+        names = set()
+        for rec in records:
+            names.add(rec.name)
+            self._handle(rec)
+        for name in set(self._reported) - names:
+            self._reported.pop(name, None)
+
     def run_forever(self):
-        """List + watch, resuming like the per-job watcher (bookmarks,
-        410 re-list with the reported-baseline kept, fast-fail
-        backoff). Polling fallback for watch-less backends."""
+        """List + watch via the shared resume driver
+        (scheduler/gke.py iter_pod_stream: bookmarks, 410 re-list with
+        the baseline kept, fast-fail backoff); polling fallback for
+        watch-less backends. Failed Brain writes flush each round."""
         if not self._api.supports_watch():
             while not self._stopped.is_set():
-                names = set()
-                for rec in self._api.list_pods():
-                    names.add(rec.name)
-                    self._handle(rec)
-                # prune like the watch branch: a deleted pod's de-dup
-                # entry would otherwise pin memory forever AND swallow
-                # a recreated same-name pod's identical failure
-                for name in set(self._reported) - names:
-                    self._reported.pop(name, None)
+                self._sync(self._api.list_pods())
+                self._flush_pending()
                 self._stopped.wait(self._poll)
             return
-        while not self._stopped.is_set():
-            records, version = self._api.list_pods_with_version()
-            if not version:
-                self._stopped.wait(self._poll)
-                continue
-            names = set()
-            for rec in records:
-                names.add(rec.name)
-                self._handle(rec)
-            # pods gone from the listing can never replay their
-            # terminal state: drop their de-dup entries
-            for name in set(self._reported) - names:
-                self._reported.pop(name, None)
-            watch_started = time.monotonic()
-            try:
-                for etype, payload in self._api.watch_pods(
-                    version, timeout_seconds=self._watch_timeout
-                ):
-                    if self._stopped.is_set():
-                        return
-                    if etype == "BOOKMARK":
-                        version = payload or version
-                        continue
-                    rec = payload
-                    version = rec.get("resource_version") or version
-                    if etype == "DELETED":
-                        self._handle(rec)  # final state rides the event
-                        self._reported.pop(rec.name, None)
-                        continue
-                    self._handle(rec)
-                if time.monotonic() - watch_started < 1.0:
-                    self._stopped.wait(self._poll)
-            except StaleResourceVersion:
-                logger.info("cluster watch bookmark expired; re-listing")
+        from dlrover_tpu.scheduler.gke import iter_pod_stream
+
+        for etype, payload in iter_pod_stream(
+            self._api, self._stopped, self._poll, self._watch_timeout
+        ):
+            if etype == "SYNC":
+                self._sync(payload)
+            elif etype == "DELETED":
+                self._handle(payload)  # final state rides the event
+                self._reported.pop(payload.name, None)
+            else:
+                self._handle(payload)
+            self._flush_pending()
 
     def start(self):
         self._thread = threading.Thread(

@@ -76,10 +76,14 @@ def run(args) -> int:
         # the port must be fixed before the platform is built. Probing a
         # free port then binding is racy, so retry on bind failure.
         from dlrover_tpu.brain.client import build_brain_client
+        from dlrover_tpu.scheduler.factory import fetch_avoid_hosts
 
         brain_client = build_brain_client(
             job_args.brain_addr, job_args.brain_store_path
         )
+        # once, OUTSIDE the bind-retry loop: an unreachable Brain
+        # must not stall every retry for the client's full timeout
+        avoid_hosts = fetch_avoid_hosts(brain_client)
         master = None
         for attempt in range(3):
             port = args.port or find_free_port()
@@ -87,6 +91,7 @@ def run(args) -> int:
                 job_args,
                 f"{_master_host(args, job_args.platform)}:{port}",
                 brain_client=brain_client,
+                avoid_hosts=avoid_hosts,
             )
             try:
                 master = DistributedJobMaster(

@@ -114,7 +114,8 @@ def bubble_fraction(pp: int, num_micro: int, num_chunks: int = 1) -> float:
 
 
 def _interleaved_local(params, x_mb, *, block_fn, axis_name, pp,
-                       num_micro, num_chunks, compute_dtype):
+                       num_micro, num_chunks, compute_dtype,
+                       count_work=False):
     """Per-device circular-pipeline schedule (runs under shard_map).
 
     params: this device's [V, K_local_layers, ...] chunk stack — chunk v
@@ -144,6 +145,7 @@ def _interleaved_local(params, x_mb, *, block_fn, axis_name, pp,
     cur = jnp.zeros(m_shape, x_mb.dtype)
     ybuf = jnp.zeros_like(x_mb)
     aux_total = jnp.zeros((), jnp.float32)
+    work_done = jnp.zeros((), jnp.float32)
     n_ticks = num_micro * num_chunks + pp - 1
 
     for t in range(n_ticks):
@@ -166,6 +168,7 @@ def _interleaved_local(params, x_mb, *, block_fn, axis_name, pp,
         )
         y, aux = _stage_body(chunk_params, inp, block_fn=block_fn)
         aux_total = aux_total + jnp.where(valid, aux, 0.0)
+        work_done = work_done + jnp.where(valid, 1.0, 0.0)
         # device P-1 finishing chunk V-1 emits the final output
         emit = jnp.logical_and(
             jnp.logical_and(stage == pp - 1, v == num_chunks - 1),
@@ -191,6 +194,13 @@ def _interleaved_local(params, x_mb, *, block_fn, axis_name, pp,
         ybuf.astype(psum_dtype) * mask, axis_name
     ).astype(x_mb.dtype)
     aux_total = jax.lax.psum(aux_total, axis_name) / num_micro
+    if count_work:
+        # executed-schedule occupancy: total valid work items across
+        # the ring vs pp*n_ticks device-tick slots — the MEASURED
+        # bubble the dryrun asserts against bubble_fraction()'s
+        # prediction (it counts what this compiled program actually
+        # issued, not the closed form)
+        return ybuf, aux_total, jax.lax.psum(work_done, axis_name)
     return ybuf, aux_total
 
 
@@ -202,13 +212,18 @@ def interleaved_pipeline_apply(
     num_microbatches: int,
     num_chunks: int = 2,
     axis_name: str = PIPE_AXIS,
-) -> Tuple[jax.Array, jax.Array]:
+    schedule_stats: bool = False,
+) -> Tuple[jax.Array, ...]:
     """Circular/interleaved pipeline over ``axis_name`` with
     ``num_chunks`` virtual stages per device (parity role: Megatron/
     PiPPy interleaved 1F1B, ref distributed_pippy_compiler.py — bubble
     cut by the virtual-stage factor).
 
-    Returns (output [batch, ...], aux scalar)."""
+    Returns (output [batch, ...], aux scalar); with
+    ``schedule_stats=True`` additionally a dict with the executed
+    schedule's measured occupancy (``bubble_measured`` = idle
+    device-tick slots / all slots) for validation against
+    :func:`bubble_fraction`."""
     pp = mesh.shape.get(axis_name, 1)
     if num_chunks < 1:
         raise ValueError("num_chunks >= 1")
@@ -249,11 +264,11 @@ def interleaved_pipeline_apply(
         functools.partial(
             _interleaved_local, block_fn=block_fn, axis_name=axis_name,
             pp=pp, num_micro=num_microbatches, num_chunks=num_chunks,
-            compute_dtype=x_mb.dtype,
+            compute_dtype=x_mb.dtype, count_work=schedule_stats,
         ),
         mesh=mesh,
         in_specs=(params_spec, P()),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P()) if schedule_stats else (P(), P()),
         # only pipe is manual: data/tensor axes of a combined 3D mesh
         # stay GSPMD-automatic, so TP/DP collectives are still inserted
         # by XLA inside each stage (PP x TP x DP composition)
@@ -262,6 +277,18 @@ def interleaved_pipeline_apply(
     )
     if _cpu_needs_f32_boundary():
         x_mb = x_mb.astype(jnp.float32)
+    if schedule_stats:
+        y_mb, aux, work = fn(chunked, x_mb)
+        n_ticks = num_microbatches * num_chunks + pp - 1
+        slots = pp * n_ticks
+        stats = {
+            "ticks": n_ticks,
+            "slots_total": slots,
+            # jnp values so the stats path stays jit-traceable
+            "work_slots_used": work,
+            "bubble_measured": 1.0 - work / slots,
+        }
+        return y_mb.reshape(x.shape), aux, stats
     y_mb, aux = fn(chunked, x_mb)
     return y_mb.reshape(x.shape), aux
 
@@ -316,11 +343,15 @@ def gpipe_apply(
 def pipeline_llama_forward(
     params, tokens, cfg, mesh: Mesh, num_microbatches: int = 4,
     attn_fn=None, return_aux: bool = False, num_chunks: int = 1,
+    schedule_stats: bool = False,
 ):
     """Llama forward with the block stack pipelined over the pipe axis.
 
     ``num_chunks > 1`` switches from GPipe to the circular/interleaved
     schedule (V virtual stages per device, bubble cut by V).
+    ``schedule_stats=True`` (interleaved only) returns
+    ``(logits, aux, stats)`` with the executed schedule's measured
+    occupancy — see :func:`interleaved_pipeline_apply`.
 
     Embed / final-norm / lm_head stay outside the pipeline (they live on
     every stage; XLA shards them by the surrounding jit's rules)."""
@@ -354,17 +385,26 @@ def pipeline_llama_forward(
     elif cfg.remat != "off":
         raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
+    stats = None
     if num_chunks > 1:
-        x, aux = interleaved_pipeline_apply(
+        out = interleaved_pipeline_apply(
             block_fn, params["blocks"], x, mesh, num_microbatches,
-            num_chunks=num_chunks,
+            num_chunks=num_chunks, schedule_stats=schedule_stats,
         )
+        if schedule_stats:
+            x, aux, stats = out
+        else:
+            x, aux = out
     else:
+        if schedule_stats:
+            raise ValueError("schedule_stats needs num_chunks > 1")
         x, aux = gpipe_apply(
             block_fn, params["blocks"], x, mesh, num_microbatches
         )
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if stats is not None:
+        return logits, aux, stats
     if return_aux:
         return logits, aux
     return logits

@@ -20,11 +20,38 @@ from dlrover_tpu.master.scaler.base_scaler import Scaler
 from dlrover_tpu.master.watcher.base_watcher import NodeWatcher
 
 
+def fetch_avoid_hosts(brain_client) -> Optional[list]:
+    """The Brain's current host blacklist, or None when unavailable.
+    Callers that rebuild the platform (master/main.py's port-bind
+    retry loop) fetch ONCE and pass ``avoid_hosts`` through — the
+    list cannot change between attempts and an unreachable Brain
+    would otherwise stall every retry for the client's full timeout."""
+    if brain_client is None:
+        return None
+    try:
+        return list(brain_client.get_node_blacklist())
+    except Exception as e:
+        logger.warning("brain blacklist unavailable: %s", e)
+        return None
+
+
 def build_platform(
-    job_args, master_addr: str, brain_client=None
+    job_args, master_addr: str, brain_client=None,
+    avoid_hosts: Optional[list] = None,
 ) -> Tuple[Optional[Scaler], Optional[NodeWatcher]]:
     platform = getattr(job_args, "platform", "local")
     job_name = getattr(job_args, "job_name", "job")
+    if avoid_hosts is None:
+        avoid_hosts = fetch_avoid_hosts(brain_client)
+    if avoid_hosts and platform not in ("gke",):
+        # pod anti-affinity is the gke backend's mechanism; other
+        # platforms get fresh machines from their fleet API — say so
+        # instead of silently ignoring a configured blacklist
+        logger.info(
+            "brain blacklist %s: placement avoidance is gke-only; "
+            "platform %r allocates fresh machines", avoid_hosts,
+            platform,
+        )
     if platform == "tpu_vm":
         from dlrover_tpu.scheduler.tpu_vm import (
             FakeTpuVmApi,
@@ -73,21 +100,16 @@ def build_platform(
                 job_name=job_name,
                 image=getattr(res, "image", "") if res else "",
             )
-        if brain_client is not None:
+        if avoid_hosts:
             # cross-job node-health learning, closed loop: incidents
             # recorded by job masters AND the standalone cluster
             # monitor (brain/monitor.py) keep repeat-offender hosts
             # out of this job's pod placement (required anti-affinity
             # in RestK8sApi._pod_manifest)
-            try:
-                bad = brain_client.get_node_blacklist()
-                if bad:
-                    logger.info(
-                        "brain blacklist: scheduling around %s", bad
-                    )
-                    api.set_avoid_hosts(bad)
-            except Exception as e:
-                logger.warning("brain blacklist unavailable: %s", e)
+            logger.info(
+                "brain blacklist: scheduling around %s", avoid_hosts
+            )
+            api.set_avoid_hosts(avoid_hosts)
         scaler = GkePodScaler(
             job_name, api, master_addr,
             worker_env=dict(getattr(job_args, "worker_env", {}) or {}),

@@ -641,6 +641,47 @@ def pod_to_node(rec: PodRecord) -> Optional[Node]:
     return node
 
 
+def iter_pod_stream(api: K8sApi, stopped: threading.Event,
+                    poll_interval: float = 5.0,
+                    watch_timeout: int = 300):
+    """Shared list+watch resume driver (the subtle half of both the
+    per-job watcher and the cluster monitor): yields
+
+      ("SYNC", [PodRecord])   after every successful (re-)list — the
+                              consumer diffs/prunes against it
+      (etype, PodRecord)      per ADDED/MODIFIED/DELETED stream event
+
+    and internally owns the invariants: a FAILED list (empty version)
+    is backed off, never yielded (an empty SYNC would read as mass
+    deletion); the bookmark advances per event; a stream that dies in
+    under a second backs off before re-listing (watch verb rejected —
+    RBAC, proxy without chunking); 410 Gone re-lists WITHOUT telling
+    the consumer to reset its baseline (the next SYNC's diff surfaces
+    deletions from the gap)."""
+    while not stopped.is_set():
+        records, version = api.list_pods_with_version()
+        if not version:
+            stopped.wait(poll_interval)
+            continue
+        yield "SYNC", records
+        watch_started = time.monotonic()
+        try:
+            for etype, payload in api.watch_pods(
+                version, timeout_seconds=watch_timeout
+            ):
+                if stopped.is_set():
+                    return
+                if etype == "BOOKMARK":
+                    version = payload or version
+                    continue
+                version = payload.get("resource_version") or version
+                yield etype, payload
+            if time.monotonic() - watch_started < 1.0:
+                stopped.wait(poll_interval)
+        except StaleResourceVersion:
+            logger.info("watch bookmark expired; re-listing")
+
+
 class GkePodWatcher(NodeWatcher):
     """Pod-fleet watcher (parity: PodWatcher, k8s_watcher.py:139-152).
 
@@ -703,83 +744,53 @@ class GkePodWatcher(NodeWatcher):
             self._stopped.wait(self._poll)
 
     def _watch_stream(self) -> Iterator[NodeEvent]:
-        while not self._stopped.is_set():
-            # (re-)list: sync state, emit missed transitions as diff
-            # events, and take the watch bookmark
-            records, version = self._api.list_pods_with_version()
-            if not version:
-                # list FAILED (empty version is the failure signal):
-                # do NOT diff — an empty result against known state
-                # would read as the whole fleet deleted. Back off and
-                # re-list; self._last stays authoritative.
-                self._stopped.wait(self._poll)
-                continue
-            seen: Dict[str, str] = {}
-            for rec in records:
-                if rec.get("labels", {}).get(
-                    "dlrover-job"
-                ) != self._job_name:
-                    continue
-                fp = self._fingerprint(rec)
-                seen[rec.name] = fp
-                if self._last.get(rec.name) != fp:
-                    node = pod_to_node(rec)
-                    if node is not None:
-                        yield NodeEvent(NodeEventType.MODIFIED, node)
-            for name in set(self._last) - set(seen):
-                gone = self._deleted_node(name)
-                if gone is not None:
-                    yield NodeEvent(NodeEventType.DELETED, gone)
-            self._last = seen
-            watch_started = time.monotonic()
-            try:
-                for etype, payload in self._api.watch_pods(
-                    version, timeout_seconds=self._watch_timeout
-                ):
-                    if self._stopped.is_set():
-                        return
-                    if etype == "BOOKMARK":
-                        version = payload or version
-                        continue
-                    rec = payload
-                    version = rec.get("resource_version") or version
+        # resume/backoff/bookmark invariants live in iter_pod_stream;
+        # only the per-job diffing is this watcher's
+        for etype, payload in iter_pod_stream(
+            self._api, self._stopped, self._poll, self._watch_timeout
+        ):
+            if etype == "SYNC":
+                seen: Dict[str, str] = {}
+                for rec in payload:
                     if rec.get("labels", {}).get(
                         "dlrover-job"
                     ) != self._job_name:
                         continue
-                    if etype == "DELETED":
-                        self._last.pop(rec.name, None)
+                    fp = self._fingerprint(rec)
+                    seen[rec.name] = fp
+                    if self._last.get(rec.name) != fp:
                         node = pod_to_node(rec)
                         if node is not None:
-                            node.status = NodeStatus.DELETED
                             yield NodeEvent(
-                                NodeEventType.DELETED, node
+                                NodeEventType.MODIFIED, node
                             )
-                        continue
-                    fp = self._fingerprint(rec)
-                    if self._last.get(rec.name) == fp:
-                        continue
-                    self._last[rec.name] = fp
-                    node = pod_to_node(rec)
-                    if node is not None:
-                        yield NodeEvent(NodeEventType.MODIFIED, node)
-                # stream ended normally (server timeout): resume via
-                # a fresh WATCH from the advanced bookmark — the loop's
-                # re-list keeps state exact even if events were missed.
-                # A stream that died FAST (watch verb rejected — RBAC,
-                # proxy without chunking) must not tight-loop full-fleet
-                # LISTs against the apiserver: back off first
-                if time.monotonic() - watch_started < 1.0:
-                    self._stopped.wait(self._poll)
-            except StaleResourceVersion:
-                # keep self._last: the re-list diff emits MODIFIED for
-                # changes and DELETED for pods that vanished during the
-                # gap — wiping the baseline would swallow exactly those
-                # DELETED events
-                logger.info(
-                    "watch bookmark expired; re-listing %s",
-                    self._job_name,
-                )
+                # the diff against the KEPT baseline surfaces pods
+                # that vanished while the watch was down (410 gap)
+                for name in set(self._last) - set(seen):
+                    gone = self._deleted_node(name)
+                    if gone is not None:
+                        yield NodeEvent(NodeEventType.DELETED, gone)
+                self._last = seen
+                continue
+            rec = payload
+            if rec.get("labels", {}).get(
+                "dlrover-job"
+            ) != self._job_name:
+                continue
+            if etype == "DELETED":
+                self._last.pop(rec.name, None)
+                node = pod_to_node(rec)
+                if node is not None:
+                    node.status = NodeStatus.DELETED
+                    yield NodeEvent(NodeEventType.DELETED, node)
+                continue
+            fp = self._fingerprint(rec)
+            if self._last.get(rec.name) == fp:
+                continue
+            self._last[rec.name] = fp
+            node = pod_to_node(rec)
+            if node is not None:
+                yield NodeEvent(NodeEventType.MODIFIED, node)
 
     def _deleted_node(self, name: str) -> Optional[Node]:
         parts = name.rsplit("-", 2)

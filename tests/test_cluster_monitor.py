@@ -197,9 +197,11 @@ def test_manifest_carries_required_anti_affinity(stub):
     )["spec"]
 
 
-def test_brain_outage_retries_on_next_sight(stub):
-    """A failed Brain write must not permanently swallow the incident:
-    the de-dup entry is dropped so the next sighting retries."""
+def test_brain_outage_queues_write_even_for_vanished_pods(stub):
+    """A failed Brain write must not permanently swallow the incident —
+    even when the pod is GONE by retry time (its terminal state rode a
+    DELETED event): the write queues and flushes independent of any
+    future sighting."""
 
     class FlakyBrain:
         def __init__(self):
@@ -213,12 +215,21 @@ def test_brain_outage_retries_on_next_sight(stub):
             self.events.append((host, kind, job_name))
 
     flaky = FlakyBrain()
-    monitor = ClusterMonitor(_api(stub), flaky)
+    monitor = ClusterMonitor(_api(stub), flaky, poll_interval=0.0)
     rec = _record(
         name="w0", phase="Failed", exit_code=1,
         host_name="host-1", labels={"dlrover-job": "j"},
     )
-    assert monitor._handle(rec) is None  # write failed
-    assert monitor._handle(rec) == ("host-1", "failure")  # retried
-    assert monitor._handle(rec) is None  # now de-duped
+    assert monitor._handle(rec) is None  # write failed -> queued
+    assert monitor._pending == [("host-1", "failure", "j")]
+    # the pod vanishes (DELETED path drops its de-dup entry) — the
+    # queued write must survive that
+    monitor._reported.pop("w0", None)
+    monitor._flush_pending()
+    assert monitor._pending == []
+    assert flaky.events == [("host-1", "failure", "j")]
+    # and a replay of the same terminal state while the de-dup entry
+    # lives does not double-report
+    monitor._reported["w0"] = "failure/1/None"
+    assert monitor._handle(rec) is None
     assert flaky.events == [("host-1", "failure", "j")]

@@ -216,3 +216,28 @@ def test_pipeline_training_learns():
         loss, params, opt_state = step(params, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_measured_bubble_matches_prediction():
+    """The EXECUTED schedule's occupancy (valid work items counted
+    inside the compiled program, psum'd over the ring) must equal
+    bubble_fraction()'s closed form — the dryrun pp=4 leg's
+    load-bearing assertion (VERDICT r4 item #7)."""
+    cfg = llama.llama_tiny(num_layers=8, remat="off")
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0,
+                                cfg.vocab_size)
+    for pp, chunks, micro in ((4, 2, 4), (2, 2, 4)):
+        mesh = create_mesh([("pipe", pp)], devices=jax.devices()[:pp])
+        logits, _aux, stats = pipeline_llama_forward(
+            params, tokens, cfg, mesh, num_microbatches=micro,
+            num_chunks=chunks, schedule_stats=True,
+        )
+        assert np.isfinite(np.asarray(logits)).all()
+        predicted = bubble_fraction(pp, micro, chunks)
+        assert float(stats["bubble_measured"]) == pytest.approx(
+            predicted, abs=1e-6  # f32 division rounding only
+        ), (pp, chunks, micro)
+        # the underlying count is EXACT: every scheduled work item
+        # executed exactly once
+        assert float(stats["work_slots_used"]) == micro * chunks * pp
