@@ -1,0 +1,200 @@
+"""Long-context artifact -> LONGCTX_r05.json (VERDICT r4 Weak #3/#4).
+
+Three sections:
+  --envelope   on the real chip: the single-chip points (batch x seq
+               at constant 8192 tokens/step), re-measured this round —
+               the measured basis of strategy.SINGLE_CHIP_MAX_SEQ.
+  --sp16k      on the 8-device CPU mesh: seq 16384 EXECUTES end to end
+               (ring-attention train step at reduced width), with the
+               compiled step's XLA memory accounting — the execution
+               evidence behind the "16k is SP's job" claim.
+  --project    the on-chip SP point this implies: the analyser's step
+               model for the auto-chosen 16k strategy over 8 v5e
+               chips, at the MFU measured at the 8k envelope point
+               (conservative: SP adds ring ppermute traffic the model
+               charges as exposed).
+
+Run all three (sp16k + project always run; --envelope needs the chip):
+  python benchmarks/longctx.py --envelope --out LONGCTX_r05.json
+Parity: atorch distributed_attention.py:21,79 (the reference's
+sequence-parallel long-context path).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: (batch, seq) at a constant 8192 tokens/step — the envelope frontier
+ENVELOPE_POINTS = ((4, 2048), (2, 4096), (1, 8192))
+
+
+def measure_envelope() -> list:
+    """Each point in its own subprocess (co-resident compiled programs
+    OOM the 15.75 GB chip even when each alone fits)."""
+    points = []
+    for batch, seq in ENVELOPE_POINTS:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "sweep_single_chip.py"),
+             "--batch", str(batch), "--seq", str(seq),
+             "--remat", "dots", "--steps", "10", "--warmup", "2"],
+            capture_output=True, text=True, timeout=1800, cwd=REPO,
+        )
+        if proc.returncode != 0:
+            points.append({"batch": batch, "seq": seq,
+                           "error": proc.stderr[-500:]})
+            continue
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        points.append({
+            "batch": batch, "seq": seq,
+            "step_ms": line["step_ms"],
+            "tokens_per_sec": line["tok_s"],
+            "mfu_percent": line["mfu"],
+        })
+    return points
+
+
+def measure_sp16k() -> dict:
+    """Ring-attention train step at seq 16384 on the 8-device CPU mesh
+    (reduced width — CPU flops, not HBM, are the constraint here)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.mesh import create_mesh
+    from dlrover_tpu.trainer.sharded import make_trainer_for_llama
+
+    cfg = llama.llama_tiny(
+        num_layers=1, hidden_size=32, intermediate_size=64,
+        num_heads=2, num_kv_heads=2, max_seq_len=16384, remat="off",
+    )
+    mesh = create_mesh([("seq", 8)])
+    trainer = make_trainer_for_llama(
+        cfg, mesh, strategy="sequence", optimizer=optax.adam(1e-2)
+    )
+    params, opt_state = trainer.init(jax.random.key(0))
+    tokens = jax.numpy.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 16384)),
+        dtype=jax.numpy.int32,
+    )
+    mb = trainer.shard_batch(trainer.microbatch((tokens, tokens)))
+    lowered = trainer.train_step.lower(params, opt_state, mb)
+    compiled = lowered.compile()
+    analysis = compiled.memory_analysis()
+    t0 = time.time()
+    params, opt_state, loss = compiled(params, opt_state, mb)
+    loss0 = float(loss)
+    t_step = time.time() - t0
+    return {
+        "what": (
+            "seq-16384 ring-attention train step, 8-device CPU mesh "
+            "(seq axis 8, 2048 tokens/device), reduced width; "
+            "correctness vs dense at this length is "
+            "tests/test_context_parallel.py::"
+            "test_ring_attention_16k_matches_dense"
+        ),
+        "loss": round(loss0, 4),
+        "step_seconds_cpu": round(t_step, 1),
+        "xla_temp_bytes_per_device": getattr(
+            analysis, "temp_size_in_bytes", None
+        ),
+        "xla_argument_bytes_per_device": getattr(
+            analysis, "argument_size_in_bytes", None
+        ),
+    }
+
+
+def project_sp_on_chip() -> dict:
+    """The analyser's on-chip projection for the strategy
+    auto_accelerate CHOOSES at 16k (tests/test_auto.py asserts the
+    choice), at the 8k envelope point's measured MFU."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    from dlrover_tpu.auto.accelerate import auto_accelerate
+    from dlrover_tpu.auto.analyser import (
+        ModelProfile,
+        estimate_memory,
+        estimate_step_time,
+    )
+    from dlrover_tpu.models import llama
+
+    cfg = llama.llama_1b()
+    res = auto_accelerate(
+        cfg, global_batch=8, seq_len=16384, hbm_bytes=15.75e9,
+        dryrun_top_k=0,
+    )
+    s = res.strategy
+    profile = ModelProfile.from_config(cfg, 16384)
+    mfu_8k = 0.477  # the measured 8k envelope point (r4/r5 artifact)
+    t = estimate_step_time(profile, s, 8, 16384, mfu=mfu_8k)
+    mem = estimate_memory(profile, s, 8, 16384)
+    return {
+        "what": (
+            "projected 8-chip v5e SP point for the auto-chosen 16k "
+            "strategy, at the MFU measured at the single-chip 8k "
+            "envelope point (conservative: ring ppermute traffic is "
+            "charged exposed)"
+        ),
+        "strategy": {
+            "mesh": dict(s.mesh_spec), "sharding": s.sharding,
+            "context_parallel": s.context_parallel, "remat": s.remat,
+        },
+        "global_batch": 8, "seq": 16384,
+        "projected_step_seconds": round(t, 2),
+        "projected_tokens_per_sec": round(8 * 16384 / t, 0),
+        "estimated_hbm_gb_per_chip": round(mem.total / 1e9, 1),
+        "mfu_prior_from_8k_point": mfu_8k,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--envelope", action="store_true",
+                    help="measure the single-chip points (needs TPU)")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "LONGCTX_r05.json"
+    ))
+    args = ap.parse_args(argv)
+
+    # causal ring ranks are work-imbalanced; XLA CPU's 40s collective
+    # terminator kills the slow ranks' wait — set before backend init
+    from dlrover_tpu.common.xla_flags import (
+        ensure_cpu_collective_timeout,
+    )
+
+    ensure_cpu_collective_timeout()
+
+    doc = {
+        "what": (
+            "long-context story, round 5: measured single-chip "
+            "envelope (the basis of the auto layer's "
+            "SINGLE_CHIP_MAX_SEQ gate), seq-16384 EXECUTED via "
+            "sequence parallelism on the 8-device mesh, and the "
+            "projected on-chip SP point for the auto-chosen strategy"
+        ),
+    }
+    if args.envelope:
+        doc["envelope_single_chip"] = measure_envelope()
+    # subprocesses for isolation: each section re-configures jax
+    doc["sp_16k_cpu_mesh"] = measure_sp16k()
+    doc["sp_16k_projection"] = project_sp_on_chip()
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

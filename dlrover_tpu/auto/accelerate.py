@@ -26,7 +26,12 @@ from dlrover_tpu.auto.analyser import (
     estimate_memory,
     estimate_step_time,
 )
-from dlrover_tpu.auto.strategy import Strategy, enumerate_strategies
+from dlrover_tpu.auto.strategy import (
+    SINGLE_CHIP_MAX_SEQ,
+    Strategy,
+    enumerate_strategies,
+    envelope_max_seq,
+)
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.parallel.mesh import create_mesh
 
@@ -198,6 +203,12 @@ def auto_accelerate(
     hbm = hbm_bytes or _device_hbm_bytes(devices[0])
     candidates = strategies or enumerate_strategies(
         len(devices), global_batch,
+        # past the measured single-chip envelope (LONGCTX artifact,
+        # strategy.SINGLE_CHIP_MAX_SEQ) no per-chip layout can hold
+        # the sequence — sequence-parallel candidates join the search
+        # and the analytic memory model (which divides activation
+        # tokens by the seq axis) does the rest
+        context_lengths_long=seq_len > SINGLE_CHIP_MAX_SEQ,
         num_experts=getattr(cfg, "num_experts", 0),
     )
     if not hasattr(cfg, "remat") and not strategies:
@@ -242,6 +253,17 @@ def auto_accelerate(
                 seen.add(key)
                 extra.append(cand)
         candidates = list(candidates) + extra
+    # measured-envelope cap (strategy.envelope_max_seq): attention
+    # models only — recommender towers have no seq-quadratic
+    # residuals. Auto-enumerated candidates only: an EXPLICIT
+    # strategies= list is the user's to rank as given (gating it
+    # would silently collapse their dryrun comparison to one
+    # fallback candidate)
+    seq_cap = (
+        envelope_max_seq(profile.hidden_size, profile.num_layers)
+        if getattr(cfg, "num_heads", 0) and strategies is None
+        else float("inf")
+    )
     reports: List[CandidateReport] = []
     for s in candidates:
         if s.num_devices != len(devices):
@@ -250,8 +272,10 @@ def auto_accelerate(
         t = estimate_step_time(
             profile, s, global_batch, seq_len, mfu=mfu_guess,
         )
+        per_chip_seq = seq_len / max(s.axis("seq"), 1)
         reports.append(CandidateReport(
-            s, mem.total, t, fits=mem.total < 0.9 * hbm,
+            s, mem.total, t,
+            fits=(mem.total < 0.9 * hbm and per_chip_seq <= seq_cap),
         ))
     fitting = [r for r in reports if r.fits]
     if not fitting:

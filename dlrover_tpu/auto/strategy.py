@@ -18,6 +18,33 @@ from typing import List, Optional, Tuple
 REMAT_POLICIES = ("off", "dots", "dots_attn_out", "minimal")
 PRECISIONS = ("bf16", "fp32")
 
+#: longest sequence the flagship fits on ONE chip (measured envelope,
+#: LONGCTX_r04/r05.json: batch 1 x seq 8192 trains at 47.7% MFU on the
+#: 15.75 GB v5e; 16384 does not fit with params+adam+dots-remat
+#: activations). Past this, sequence-parallel candidates enter the
+#: search — the auto layer's gate for choosing ring/Ulysses attention.
+SINGLE_CHIP_MAX_SEQ = 8192
+#: the flagship's per-token activation-cost proxy (hidden x layers of
+#: llama_1b, the model the envelope was MEASURED on) — smaller models
+#: extrapolate to proportionally longer single-chip sequences
+_ENVELOPE_ACT_PROXY = 2048 * 22
+
+
+def envelope_max_seq(hidden_size: int, num_layers: int) -> float:
+    """Measured-envelope cap on the UNSHARDED per-chip sequence.
+
+    Analytic activation models are optimistic at long sequence (the
+    attention residual terms they fold into one per-token constant
+    grow with seq); the measured envelope is ground truth for the
+    flagship and extrapolates inversely with the per-token activation
+    cost. Candidates leaving the sequence unsharded past this cap are
+    unfit regardless of the analytic estimate — that is what pulls
+    sequence-parallel candidates to the top at 16k."""
+    proxy = max(1, hidden_size * num_layers)
+    return SINGLE_CHIP_MAX_SEQ * max(
+        1.0, _ENVELOPE_ACT_PROXY / proxy
+    )
+
 
 @dataclasses.dataclass(frozen=True)
 class Strategy:
@@ -113,17 +140,24 @@ def enumerate_strategies(
                         remat=remat,
                     ))
     if context_lengths_long:
+        # sequence_rules = tp_fsdp + seq: the fsdp factor shards
+        # params/opt (a replicated flagship + Adam would not fit a
+        # chip), the seq factor shards the context for ring attention
         for sp in _divisors(num_devices):
             if sp == 1:
                 continue
-            data = num_devices // sp
-            if global_batch % max(data, 1):
-                continue
-            out.append(Strategy(
-                mesh_spec=(("data", data), ("seq", sp)),
-                sharding="sequence", remat="dots",
-                context_parallel="ring",
-            ))
+            rest = num_devices // sp
+            for fsdp in _divisors(rest):
+                data = rest // fsdp
+                if global_batch % max(data * fsdp, 1):
+                    continue
+                out.append(Strategy(
+                    mesh_spec=(
+                        ("data", data), ("fsdp", fsdp), ("seq", sp)
+                    ),
+                    sharding="sequence", remat="dots",
+                    context_parallel="ring",
+                ))
     if num_experts > 1:
         for ep in _divisors(min(num_devices, num_experts)):
             if ep == 1:

@@ -1,0 +1,25 @@
+"""XLA flag helpers importable BEFORE jax (env-only, no jax import).
+
+One home for flag snippets every CPU-mesh entry point needs, so a
+tuning change cannot silently miss one of them.
+"""
+
+import os
+
+
+def ensure_cpu_collective_timeout(seconds: int = 900) -> None:
+    """Raise XLA CPU's collective terminator (default kills at 40s).
+
+    Causal ring attention's ranks are inherently work-imbalanced (the
+    last seq shard does sp x the first's chunk work); on the virtual
+    CPU test mesh the slow ranks arrive late enough to trip the
+    terminator at long sequence. Host-emulation artifact only — TPU is
+    unaffected. Must run before the CPU backend initializes."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "collective_call_terminate" in flags:
+        return
+    os.environ["XLA_FLAGS"] = (
+        flags
+        + f" --xla_cpu_collective_call_terminate_timeout_seconds"
+          f"={seconds}"
+    ).strip()

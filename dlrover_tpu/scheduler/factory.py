@@ -21,18 +21,21 @@ from dlrover_tpu.master.watcher.base_watcher import NodeWatcher
 
 
 def fetch_avoid_hosts(brain_client) -> Optional[list]:
-    """The Brain's current host blacklist, or None when unavailable.
-    Callers that rebuild the platform (master/main.py's port-bind
-    retry loop) fetch ONCE and pass ``avoid_hosts`` through — the
-    list cannot change between attempts and an unreachable Brain
-    would otherwise stall every retry for the client's full timeout."""
+    """The Brain's current host blacklist; [] when the Brain is
+    configured but unreachable (so a caller-passed result is always
+    distinguishable from "never fetched" = None); None only without a
+    brain client. Callers that rebuild the platform (master/main.py's
+    port-bind retry loop) fetch ONCE and pass ``avoid_hosts`` through
+    — the list cannot change between attempts and an unreachable
+    Brain would otherwise stall every retry for the client's full
+    timeout."""
     if brain_client is None:
         return None
     try:
         return list(brain_client.get_node_blacklist())
     except Exception as e:
         logger.warning("brain blacklist unavailable: %s", e)
-        return None
+        return []
 
 
 def build_platform(

@@ -224,6 +224,15 @@ def make_trainer_for_llama(
 
     if mesh is None:
         mesh = create_mesh([(shd.DATA_AXIS, 1), (shd.FSDP_AXIS, -1)])
+    if attn_fn is None and strategy == "sequence":
+        # the sequence strategy's entire point: without ring attention
+        # GSPMD gathers K/V and materializes the [seq, seq] scores —
+        # at 16k that is a silent gigabyte-scale dense fallback
+        from dlrover_tpu.parallel.context_parallel import (
+            make_context_parallel_attn,
+        )
+
+        attn_fn = make_context_parallel_attn(mesh, kind="ring")
     loss = lambda params, batch: llama.next_token_loss(  # noqa: E731
         params, batch, cfg, attn_fn=attn_fn
     )

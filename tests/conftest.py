@@ -15,6 +15,11 @@ os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")
 # subprocesses spawned by tests (agents, probes) must also land on CPU
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+# XLA CPU kills a collective when participants arrive >40s apart;
+# causal ring attention at 16k trips it (see common/xla_flags.py)
+from dlrover_tpu.common.xla_flags import ensure_cpu_collective_timeout
+
+ensure_cpu_collective_timeout()
 
 import jax
 
