@@ -105,7 +105,12 @@ class DistributedJobMaster:
                 RendezvousName.NETWORK_CHECK
             ].get_straggler_nodes,
             min_nodes=getattr(job_args, "min_node_num", 0) or 0,
-            max_nodes=getattr(job_args, "node_num", 0) or 0,
+            # the elasticity ceiling: maxReplicas when declared, else
+            # the provisioned count (no throughput growth possible)
+            max_nodes=max(
+                getattr(job_args, "max_node_num", 0) or 0,
+                getattr(job_args, "node_num", 0) or 0,
+            ),
         )
         self._server, self.servicer = create_master_service(
             port,

@@ -33,6 +33,8 @@ class SpeedMonitor:
         self._start_training_time: Optional[float] = None
         self._sample_count = 0
         self._task_completed_times: Dict[int, float] = {}
+        self._has_step_reports = False
+        self._batches_done = 0
 
     def set_target_worker_num(self, worker_num: int):
         self._target_worker_num = worker_num
@@ -63,7 +65,16 @@ class SpeedMonitor:
     def completed_global_step(self):
         return self._global_step
 
-    def collect_global_step(self, global_step: int, timestamp: float):
+    def collect_global_step(self, global_step: int, timestamp: float,
+                            _source: str = "step"):
+        if _source == "step" and not self._has_step_reports:
+            self._has_step_reports = True
+            if self._batches_done:
+                # step source takes over from the batch feed: drop the
+                # batch-unit records — one mixed delta would put a
+                # wildly inflated speed sample into the scaler's window
+                self._global_step_records.clear()
+                self._global_step = 0
         self._global_step = max(self._global_step, global_step)
         if not self._start_training_time:
             self._start_training_time = time.time()
@@ -74,18 +85,42 @@ class SpeedMonitor:
         if len(self._global_step_records) > self._max_record_count:
             self._global_step_records.pop(0)
 
-    def running_speed(self) -> float:
-        """Steps/sec over the last two records (0 if insufficient data)."""
-        if len(self._global_step_records) < 2:
-            return 0.0
-        last, prev = (
-            self._global_step_records[-1],
-            self._global_step_records[-2],
+    def collect_batch_done(self, batches: int, timestamp: float):
+        """Shard-fed jobs with INDEPENDENT workers (the reference's
+        PS/DeepRec shape — docs/blogs/deeprec_autoscale_cn.md) have no
+        collective global step; the job-wide completed-task count
+        drives the same speed window so throughput-driven autoscaling
+        works identically. A job that reports real global steps keeps
+        step semantics: the batch feed defers to it (mixing the two
+        units would corrupt the window's deltas)."""
+        if self._has_step_reports:
+            return
+        self._batches_done += batches
+        self.collect_global_step(
+            self._batches_done, timestamp, _source="batch"
         )
-        dt = last.timestamp - prev.timestamp
+
+    def running_speed(self) -> float:
+        """Steps/sec over the windowed records of the CURRENT world
+        size (0 if insufficient data). Windowed, not last-two: with
+        event-driven feeds (per-task batch completions) two records
+        can land microseconds apart, and a 1/dt estimator over
+        near-simultaneous events produces divergent spike samples that
+        would dominate the scaler's per-worker means. Restricting to
+        the last record's worker_num keeps a membership change from
+        blending two incarnations' rates."""
+        records = self._global_step_records
+        if len(records) < 2:
+            return 0.0
+        wn = records[-1].worker_num
+        same = [r for r in records if r.worker_num == wn]
+        if len(same) < 2:
+            return 0.0
+        first, last = same[0], same[-1]
+        dt = last.timestamp - first.timestamp
         if dt <= 0:
             return 0.0
-        return (last.global_step - prev.global_step) / dt
+        return (last.global_step - first.global_step) / dt
 
     def worker_adjustment_finished(self) -> bool:
         """All target workers present and speed samples collected since."""

@@ -171,8 +171,10 @@ class TPULocalOptimizer(ResourceOptimizer):
             return plan
         target = self._speed_monitor._target_worker_num
         running = len(self._speed_monitor.running_workers)
-        if not target or running >= target:
+        if not target:
             return plan
+        if running >= target:
+            return self._maybe_throughput_grow(running)
         # restore to the node_unit-aligned target (a partial slice
         # cannot run; never over-provision past the rounded target)
         unit = self._node_unit
@@ -188,6 +190,58 @@ class TPULocalOptimizer(ResourceOptimizer):
         )
         plan.comment = (
             f"restore to {total} workers ({running}/{target} running)"
+        )
+        logger.info("Resource plan: %s", plan.comment)
+        return plan
+
+    def _maybe_throughput_grow(self, running: int) -> ResourcePlan:
+        """DeepRec-style throughput scale-UP (parity:
+        docs/blogs/deeprec_autoscale_cn.md:223 — 30 -> 100 steps/s by
+        adding workers off observed speed; AllreduceTrainingAutoScaler
+        job_auto_scaler.py:251): with headroom below maxReplicas and a
+        MEASURED speed window at the current size, grow one node_unit
+        at a time; the next round needs fresh samples at the grown
+        size, and plateau evidence (the marginal worker stopped
+        pulling its weight) ends the climb."""
+        plan = ResourcePlan()
+        max_nodes = getattr(self._job_args, "max_node_num", 0) or 0
+        unit = self._node_unit
+        proposed = min(running + unit, max_nodes)
+        proposed = (proposed // unit) * unit
+        if proposed <= running:
+            return plan
+        spw = self._speed_per_worker()
+        measured_le = sorted(n for n in spw if n <= running)
+        if not measured_le or measured_le[-1] != running:
+            # growth is driven by speed measured AT the current size —
+            # accepting smaller-world samples would let consecutive
+            # grows climb to maxReplicas with zero fresh evidence
+            return plan
+        cur = measured_le[-1]
+        if len(measured_le) > 1 and spw[cur] < (
+            MIN_WORKER_SPEED_RATIO * spw[measured_le[-2]]
+        ):
+            # retrospective: the PREVIOUS growth's marginal workers are
+            # not pulling their weight — the climb already hit the wall
+            logger.info(
+                "Not growing %d -> %d workers: last growth's marginal "
+                "throughput gone (plateau)", running, proposed,
+            )
+            return plan
+        if self._growth_plateaued(running, proposed):
+            # forward-looking: history at >= proposed (e.g. before a
+            # shrink) already showed it doesn't pay
+            logger.info(
+                "Not growing %d -> %d workers: marginal throughput "
+                "gone (plateau)", running, proposed,
+            )
+            return plan
+        plan.node_group_resources[NodeType.WORKER] = (
+            NodeGroupResource(proposed, NodeResource())
+        )
+        plan.comment = (
+            f"throughput grow {running} -> {proposed} workers "
+            f"(max {max_nodes})"
         )
         logger.info("Resource plan: %s", plan.comment)
         return plan

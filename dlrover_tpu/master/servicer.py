@@ -110,6 +110,15 @@ class MasterServicer:
             )
         except ValueError as e:
             return comm.Response(success=False, reason=str(e))
+        if self._job_metric_collector:
+            # shard-fed jobs advance the speed window here, not via
+            # report_global_step — sample runtime stats on the same
+            # trigger so the resource optimizer sees their throughput
+            self._job_metric_collector.collect_runtime_stats(
+                self._speed_monitor,
+                self._job_manager.get_running_nodes()
+                if self._job_manager else [],
+            )
         return comm.Response(success=True)
 
     def rpc_get_shard_checkpoint(

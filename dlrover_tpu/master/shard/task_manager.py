@@ -102,6 +102,9 @@ class TaskManager:
                 self._speed_monitor.add_task_completed(
                     doing_task.node_id, time.time() - doing_task.start_time
                 )
+                # shard-fed jobs' throughput signal (speed_monitor
+                # defers to real global-step reports when they exist)
+                self._speed_monitor.collect_batch_done(1, time.time())
             return success
 
     def recover_tasks(self, node_type: str, node_id: int):

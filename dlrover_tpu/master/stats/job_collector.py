@@ -39,12 +39,18 @@ def _catch(fn):
 class JobMetricCollector:
     """parity: job_collector.py:78."""
 
-    def __init__(self, job_meta: Optional[JobMeta] = None, reporter=None):
+    def __init__(self, job_meta: Optional[JobMeta] = None, reporter=None,
+                 min_sample_interval: float = 1.0):
         self._job_meta = job_meta or JobMeta()
         self._reporter = reporter or StatsReporter.new_stats_reporter(
             self._job_meta
         )
         self._last_sampled_step = 0
+        # event-driven feeds (per-task completions) would otherwise
+        # snapshot+serialize every running node on EVERY report RPC;
+        # the reference samples on a 15s clock
+        self._min_sample_interval = min_sample_interval
+        self._last_sample_time = 0.0
         self._custom = {}
 
     @property
@@ -93,11 +99,15 @@ class JobMetricCollector:
         step gate replaces the reference's 15s thread)."""
         if speed_monitor is None:
             return
+        now = time.time()
+        if now - self._last_sample_time < self._min_sample_interval:
+            return
         speed = speed_monitor.running_speed()
         step = speed_monitor.completed_global_step
         if speed <= 0 or step <= self._last_sampled_step:
             return
         self._last_sampled_step = step
+        self._last_sample_time = now
         def node_dict(n):
             d = n.to_dict() if hasattr(n, "to_dict") else dict(n)
             used = getattr(n, "used_resource", None)

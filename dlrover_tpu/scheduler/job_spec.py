@@ -87,6 +87,11 @@ class JobArgs:
     distribution_strategy: str = "allreduce"
     node_num: int = 1
     min_node_num: int = 1
+    #: elasticity ceiling (maxReplicas): throughput-driven autoscaling
+    #: may grow the fleet past the initial ``replicas`` up to this
+    #: (parity role: the DeepRec scale-up story — the reference's
+    #: AllreduceTrainingAutoScaler adds workers off observed speed)
+    max_node_num: int = 0
     node_unit: int = 1
     relaunch_always: bool = False
     heartbeat_timeout: Optional[float] = None
@@ -151,6 +156,8 @@ class JobArgs:
             node_num=int(worker.get("replicas", 1)),
             min_node_num=int(
                 worker.get("minReplicas", worker.get("replicas", 1))),
+            max_node_num=int(
+                worker.get("maxReplicas", worker.get("replicas", 1))),
             node_unit=int(spec.get("nodeUnit", 1)),
             relaunch_always=spec.get("relaunchStrategy", "") == "always",
             heartbeat_timeout=spec.get("heartbeatTimeout"),

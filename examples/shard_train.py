@@ -1,0 +1,87 @@
+"""Shard-fed elastic workload with INDEPENDENT workers (DeepRec shape).
+
+The reference's throughput-autoscaling story (docs/blogs/
+deeprec_autoscale_cn.md) runs workers that each pull data shards from
+the master and train independently — job throughput is shards/sec, and
+adding workers raises it linearly until the input pipeline saturates.
+This workload reproduces that shape for the live scale-UP drill
+(tests/test_scale_up_drill.py): each worker fetches master shards at a
+fixed per-worker rate (``--batch-seconds`` simulated train time per
+shard), records completed sample ranges, and exits cleanly when the
+dataset is exhausted.
+
+Exactly-once accounting: a completion line is written ONLY after the
+master accepted the task result, and SIGTERM (the agent recycling
+workers on membership change) defers until the in-flight shard is
+reported — so the drill can assert the union of completed ranges
+covers the dataset exactly once across the scale transition.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+from dlrover_tpu.agent.master_client import build_master_client
+from dlrover_tpu.agent.sharding.client import ShardingClient
+from dlrover_tpu.common.constants import NodeEnv
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset-size", type=int, default=4000)
+    parser.add_argument("--batch-size", type=int, default=50)
+    parser.add_argument("--batch-seconds", type=float, default=0.2,
+                        help="simulated train time per shard — fixes "
+                             "the per-worker rate so job throughput "
+                             "scales with the worker count")
+    parser.add_argument("--progress", type=str, required=True)
+    args = parser.parse_args()
+
+    node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+    world = int(os.getenv(NodeEnv.NODE_NUM, "1"))
+    client = build_master_client()
+    sharding = ShardingClient(
+        dataset_name="scaleup-drill", batch_size=args.batch_size,
+        num_epochs=1, dataset_size=args.dataset_size,
+        num_minibatches_per_shard=1, master_client=client,
+    )
+
+    stop_requested = {"flag": False}
+
+    def on_term(signum, frame):
+        # finish + report the in-flight shard first: dying between a
+        # master-side completion and the progress line would break the
+        # drill's exactly-once ledger
+        stop_requested["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    print(f"WORLD world={world} rank={node_rank}", flush=True)
+
+    done = 0
+    while not stop_requested["flag"]:
+        shard = sharding.fetch_shard()
+        if shard is None:
+            break  # dataset exhausted
+        time.sleep(args.batch_seconds)  # the fixed per-worker rate
+        if not sharding.report_batch_done():
+            # the master did not accept the completion (requeue race
+            # during a scale transition): the shard will be re-issued,
+            # so writing the line here would double-count the range
+            continue
+        done += 1
+        with open(args.progress, "a") as f:
+            f.write(
+                f"{shard.start},{shard.end},{node_rank},{world},"
+                f"{time.time()}\n"
+            )
+    print(
+        f"FINAL rank={node_rank} world={world} shards={done} "
+        f"stopped={stop_requested['flag']}", flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,7 @@ DRILL_MODULES = {
     "test_operator",
     "test_four_node_drill",
     "test_slice_soak_drill",
+    "test_scale_up_drill",
 }
 HEAVY_MODULES = {
     "test_auto",
