@@ -76,8 +76,11 @@ class MasterClient:
         ))
         return self._call("report_dataset_shard_params", req)
 
-    def get_task(self, dataset_name: str) -> comm.Task:
-        req = self._fill(comm.TaskRequest(dataset_name=dataset_name))
+    def get_task(self, dataset_name: str,
+                 incarnation: int = -1) -> comm.Task:
+        req = self._fill(comm.TaskRequest(
+            dataset_name=dataset_name, incarnation=incarnation,
+        ))
         return self._call("get_task", req)
 
     @retry_rpc_request
@@ -331,9 +334,10 @@ class LocalMasterClient:
         )
 
     def report_task_result(self, dataset_name, task_id, err_message=""):
-        self._task_manager.report_dataset_task(
+        accepted = self._task_manager.report_dataset_task(
             dataset_name, task_id, not err_message
         )
+        return comm.Response(success=bool(accepted))
 
     def get_dataset_epoch(self, dataset_name: str) -> int:
         return self._task_manager.get_dataset_epoch(dataset_name)

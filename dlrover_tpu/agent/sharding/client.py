@@ -30,6 +30,10 @@ class ShardingClient:
         storage_type: str = "table",
         master_client=None,
     ):
+        import os
+
+        from dlrover_tpu.common.constants import NodeEnv
+
         self._master_client = master_client or get_master_client()
         self._batch_size = batch_size
         self._dataset_name = dataset_name
@@ -38,6 +42,12 @@ class ShardingClient:
         self._batch_count = 0
         self._lock = threading.Lock()
         self._current_task = None
+        # this process's incarnation (agent restart count): lets the
+        # master reclaim a dead predecessor's in-flight shards on our
+        # first fetch instead of waiting out the task timeout
+        self._incarnation = int(
+            os.getenv(NodeEnv.RESTART_COUNT, "-1") or -1
+        )
         self._master_client.report_dataset_shard_params(
             batch_size=batch_size,
             num_epochs=num_epochs,
@@ -53,15 +63,30 @@ class ShardingClient:
     def dataset_name(self):
         return self._dataset_name
 
-    def fetch_shard(self):
-        """Fetch the next shard, or None when the dataset is exhausted."""
-        task = self._master_client.get_task(self._dataset_name)
-        if task is None or task.task_id < 0:
-            return None
-        with self._lock:
-            self._pending_tasks.append(task)
-            self._current_task = task
-        return task.shard
+    def fetch_shard(self, poll_interval: float = 0.5):
+        """Fetch the next shard, or None when the dataset is exhausted.
+
+        A WAIT task (queue drained, a PEER's work still in flight)
+        polls instead of returning None — reading it as end-of-dataset
+        would lose the re-delivery of a dead peer's orphaned shard.
+        The master never WAITs us on our own unreported tail (see
+        DatasetManger.pending_for_others), and a fetch from a
+        restarted worker reclaims its dead predecessor's shards
+        immediately (reclaim_stale_incarnation, keyed on the
+        incarnation this client sends)."""
+        while True:
+            task = self._master_client.get_task(
+                self._dataset_name, incarnation=self._incarnation
+            )
+            if task is not None and task.task_type == TaskType.WAIT:
+                time.sleep(poll_interval)
+                continue
+            if task is None or task.task_id < 0:
+                return None
+            with self._lock:
+                self._pending_tasks.append(task)
+                self._current_task = task
+            return task.shard
 
     def report_batch_done(self, batch_size: Optional[int] = None) -> bool:
         """Accumulate minibatch completions; report the oldest pending task
@@ -79,10 +104,13 @@ class ShardingClient:
             if self._batch_count >= minibatches:
                 self._pending_tasks.popleft()
                 self._batch_count = 0
-                self._master_client.report_task_result(
+                resp = self._master_client.report_task_result(
                     self._dataset_name, task.task_id
                 )
-                return True
+                # the master may REJECT the completion (the watchdog
+                # already requeued this task to someone else): the
+                # caller must not account the range as its own
+                return bool(getattr(resp, "success", True))
         return False
 
     def report_task_done(self, task_id: int, err: str = ""):

@@ -202,6 +202,11 @@ class Task(BaseMessage):
 @dataclass
 class TaskRequest(BaseRequest):
     dataset_name: str = ""
+    #: the worker PROCESS incarnation (agent restart count): a fetch
+    #: from a newer incarnation proves the older one is dead, so its
+    #: in-flight shards are reclaimed immediately instead of waiting
+    #: out the task timeout; -1 = unknown (no reclaim)
+    incarnation: int = -1
 
 
 @dataclass

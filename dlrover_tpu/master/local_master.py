@@ -70,7 +70,20 @@ class LocalJobMaster:
                         self._exit_reason = JobExitReason.UNKNOWN_ERROR
                     break
                 if self.task_manager.finished():
-                    logger.info("All data tasks finished; stopping master")
+                    # drain, don't slam the door: workers are about to
+                    # see end-of-dataset and exit, and their agents
+                    # still need the server up to report node status —
+                    # stopping immediately turns a clean finish into
+                    # 60s of connection-refused retries and rc 1
+                    logger.info(
+                        "All data tasks finished; draining workers"
+                    )
+                    deadline = time.time() + 30
+                    while (
+                        time.time() < deadline
+                        and not self.job_manager.all_workers_exited()
+                    ):
+                        time.sleep(0.2)
                     break
                 time.sleep(check_interval)
         except KeyboardInterrupt:

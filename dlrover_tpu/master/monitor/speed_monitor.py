@@ -113,10 +113,17 @@ class SpeedMonitor:
         if len(records) < 2:
             return 0.0
         wn = records[-1].worker_num
-        same = [r for r in records if r.worker_num == wn]
+        # contiguous TRAILING run only: an earlier incarnation at the
+        # same size (grow -> shrink -> regrow) would otherwise blend
+        # the slow middle span into the current rate
+        same = []
+        for r in reversed(records):
+            if r.worker_num != wn:
+                break
+            same.append(r)
         if len(same) < 2:
             return 0.0
-        first, last = same[0], same[-1]
+        last, first = same[0], same[-1]
         dt = last.timestamp - first.timestamp
         if dt <= 0:
             return 0.0

@@ -71,28 +71,26 @@ class AllreduceTrainingAutoScaler:
                     )
                     if plan and not plan.empty():
                         self.execute_job_optimization_plan(plan)
-                        group = plan.node_group_resources.get(
-                            NodeType.WORKER
-                        )
                         monitor = getattr(
                             self._job_optimizer, "_speed_monitor", None
                         )
-                        new_target = group.count if group else 0
+                        new_target = plan.grow_target
                         if self._max_nodes > 0:
                             new_target = min(
                                 new_target, self._max_nodes
                             )
                         if (
-                            plan.comment.startswith("throughput grow")
+                            new_target
                             and monitor is not None
                             and new_target
                             > (monitor._target_worker_num or 0)
                         ):
                             # ONLY a throughput grow RAISES the
-                            # target (a restore plan's node_unit
-                            # round-up must not ratchet it): a grown
-                            # worker that later dies is then restored
-                            # at the grown size, never past maxReplicas
+                            # target (plan.grow_target — a restore
+                            # plan's node_unit round-up must not
+                            # ratchet it): a grown worker that later
+                            # dies is then restored at the grown
+                            # size, never past maxReplicas
                             monitor.set_target_worker_num(new_target)
                     self._maybe_shrink_stragglers()
             except Exception as e:

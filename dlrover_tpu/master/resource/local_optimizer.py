@@ -239,6 +239,7 @@ class TPULocalOptimizer(ResourceOptimizer):
         plan.node_group_resources[NodeType.WORKER] = (
             NodeGroupResource(proposed, NodeResource())
         )
+        plan.grow_target = proposed
         plan.comment = (
             f"throughput grow {running} -> {proposed} workers "
             f"(max {max_nodes})"

@@ -21,6 +21,10 @@ class ResourcePlan:
     comment: str = ""
     #: specific node ranks a shrink plan wants removed (stragglers)
     remove_ranks: List[int] = field(default_factory=list)
+    #: throughput-grow plans set this to the proposed worker count so
+    #: the scaler RAISES the job's target (a structured contract — the
+    #: comment is for humans); 0 for every other plan kind
+    grow_target: int = 0
 
     def empty(self) -> bool:
         return not self.node_group_resources

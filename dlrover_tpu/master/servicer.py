@@ -90,7 +90,8 @@ class MasterServicer:
             if self._speed_monitor:
                 self._speed_monitor.set_start_timestamp()
         task = self._task_manager.get_dataset_task(
-            req.node_type, req.node_id, req.dataset_name
+            req.node_type, req.node_id, req.dataset_name,
+            incarnation=req.incarnation,
         )
         shard = comm.Shard(
             name=task.shard.name,
@@ -105,11 +106,18 @@ class MasterServicer:
     def rpc_report_task_result(self, req: comm.TaskResult) -> comm.Response:
         success = not req.err_message
         try:
-            self._task_manager.report_dataset_task(
+            accepted = self._task_manager.report_dataset_task(
                 req.dataset_name, req.task_id, success, req.err_message
             )
         except ValueError as e:
             return comm.Response(success=False, reason=str(e))
+        if not accepted:
+            # unknown/requeued task (e.g. the watchdog already gave it
+            # to someone else): the reporter must NOT count this range
+            # as its own completion
+            return comm.Response(
+                success=False, reason="task not accepted"
+            )
         if self._job_metric_collector:
             # shard-fed jobs advance the speed window here, not via
             # report_global_step — sample runtime stats on the same

@@ -26,6 +26,14 @@ class Task:
     def create_invalid_task(cls) -> "Task":
         return cls(-1, TaskType.NONE, Shard("", -1, -1))
 
+    @classmethod
+    def create_wait_task(cls) -> "Task":
+        """Queue drained but the dataset is NOT complete (in-flight
+        shards may still be requeued; a stream may produce more): the
+        worker must poll, not exit — exiting here loses the re-delivery
+        of an orphaned shard (parity: the reference's wait semantics)."""
+        return cls(-1, TaskType.WAIT, Shard("", -1, -1))
+
 
 @dataclass
 class DoingTask:
@@ -34,6 +42,8 @@ class DoingTask:
     task: Task
     node_id: int
     start_time: float
+    #: worker-process incarnation the task was issued to (-1 unknown)
+    incarnation: int = -1
 
 
 class DatasetShardCheckpoint:
@@ -94,6 +104,31 @@ class DatasetManger(ABC):
 
     def get_epoch(self) -> int:
         return self._dataset_splitter.get_epoch()
+
+    def reclaim_stale_incarnation(self, node_id: int,
+                                  incarnation: int) -> List[int]:
+        """A fetch from incarnation k of a node proves its older
+        incarnations are dead: requeue their in-flight shards NOW — a
+        restarted worker resumes at the right offset without waiting
+        out the task timeout. No-op for unknown incarnations."""
+        if incarnation < 0:
+            return []
+        stale = [
+            tid for tid, dt in self.doing.items()
+            if dt.node_id == node_id
+            and 0 <= dt.incarnation < incarnation
+        ]
+        for tid in stale:
+            self.recover_task(self.doing.pop(tid).task)
+        return stale
+
+    def pending_for_others(self, node_id: int) -> bool:
+        """In-flight work owned by OTHER nodes (whose death/requeue the
+        asker should WAIT for; the asker's own current-incarnation tail
+        is its own to report)."""
+        return any(
+            dt.node_id != node_id for dt in self.doing.values()
+        )
 
     def reset(self):
         self.todo = []

@@ -28,17 +28,27 @@ class BatchDatasetManager(DatasetManger):
         self._task_id = 0
         self._completed_step = 0
 
-    def get_task(self, node_type: str, node_id: int) -> Task:
+    def get_task(self, node_type: str, node_id: int,
+                 incarnation: int = -1) -> Task:
         """Pop a todo task; refill from the splitter when drained."""
+        self.reclaim_stale_incarnation(node_id, incarnation)
         if not self.todo and not self._dataset_splitter.epoch_finished():
             shards = self._dataset_splitter.create_shards()
             if shards:
                 self._create_todo_tasks()
         if not self.todo:
-            # datasets exhausted or evaluator waiting for next epoch
+            if self.pending_for_others(node_id):
+                # drained, but a PEER's in-flight shards can still be
+                # requeued (death, timeout): wait for the re-delivery
+                # — the asker's own unreported tail is its own to
+                # finish, so it gets end-of-queue, not a self-deadlock
+                return Task.create_wait_task()
+            # dataset exhausted (or only the asker's tail remains)
             return Task.create_invalid_task()
         task = self.todo.pop(0)
-        self.doing[task.task_id] = DoingTask(task, node_id, time.time())
+        self.doing[task.task_id] = DoingTask(
+            task, node_id, time.time(), incarnation
+        )
         logger.debug(
             "Assign task %s of dataset %s to %s-%s",
             task.task_id, self._dataset_splitter.dataset_name, node_type,

@@ -23,14 +23,22 @@ class StreamingDatasetManager(DatasetManger):
         super().__init__(task_type, batch_size, dataset_splitter)
         self._task_id = 0
 
-    def get_task(self, node_type: str, node_id: int) -> Task:
+    def get_task(self, node_type: str, node_id: int,
+                 incarnation: int = -1) -> Task:
+        self.reclaim_stale_incarnation(node_id, incarnation)
         if not self.todo:
             if self._dataset_splitter.create_shards():
                 self._create_todo_tasks()
         if not self.todo:
+            if self.pending_for_others(node_id):
+                # the stream is drained but a PEER's shards are in
+                # flight: their orphaned ranges may requeue any moment
+                return Task.create_wait_task()
             return Task.create_invalid_task()
         task = self.todo.pop(0)
-        self.doing[task.task_id] = DoingTask(task, node_id, time.time())
+        self.doing[task.task_id] = DoingTask(
+            task, node_id, time.time(), incarnation
+        )
         return task
 
     def _create_todo_tasks(self):

@@ -84,12 +84,13 @@ class TaskManager:
     # ---------------------------------------------------------------- tasks
 
     def get_dataset_task(self, node_type: str, node_id: int,
-                         dataset_name: str) -> Task:
+                         dataset_name: str,
+                         incarnation: int = -1) -> Task:
         with self._lock:
             ds = self._datasets.get(dataset_name)
             if ds is None:
                 return Task.create_invalid_task()
-            return ds.get_task(node_type, node_id)
+            return ds.get_task(node_type, node_id, incarnation)
 
     def report_dataset_task(self, dataset_name: str, task_id: int,
                             success: bool, err: str = ""):

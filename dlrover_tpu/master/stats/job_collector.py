@@ -104,6 +104,12 @@ class JobMetricCollector:
             return
         speed = speed_monitor.running_speed()
         step = speed_monitor.completed_global_step
+        if step < self._last_sampled_step:
+            # the monitor's step counter went BACKWARD: its source
+            # switched (batch feed -> real global steps, which resets
+            # the window) — follow it or sampling stalls until the new
+            # unit outruns the old count
+            self._last_sampled_step = step
         if speed <= 0 or step <= self._last_sampled_step:
             return
         self._last_sampled_step = step

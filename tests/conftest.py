@@ -39,6 +39,7 @@ DRILL_MODULES = {
     "test_four_node_drill",
     "test_slice_soak_drill",
     "test_scale_up_drill",
+    "test_streaming_e2e",
 }
 HEAVY_MODULES = {
     "test_auto",

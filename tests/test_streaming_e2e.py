@@ -1,0 +1,111 @@
+"""Streaming dataset e2e through the launcher (VERDICT r4 item #8):
+a live run consuming a streaming source (partition-offset shards from
+StreamingDatasetSplitter), with a mid-run crash that orphans an
+IN-FLIGHT shard — the restarted worker must resume at the right
+offset: the orphaned range is re-delivered exactly once and the whole
+stream is covered with no gaps or duplicates.
+
+Parity: dlrover/python/master/shard/dataset_splitter.py:359 +
+streaming_dataset_manager.py:32 + the reference's task-timeout
+reassignment (task_manager.py:205).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+TOTAL = 2000
+BATCH = 100
+
+
+def _run(tmp, crash_after=0, timeout=300):
+    progress = os.path.join(tmp, "progress.txt")
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.trainer.elastic_run",
+        "--standalone", "--nnodes", "1:1",
+        "--max_restarts", "2", "--monitor_interval", "0.3",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "stream_train.py",
+        ), "--",
+        "--total", str(TOTAL), "--batch-size", str(BATCH),
+        "--progress", progress,
+    ] + (["--crash-after", str(crash_after)] if crash_after else [])
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the orphaned in-flight shard is recovered by the master's task
+    # timeout watchdog; the default 1800s would stall the drill
+    env["DLROVER_TPU_CTX_TASK_PROCESS_TIMEOUT"] = "5"
+    proc = subprocess.run(
+        cmd, env=env, timeout=timeout, capture_output=True, text=True,
+    )
+    return proc, progress
+
+
+def _rows(progress):
+    rows = []
+    if os.path.exists(progress):
+        for line in open(progress):
+            parts = line.strip().split(",")
+            if len(parts) == 5:
+                rows.append((parts[0], int(parts[1]), int(parts[2]),
+                             int(parts[3])))
+    return rows
+
+
+def _assert_exactly_once(rows):
+    ranges = sorted((r[1], r[2]) for r in rows)
+    prev_end = 0
+    for start, end in ranges:
+        assert start == prev_end, (
+            f"gap/overlap at {start} (prev end {prev_end})"
+        )
+        prev_end = end
+    assert prev_end == TOTAL, (prev_end, TOTAL)
+
+
+def test_streaming_source_completes():
+    with tempfile.TemporaryDirectory() as tmp:
+        proc, progress = _run(tmp)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        _assert_exactly_once(_rows(progress))
+
+
+def test_streaming_crash_resumes_at_right_offset():
+    with tempfile.TemporaryDirectory() as tmp:
+        proc, progress = _run(tmp, crash_after=5)
+        out = proc.stdout + proc.stderr
+        assert proc.returncode == 0, out[-3000:]
+
+        # the crash really happened with a shard in flight
+        m = re.search(r"CRASH holding (\S+):(\d+)-(\d+)", out)
+        assert m, out[-3000:]
+        orphan = (int(m.group(2)), int(m.group(3)))
+
+        # the master's shard checkpoint (snapshotted by the dying
+        # worker over the RPC) tracked that range as doing/todo
+        ck = re.search(r"SHARD_CKPT (\{.*\})", out)
+        assert ck, out[-3000:]
+        doc = json.loads(ck.group(1))
+        tracked = [tuple(x) for x in doc.get("doing", [])] + [
+            tuple(x) for x in doc.get("todo", [])
+        ]
+        assert list(orphan) in [list(t) for t in tracked], (
+            orphan, tracked,
+        )
+
+        rows = _rows(progress)
+        # the restarted incarnation completed the orphaned range —
+        # exactly once, at the right offset
+        redelivered = [
+            r for r in rows
+            if (r[1], r[2]) == orphan and r[3] >= 1
+        ]
+        assert len(redelivered) == 1, (orphan, rows[-8:])
+        assert not [
+            r for r in rows if (r[1], r[2]) == orphan and r[3] == 0
+        ]
+        _assert_exactly_once(rows)
