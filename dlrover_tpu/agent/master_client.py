@@ -321,9 +321,13 @@ class LocalMasterClient:
             batch_size, dataset_size, dataset_name, splitter, task_type
         )
 
-    def get_task(self, dataset_name: str) -> comm.Task:
+    # signature in lockstep with MasterClient.get_task: ShardingClient
+    # calls either through the same code path
+    def get_task(self, dataset_name: str,
+                 incarnation: int = -1) -> comm.Task:
         task = self._task_manager.get_dataset_task(
-            self._node_type, self._node_id, dataset_name
+            self._node_type, self._node_id, dataset_name,
+            incarnation=incarnation,
         )
         return comm.Task(
             task_id=task.task_id, task_type=task.task_type,

@@ -79,6 +79,11 @@ class ShardingClient:
                 self._dataset_name, incarnation=self._incarnation
             )
             if task is not None and task.task_type == TaskType.WAIT:
+                # stop() (defined on IndexShardingClient; absent on the
+                # base class) must be able to interrupt the poll, or a
+                # shutdown during a peer's in-flight window spins here
+                if getattr(self, "_stopped", False):
+                    return None
                 time.sleep(poll_interval)
                 continue
             if task is None or task.task_id < 0:
