@@ -50,6 +50,10 @@ class AllreduceTrainingAutoScaler:
         # exclusion the stale plan would undo the manual request (or
         # both paths double-launch from the same bookkeeping read)
         self._plan_lock = threading.Lock()
+        # an operator's manual_scale is an explicit decision about the
+        # world size; the throughput-grow loop must not override it
+        # minutes later (the reference's manualScaling wins over auto)
+        self._manual_override = False
 
     def start_auto_scaling(self):
         if self._thread is None:
@@ -69,6 +73,17 @@ class AllreduceTrainingAutoScaler:
                     plan = (
                         self._job_optimizer.generate_job_resource_plan()
                     )
+                    if (
+                        plan is not None
+                        and plan.grow_target
+                        and self._manual_override
+                    ):
+                        logger.info(
+                            "Skipping throughput grow to %d: operator "
+                            "manually scaled this job",
+                            plan.grow_target,
+                        )
+                        plan = None
                     if plan and not plan.empty():
                         self.execute_job_optimization_plan(plan)
                         monitor = getattr(
@@ -162,6 +177,7 @@ class AllreduceTrainingAutoScaler:
             # ceiling (agents rendezvous with --nnodes min:max anyway)
             aligned = min(aligned, self._max_nodes)
         with self._plan_lock:
+            self._manual_override = True
             monitor = getattr(
                 self._job_optimizer, "_speed_monitor", None
             )

@@ -274,3 +274,23 @@ def test_runtime_stats_throttled_by_time():
         sm.collect_batch_done(1, t + i)
         collector.collect_runtime_stats(sm, [])
     assert len(reporter.runtime_stats) == 1  # first sample only
+
+
+def test_manual_scale_disables_throughput_growth():
+    """Regression (soak drill): an operator's manual_scale retargeted
+    the job at 4, and the throughput-grow loop regrew it to 8 minutes
+    later — reprovisioning into a dead slice. manualScaling wins."""
+    from dlrover_tpu.master.node.job_auto_scaler import (
+        AllreduceTrainingAutoScaler,
+    )
+
+    opt = _grow_optimizer([(2, 10.0), (2, 10.0)])
+    scaler = AllreduceTrainingAutoScaler(
+        job_manager=None, job_optimizer=opt, scaler=None,
+        min_nodes=2, max_nodes=4,
+    )
+    plan = opt.generate_job_resource_plan()
+    assert plan.grow_target == 4  # growth WOULD fire...
+    scaler._manual_override = True  # ...but the operator scaled
+    # the periodic loop's gate: a grow plan is dropped under override
+    assert scaler._manual_override and plan.grow_target
