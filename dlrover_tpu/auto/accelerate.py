@@ -253,6 +253,18 @@ def auto_accelerate(
                 seen.add(key)
                 extra.append(cand)
         candidates = list(candidates) + extra
+    if not strategies:
+        # the enumeration is model-blind: drop ulysses candidates
+        # whose Q-head count doesn't divide by the seq axis — the
+        # all-to-all reshards Q heads over sp (ulysses_attention's
+        # hard constraint; an indivisible KV count is fine, the kernel
+        # broadcasts KV heads)
+        q_heads = getattr(cfg, "num_heads", 0)
+        candidates = [
+            s for s in candidates
+            if s.context_parallel != "ulysses"
+            or (q_heads and q_heads % max(s.axis("seq"), 1) == 0)
+        ]
     # measured-envelope cap (strategy.envelope_max_seq): attention
     # models only — recommender towers have no seq-quadratic
     # residuals. Auto-enumerated candidates only: an EXPLICIT

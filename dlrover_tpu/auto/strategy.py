@@ -151,13 +151,18 @@ def enumerate_strategies(
                 data = rest // fsdp
                 if global_batch % max(data * fsdp, 1):
                     continue
-                out.append(Strategy(
-                    mesh_spec=(
-                        ("data", data), ("fsdp", fsdp), ("seq", sp)
-                    ),
-                    sharding="sequence", remat="dots",
-                    context_parallel="ring",
-                ))
+                for kind in ("ring", "ulysses"):
+                    # ulysses needs heads % sp == 0; the enumeration
+                    # is model-blind, so auto_accelerate drops the
+                    # indivisible ulysses candidates once it has cfg
+                    out.append(Strategy(
+                        mesh_spec=(
+                            ("data", data), ("fsdp", fsdp),
+                            ("seq", sp),
+                        ),
+                        sharding="sequence", remat="dots",
+                        context_parallel=kind,
+                    ))
     if num_experts > 1:
         for ep in _divisors(min(num_devices, num_experts)):
             if ep == 1:

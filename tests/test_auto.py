@@ -339,3 +339,34 @@ def test_auto_selects_sequence_parallel_past_envelope():
         dryrun_top_k=0,
     )
     assert res8k.strategy.context_parallel is None
+
+
+def test_ulysses_candidates_gated_on_head_divisibility():
+    """The model-blind enumeration emits ulysses variants; the search
+    drops those whose Q-head count doesn't divide by the seq axis
+    (ulysses_attention's hard constraint — indivisible KV broadcasts)."""
+    from dlrover_tpu.auto.accelerate import auto_accelerate
+
+    # 6 Q heads: sp=2 divides, sp=4/8 don't
+    cfg = llama.llama_tiny(
+        hidden_size=96, num_heads=6, num_kv_heads=3,
+        max_seq_len=16384,
+    )
+    res = auto_accelerate(
+        cfg, global_batch=8, seq_len=16384, hbm_bytes=15.75e9,
+        dryrun_top_k=0,
+    )
+    ulysses = [
+        r.strategy for r in res.reports
+        if r.strategy.context_parallel == "ulysses"
+    ]
+    assert ulysses
+    assert all(
+        cfg.num_heads % s.axis("seq") == 0 for s in ulysses
+    )
+    assert {s.axis("seq") for s in ulysses} == {2}
+    # KV indivisibility alone (3 kv heads, sp=2) does NOT gate: the
+    # kernel broadcasts KV
+    assert any(
+        cfg.num_kv_heads % s.axis("seq") != 0 for s in ulysses
+    )

@@ -158,3 +158,51 @@ def test_task_manager_shard_checkpoint():
     ckpt = tm.get_dataset_checkpoint("ds")
     assert ckpt is not None
     assert tm.restore_dataset_from_checkpoint(ckpt.to_json())
+
+
+def test_wait_task_for_peer_work_but_not_own_tail():
+    """A drained queue with a PEER's shard in flight WAITs (its requeue
+    would otherwise be lost); the asker's own unreported tail ends
+    iteration (no self-deadlock for prefetch-ahead clients)."""
+    splitter = new_dataset_splitter(
+        shuffle=False, shard_size=10, dataset_size=20, num_epochs=1,
+        dataset_name="d",
+    )
+    mgr = BatchDatasetManager(TaskType.TRAINING, 5, splitter)
+    t0 = mgr.get_task(NodeType.WORKER, 0)
+    t1 = mgr.get_task(NodeType.WORKER, 1)
+    assert t0.task_id >= 0 and t1.task_id >= 0
+    # queue drained; node 0 still holds t0 -> node 1 must WAIT
+    assert mgr.get_task(NodeType.WORKER, 1).task_type == TaskType.WAIT
+    # node 0 asking with ONLY its own tail in flight gets end-of-queue
+    mgr.report_task_status(t1.task_id, success=True)
+    assert mgr.get_task(NodeType.WORKER, 0).task_type == TaskType.NONE
+    # the peer's shard requeues (timeout/failure) -> WAITer gets it
+    mgr.report_task_status(t0.task_id, success=False)
+    redelivered = mgr.get_task(NodeType.WORKER, 1)
+    assert redelivered.task_id == t0.task_id
+
+
+def test_incarnation_reclaim_requeues_dead_predecessors_shards():
+    """A fetch from incarnation k of a node requeues in-flight shards
+    its OLDER incarnations held — a restarted worker resumes at the
+    right offset without waiting out the task timeout."""
+    splitter = new_dataset_splitter(
+        shuffle=False, shard_size=10, dataset_size=20, num_epochs=1,
+        dataset_name="d",
+    )
+    mgr = BatchDatasetManager(TaskType.TRAINING, 5, splitter)
+    t0 = mgr.get_task(NodeType.WORKER, 0, incarnation=0)
+    t1 = mgr.get_task(NodeType.WORKER, 1, incarnation=0)
+    # node 0's process dies holding t0; its restart (incarnation 1)
+    # fetches: the orphan requeues and is re-delivered FIRST
+    again = mgr.get_task(NodeType.WORKER, 0, incarnation=1)
+    assert again.task_id == t0.task_id
+    # a same-incarnation fetch never reclaims (pipeline-ahead clients)
+    assert mgr.get_task(NodeType.WORKER, 1, incarnation=0).task_type \
+        == TaskType.WAIT
+    assert t1.task_id in mgr.doing
+    # unknown incarnations (-1) are inert
+    mgr.report_task_status(again.task_id, success=True)
+    mgr.report_task_status(t1.task_id, success=True)
+    assert mgr.get_task(NodeType.WORKER, 5).task_type == TaskType.NONE
