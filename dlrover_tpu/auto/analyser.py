@@ -193,4 +193,21 @@ def estimate_step_time(
             4 * profile.num_layers * per_dev_tokens
             * profile.hidden_size * b
         ) / (ici_bandwidth * strategy.axis("tensor"))
+    sp = strategy.axis("seq")
+    if sp > 1 and strategy.context_parallel:
+        # the ring/ulysses twins must NOT tie (the dedup/selection
+        # downstream is otherwise blind to the kind): per layer, ring
+        # rotates local K+V around the ring (sp-1 hops of 2 shards,
+        # overlappable with the chunk compute — charge half exposed);
+        # ulysses all-to-alls Q,K,V in and O out (4 transfers of the
+        # local activation shard, exposed)
+        local_act = (
+            (tokens / max(dp, 1)) / sp * profile.hidden_size * b
+        )
+        per_layer = (
+            0.5 * 2 * (sp - 1) * local_act
+            if strategy.context_parallel == "ring"
+            else 4.0 * local_act
+        )
+        comm += profile.num_layers * per_layer / ici_bandwidth
     return compute + comm

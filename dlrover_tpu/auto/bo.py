@@ -28,7 +28,11 @@ from dlrover_tpu.common.log import default_logger as logger
 
 
 def featurize(s: Strategy) -> np.ndarray:
-    """Embed a strategy into R^7 (log-scaled axes + categorical knobs)."""
+    """Embed a strategy into R^8 (log-scaled axes + categorical knobs).
+    context_parallel is a dimension: ring/ulysses twins of one mesh
+    must not embed identically, or the GP treats them as one point
+    (duplicate x rows with conflicting y; EI never explores the twin)."""
+    cp = {None: 0.0, "ring": 1.0, "ulysses": 2.0}
     return np.array([
         math.log2(max(s.axis("data"), 1)),
         math.log2(max(s.axis("fsdp"), 1)),
@@ -37,6 +41,7 @@ def featurize(s: Strategy) -> np.ndarray:
         float(REMAT_POLICIES.index(s.remat)),
         float(PRECISIONS.index(s.precision)),
         math.log2(max(s.accum_steps, 1)),
+        cp.get(s.context_parallel, 3.0),
     ])
 
 

@@ -140,6 +140,25 @@ def _check_blocks(seq, block_q, block_k):
         raise ValueError(f"block_q must be a power of two, got {block_q}")
 
 
+def _kv_index(causal, block_q, block_k):
+    """K/V block index for grid step (b, i, j), diagonal-clamped.
+
+    A causally SKIPPED (j, i) step computes nothing (pl.when), but the
+    pipeline would still stream its K/V block from HBM — dead traffic
+    that is ~half of all fetches at causal. Clamping the index to the
+    diagonal makes every skipped step re-reference the block the live
+    diagonal step fetches; Mosaic elides copies whose index didn't
+    change, so skipped steps cost no bandwidth."""
+
+    def index(b, i, j):
+        if causal:
+            diag = (i * block_q + block_q - 1) // block_k
+            j = jnp.minimum(j, diag)
+        return (b, j, 0)
+
+    return index
+
+
 def _fwd(q, k, v, scale, causal, block_q, block_k):
     """q: [bk_h, g, seq, d]; k,v: [bk_h, seq, d] ->
     (o [bk_h, g, seq, d], lse [bk_h, g, 1, seq] f32)."""
@@ -152,13 +171,14 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         _fwd_kernel, scale=scale, causal=causal, g=g,
         block_q=block_q, block_k=block_k,
     )
+    kv_idx = _kv_index(causal, block_q, block_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, g, block_q, d), lambda b, i, j: (b, 0, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, g, block_q, d), lambda b, i, j: (b, 0, i, 0)),
@@ -299,10 +319,11 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
         _dq_kernel, scale=scale, causal=causal, g=g,
         block_q=block_q, block_k=block_k,
     )
+    kv_idx = _kv_index(causal, block_q, block_k)
     in_specs_q = [
         pl.BlockSpec((1, g, block_q, d), lambda b, i, j: (b, 0, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # k
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # v
+        pl.BlockSpec((1, block_k, d), kv_idx),  # k
+        pl.BlockSpec((1, block_k, d), kv_idx),  # v
         pl.BlockSpec((1, g, block_q, d), lambda b, i, j: (b, 0, i, 0)),
         pl.BlockSpec((1, g, 1, block_q), lambda b, i, j: (b, 0, 0, i)),
         pl.BlockSpec((1, g, 1, block_q), lambda b, i, j: (b, 0, 0, i)),
@@ -323,13 +344,28 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
         _dkv_kernel, scale=scale, causal=causal, g=g,
         block_q=block_q, block_k=block_k,
     )
+
+    def q_side_idx(sublane):
+        """Q/dO/lse/delta block index for dkv's (b, j, i) grid, clamped
+        UP to the first causally-live q block of k-block j — skipped
+        steps (q entirely above the diagonal) re-reference the block
+        the first live step fetches, so they cost no bandwidth (same
+        trick as _kv_index)."""
+
+        def index(b, j, i):
+            if causal:
+                i = jnp.maximum(i, (j * block_k) // block_q)
+            return (b, 0, i, 0) if sublane else (b, 0, 0, i)
+
+        return index
+
     in_specs_kv = [
-        pl.BlockSpec((1, g, block_q, d), lambda b, j, i: (b, 0, i, 0)),
+        pl.BlockSpec((1, g, block_q, d), q_side_idx(True)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # k
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # v
-        pl.BlockSpec((1, g, block_q, d), lambda b, j, i: (b, 0, i, 0)),
-        pl.BlockSpec((1, g, 1, block_q), lambda b, j, i: (b, 0, 0, i)),
-        pl.BlockSpec((1, g, 1, block_q), lambda b, j, i: (b, 0, 0, i)),
+        pl.BlockSpec((1, g, block_q, d), q_side_idx(True)),
+        pl.BlockSpec((1, g, 1, block_q), q_side_idx(False)),
+        pl.BlockSpec((1, g, 1, block_q), q_side_idx(False)),
     ]
     dk, dv = pl.pallas_call(
         dkv_kernel,
