@@ -114,6 +114,58 @@ class _BenchProducer:
             yield t, t
 
 
+def _honor_platform_env():
+    """Site hooks may rewrite jax's platform priority (the TPU-tunnel
+    sitecustomize sets axon,cpu); a dev run launched with
+    JAX_PLATFORMS=cpu must not probe the tunnel first."""
+    import os
+
+    plat = os.getenv("JAX_PLATFORMS", "")
+    if plat and jax.config.jax_platforms != plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass  # backend already initialized
+
+
+def _guard_backend_discovery(metric: str, unit: str,
+                             timeout_s: float = 300.0):
+    """A wedged device service (e.g. a TPU tunnel whose claim is stuck)
+    makes jax.devices() block FOREVER — the bench must emit its one
+    JSON line either way, so discovery runs under a watchdog and a
+    fast init failure also becomes the error line. 300s is far above
+    healthy backend init (seconds) and unrelated to compile time,
+    which happens after discovery."""
+    import threading
+
+    done = threading.Event()
+    err = []
+
+    def probe():
+        try:
+            jax.devices()
+        except Exception as e:
+            err.append(e)
+        done.set()
+
+    def bail(reason):
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0, "error": reason,
+        }))
+        raise SystemExit(2)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        bail(
+            f"device discovery hung >{timeout_s:.0f}s (wedged "
+            "backend/tunnel); no measurement possible"
+        )
+    if err:
+        bail(f"backend init failed: {err[0]}")
+
+
 def main():
     import argparse
 
@@ -138,9 +190,12 @@ def main():
         "headline Llama MFU",
     )
     args = ap.parse_args()
+    _honor_platform_env()
     if args.model == "dlrm":
+        _guard_backend_discovery("dlrm_steps_per_sec", "steps/s")
         bench_dlrm()
         return
+    _guard_backend_discovery("mfu_percent", "%")
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
