@@ -295,6 +295,13 @@ def main():
         trainer.train_step, *abstract, params=params
     )
 
+    # which flash-attention blocks the step actually ran with, and
+    # where they came from (ops/tuning.py: cache | measured |
+    # heuristic); null off-TPU where the Pallas path never dispatches
+    from dlrover_tpu.ops import tuning
+
+    sel = tuning.last_selection()
+
     result = {
         "metric": "mfu_percent",
         "value": round(mfu, 2),
@@ -312,6 +319,9 @@ def main():
         "hbm_gb_per_step": round(prof.hbm_bytes / 2**30, 2),
         "param_count": prof.param_count,
         "data_path": args.data,
+        "attn_block_q": sel["block_q"] if sel else None,
+        "attn_block_k": sel["block_k"] if sel else None,
+        "attn_tuning_source": sel["source"] if sel else None,
     }
     print(json.dumps(result))
 

@@ -14,6 +14,12 @@ from dlrover_tpu.agent.master_client import get_master_client
 from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import default_logger as logger
 
+#: default ceiling on one fetch_shard WAIT poll. The master's task
+#: watchdog requeues a dead peer's shard within its task timeout
+#: (minutes); an hour of WAIT means the watchdog itself is gone — stop
+#: depending on it instead of spinning forever.
+DEFAULT_WAIT_DEADLINE_SECS = 3600.0
+
 
 class ShardingClient:
     """Fetch shard tasks and report completion by accumulated minibatches."""
@@ -42,6 +48,7 @@ class ShardingClient:
         self._batch_count = 0
         self._lock = threading.Lock()
         self._current_task = None
+        self._stopped = False
         # this process's incarnation (agent restart count): lets the
         # master reclaim a dead predecessor's in-flight shards on our
         # first fetch instead of waiting out the task timeout
@@ -63,7 +70,9 @@ class ShardingClient:
     def dataset_name(self):
         return self._dataset_name
 
-    def fetch_shard(self, poll_interval: float = 0.5):
+    def fetch_shard(self, poll_interval: float = 0.5,
+                    max_wait: Optional[float] =
+                    DEFAULT_WAIT_DEADLINE_SECS):
         """Fetch the next shard, or None when the dataset is exhausted.
 
         A WAIT task (queue drained, a PEER's work still in flight)
@@ -73,16 +82,31 @@ class ShardingClient:
         DatasetManger.pending_for_others), and a fetch from a
         restarted worker reclaims its dead predecessor's shards
         immediately (reclaim_stale_incarnation, keyed on the
-        incarnation this client sends)."""
+        incarnation this client sends).
+
+        The poll is BOUNDED: liveness must not hinge on the master's
+        watchdog requeueing the peer's shard — if WAIT persists past
+        ``max_wait`` seconds (None = unbounded), log and return None
+        rather than blocking the training thread forever. stop()
+        interrupts the poll at the next tick."""
+        deadline = (
+            time.monotonic() + max_wait if max_wait is not None else None
+        )
         while True:
             task = self._master_client.get_task(
                 self._dataset_name, incarnation=self._incarnation
             )
             if task is not None and task.task_type == TaskType.WAIT:
-                # stop() (defined on IndexShardingClient; absent on the
-                # base class) must be able to interrupt the poll, or a
-                # shutdown during a peer's in-flight window spins here
-                if getattr(self, "_stopped", False):
+                if self._stopped:
+                    return None
+                if deadline is not None and time.monotonic() > deadline:
+                    logger.error(
+                        "fetch_shard waited >%.0fs on dataset %s with "
+                        "the master still answering WAIT (stuck "
+                        "watchdog or never-expiring task?); giving up "
+                        "on the in-flight peer shard",
+                        max_wait, self._dataset_name,
+                    )
                     return None
                 time.sleep(poll_interval)
                 continue
@@ -92,6 +116,10 @@ class ShardingClient:
                 self._pending_tasks.append(task)
                 self._current_task = task
             return task.shard
+
+    def stop(self):
+        """Interrupt any in-progress WAIT poll; subclasses extend."""
+        self._stopped = True
 
     def report_batch_done(self, batch_size: Optional[int] = None) -> bool:
         """Accumulate minibatch completions; report the oldest pending task
@@ -155,7 +183,6 @@ class IndexShardingClient(ShardingClient):
             master_client=master_client,
         )
         self._sample_queue: "Queue[int]" = Queue(maxsize=batch_size * 8)
-        self._stopped = False
         self._exhausted = False
         self._failed = False
         self._prefetch_thread = threading.Thread(
@@ -251,7 +278,7 @@ class IndexShardingClient(ShardingClient):
         return indices or None
 
     def stop(self):
-        self._stopped = True
+        super().stop()
         try:
             # best-effort wakeup; consumers also poll _stopped on timeout,
             # so a full queue cannot deadlock the stopping thread

@@ -1,0 +1,74 @@
+"""Private host-local cache directories (shared hardening logic).
+
+Two subsystems persist host-local state that a restarted worker will
+TRUST: the XLA compile cache (deserialized executables,
+trainer/compile_cache.py) and the kernel tuning cache (block-size
+decisions, ops/tuning.py). Both live under world-writable roots
+(/dev/shm, /tmp), so both need the same two defenses:
+
+ - never adopt a directory owned by another uid (a pre-created trap
+   would let another local user seed entries we load);
+ - enforce the 0700 contract even on ADOPTED dirs — ``makedirs(mode=
+   0o700)`` applies the mode only on creation, so a pre-existing
+   same-uid dir with group/world access must be re-tightened (or
+   refused if that fails).
+"""
+
+import os
+import stat
+import tempfile
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def default_cache_base() -> str:
+    """tmpfs when available: survives process restarts, not host
+    replacement (a replacement host has different devices anyway)."""
+    return "/dev/shm" if os.path.isdir("/dev/shm") else (
+        tempfile.gettempdir()
+    )
+
+
+def ensure_private_dir(path: str) -> Optional[str]:
+    """Create-or-adopt ``path`` as a 0700 directory private to this
+    uid; returns the path, or None when it cannot be trusted.
+
+    Refuses foreign-owned dirs outright. A same-uid dir with group or
+    world bits set is re-tightened with chmod; if the chmod does not
+    stick (e.g. an ACL-restricted mount) the dir is refused rather
+    than used loose.
+    """
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.stat(path)
+    except OSError as e:
+        logger.error("cannot create cache dir %s: %s", path, e)
+        return None
+    if st.st_uid != os.getuid():
+        logger.error(
+            "cache dir %s is owned by uid %d (we are %d); refusing to "
+            "trust its contents",
+            path, st.st_uid, os.getuid(),
+        )
+        return None
+    if stat.S_IMODE(st.st_mode) & 0o077:
+        # adopted dir looser than the contract: tighten, then verify
+        try:
+            os.chmod(path, 0o700)
+            st = os.stat(path)
+        except OSError as e:
+            logger.error("chmod 0700 on cache dir %s failed: %s", path, e)
+            return None
+        if stat.S_IMODE(st.st_mode) & 0o077:
+            logger.error(
+                "cache dir %s remains group/world-accessible after "
+                "chmod; refusing to use it",
+                path,
+            )
+            return None
+        logger.warning(
+            "cache dir %s was group/world-accessible; tightened to 0700",
+            path,
+        )
+    return path

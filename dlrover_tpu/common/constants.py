@@ -147,6 +147,9 @@ class NodeEnv:
     # every worker incarnation on this host (trainer/compile_cache.py);
     # "off" disables
     COMPILE_CACHE_DIR = "DLROVER_TPU_COMPILE_CACHE_DIR"
+    # host-local persistent kernel tuning cache, co-located with the
+    # compile cache (ops/tuning.py); "off" disables persistence
+    TUNING_CACHE_DIR = "DLROVER_TPU_TUNING_CACHE_DIR"
 
 
 class TaskType:

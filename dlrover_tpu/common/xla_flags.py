@@ -4,7 +4,47 @@ One home for flag snippets every CPU-mesh entry point needs, so a
 tuning change cannot silently miss one of them.
 """
 
+import glob
 import os
+
+_FLAG_SUPPORT_CACHE = {}
+
+
+def _xla_flag_supported(name: str) -> bool:
+    """True when the installed jaxlib's XLA knows flag ``name``.
+
+    XLA ABORTS the whole process on an unknown flag in XLA_FLAGS
+    (parse_flags_from_env.cc), so an optional tuning flag must be
+    probed first. Flag names are compiled into the extension binary
+    verbatim; a byte scan answers without initializing any backend
+    (and without jax imports, which this module must avoid).
+    """
+    if name in _FLAG_SUPPORT_CACHE:
+        return _FLAG_SUPPORT_CACHE[name]
+    found = False
+    try:
+        import jaxlib
+
+        root = os.path.dirname(jaxlib.__file__)
+        needle = name.encode()
+        keep = len(needle) - 1
+        for so in glob.glob(os.path.join(root, "xla_extension*.so")):
+            tail = b""
+            with open(so, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 23)
+                    if not chunk:
+                        break
+                    if needle in tail + chunk[:keep] or needle in chunk:
+                        found = True
+                        break
+                    tail = chunk[-keep:]
+            if found:
+                break
+    except Exception:
+        found = False  # can't verify -> don't risk the abort
+    _FLAG_SUPPORT_CACHE[name] = found
+    return found
 
 
 def ensure_cpu_collective_timeout(seconds: int = 900) -> None:
@@ -14,9 +54,15 @@ def ensure_cpu_collective_timeout(seconds: int = 900) -> None:
     last seq shard does sp x the first's chunk work); on the virtual
     CPU test mesh the slow ranks arrive late enough to trip the
     terminator at long sequence. Host-emulation artifact only — TPU is
-    unaffected. Must run before the CPU backend initializes."""
+    unaffected. Must run before the CPU backend initializes. No-op on
+    jaxlib builds whose XLA predates the flag (the 40s terminator does
+    not exist there either)."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "collective_call_terminate" in flags:
+        return
+    if not _xla_flag_supported(
+        "xla_cpu_collective_call_terminate_timeout_seconds"
+    ):
         return
     os.environ["XLA_FLAGS"] = (
         flags

@@ -68,13 +68,6 @@ def mha_reference(
     return out, lse
 
 
-# s/p are [group*block_q, block_k] fp32 in VMEM; cap rows x block_k so
-# the block pair stays inside the ~16MB VMEM budget alongside the rest of
-# a fused train step (1024 rows x 1024 cols measured fastest in-model on
-# v5e: 50.2% MFU vs 48.5% for the best per-query-head-grid config)
-_ROWS_CAP = 1024
-
-
 @functools.partial(
     jax.jit, static_argnames=("causal", "scale", "block_q", "block_k")
 )
@@ -90,39 +83,38 @@ def flash_attention(
     """Memory-efficient attention: Pallas kernel on TPU, XLA elsewhere.
 
     Layout [batch, seq, heads, head_dim] (the models' native layout).
-    ``block_q``/``block_k`` cap the kernel block sizes (None = tuned
-    default); the GQA group folds into the kernel's matmul rows, so the
-    effective q-block is ``group * block_q`` rows.
+    ``block_q``/``block_k`` cap the kernel block sizes (None = tuned);
+    the GQA group folds into the kernel's matmul rows, so the effective
+    q-block is ``group * block_q`` rows.
+
+    Block selection lives in ops/tuning.py: the persisted on-device
+    autotuner answers from its cache (or measures once per shape per
+    host on TPU), with the old static largest-power-of-two heuristic
+    as the prior and the only path off-TPU. Selection runs at trace
+    time — by the time XLA sees the program the blocks are static.
     """
     if _use_pallas(q, k):
+        from dlrover_tpu.ops import tuning
         from dlrover_tpu.ops.pallas.flash_attention import (
             flash_attention_tpu,
         )
 
         seq = q.shape[1]
         g = q.shape[2] // k.shape[2]
-        # largest power-of-two block that tiles the sequence (the kernel's
-        # causal mask requires power-of-two block_q), never exceeding the
-        # caller's cap; q rows are bounded by the VMEM cap, and for high
-        # GQA ratios (g > 8, where even the 128-row-minimum q block
-        # overshoots _ROWS_CAP) block_k shrinks to keep the fp32 s/p
-        # blocks' rows*cols footprint constant
-        rows_min = 128 * g
-        bq_cap = min(block_q or _ROWS_CAP, max(_ROWS_CAP // g, 128))
-        bk_cap = min(
-            block_k or 1024,
-            max(128, _ROWS_CAP * 1024 // max(rows_min, _ROWS_CAP)),
+        blocks = tuning.get_blocks(
+            seq=seq,
+            head_dim=q.shape[3],
+            group=g,
+            dtype=jnp.dtype(q.dtype).name,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
         )
-        pow2 = (128, 256, 512, 1024)
-        bq_candidates = [b for b in pow2
-                         if seq % b == 0 and b <= bq_cap]
-        bk_candidates = [b for b in pow2
-                         if seq % b == 0 and b <= bk_cap]
-        if not bq_candidates or not bk_candidates:
+        if blocks is None:
             # caller capped blocks below the kernel's 128-lane minimum
             # (or nothing divides seq) — XLA path is always correct
             return mha_reference(q, k, v, causal=causal, scale=scale)
-        bq, bk = max(bq_candidates), max(bk_candidates)
+        bq, bk = blocks
         return flash_attention_tpu(
             q, k, v, causal=causal, scale=scale, block_q=bq, block_k=bk,
         )

@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from dlrover_tpu.parallel.compat import shard_map
 
 from dlrover_tpu.ops.attention import NEG_INF, mha_reference
 from dlrover_tpu.parallel.mesh import SEQ_AXIS, batch_axes

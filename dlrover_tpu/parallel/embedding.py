@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dlrover_tpu.parallel.compat import shard_map
 from dlrover_tpu.parallel.mesh import FSDP_AXIS, axis_size
 
 
@@ -96,7 +97,7 @@ def vocab_parallel_lookup(
         return jax.lax.psum(emb, shard_axis)
 
     batch_spec = batch_axes if batch_axes else None
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(shard_axis, None), P(batch_spec, None)),

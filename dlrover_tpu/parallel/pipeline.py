@@ -23,7 +23,7 @@ from typing import Any, Callable, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from dlrover_tpu.parallel.compat import shard_map
 
 from dlrover_tpu.parallel.mesh import PIPE_AXIS
 

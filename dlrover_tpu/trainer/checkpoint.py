@@ -359,11 +359,21 @@ class FlashCheckpointer:
                 return max(common)
             return None
         except Exception as e:
-            logger.warning(
+            # A consensus-collective failure must vote FRESH, never
+            # fall back to the host-local latest: if the allgather
+            # failed on only a subset of hosts, per-host "local
+            # latest" answers can differ while every host still votes
+            # success in the agreement gather — exactly the silent
+            # mixed-step restore this path exists to prevent. A
+            # recoverable checkpoint lost to a transient collective
+            # error costs a cold start; a mixed world corrupts the
+            # run.
+            logger.error(
                 "cross-process checkpoint consensus failed (%s); "
-                "using the local latest", e,
+                "voting for a fresh start — a partial collective "
+                "failure must not produce a mixed-step restore", e,
             )
-            return max(local_steps) if local_steps else None
+            return None
 
     def restore(self, target: Any = None, step: Optional[int] = None):
         """Restore (state, step), preferring the RAM tier.

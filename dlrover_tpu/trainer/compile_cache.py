@@ -25,9 +25,12 @@ on the real chip.
 """
 
 import os
-import tempfile
 from typing import Optional
 
+from dlrover_tpu.common.cachedir import (
+    default_cache_base,
+    ensure_private_dir,
+)
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
 
@@ -38,6 +41,26 @@ ENV_CACHE_DIR = NodeEnv.COMPILE_CACHE_DIR
 ENV_MIN_COMPILE_SECS = "DLROVER_TPU_COMPILE_CACHE_MIN_SECS"
 
 _DISABLED = ("off", "none", "0", "")
+#: force-arm the cache on a jax the safety gate would refuse
+ENV_FORCE = "DLROVER_TPU_COMPILE_CACHE_FORCE"
+
+
+def _persistent_cache_safe() -> bool:
+    """Old jaxlib builds (<0.6) SEGFAULT re-loading serialized
+    executables from the persistent cache (observed on 0.4.37: a
+    restarted worker dies rc=-11 at its first jit, turning the warm
+    path this cache exists to accelerate into a crash loop). Refuse to
+    arm the cache there; ``DLROVER_TPU_COMPILE_CACHE_FORCE=1``
+    overrides for builds known locally to be fine."""
+    if os.getenv(ENV_FORCE, "") == "1":
+        return True
+    import jax
+
+    try:
+        major, minor = (int(x) for x in jax.__version__.split(".")[:2])
+    except ValueError:
+        return True  # unparseable dev version: assume modern
+    return (major, minor) >= (0, 6)
 
 
 def default_cache_dir() -> str:
@@ -47,11 +70,8 @@ def default_cache_dir() -> str:
     fixed path under world-writable /dev/shm would let another local
     user pre-create it and seed attacker-controlled entries
     (setup_compilation_cache additionally enforces ownership+0700)."""
-    base = "/dev/shm" if os.path.isdir("/dev/shm") else (
-        tempfile.gettempdir()
-    )
     return os.path.join(
-        base, f"dlrover_tpu_compile_cache_{os.getuid()}"
+        default_cache_base(), f"dlrover_tpu_compile_cache_{os.getuid()}"
     )
 
 
@@ -73,17 +93,19 @@ def setup_compilation_cache(
     if cache_dir.strip().lower() in _DISABLED:
         logger.info("persistent compilation cache disabled")
         return None
-    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    if not _persistent_cache_safe():
+        logger.warning(
+            "persistent compilation cache disabled: this jax build "
+            "cannot reload serialized executables safely (set %s=1 "
+            "to override)", ENV_FORCE,
+        )
+        return None
     # entries are executables this process will LOAD: refuse a dir
     # someone else owns (exist_ok would happily adopt a pre-created
-    # trap under a shared /dev/shm or /tmp) — train cold instead
-    st = os.stat(cache_dir)
-    if st.st_uid != os.getuid():
-        logger.error(
-            "compilation cache dir %s is owned by uid %d (we are %d); "
-            "refusing to load executables from it — cache disabled",
-            cache_dir, st.st_uid, os.getuid(),
-        )
+    # trap under a shared /dev/shm or /tmp) and force 0700 on adopted
+    # dirs (common/cachedir.py) — train cold instead of trusting loose
+    if ensure_private_dir(cache_dir) is None:
+        logger.error("compilation cache disabled (untrusted dir)")
         return None
     import jax
 

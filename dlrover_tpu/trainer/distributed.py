@@ -98,11 +98,22 @@ def init_from_env(timeout_s: int = 300) -> DistributedEnv:
             env.coordinator_addr, env.num_processes, env.process_id,
             hb_timeout,
         )
-        jax.distributed.initialize(
+        kwargs = dict(
             coordinator_address=env.coordinator_addr,
             num_processes=env.num_processes,
             process_id=env.process_id,
             initialization_timeout=timeout_s,
             heartbeat_timeout_seconds=hb_timeout,
         )
+        import inspect
+
+        accepted = inspect.signature(
+            jax.distributed.initialize
+        ).parameters
+        if "heartbeat_timeout_seconds" not in accepted:
+            # pre-0.6 jax: the coordination service's default heartbeat
+            # applies; dropping the tuning knob beats not forming the
+            # world at all
+            kwargs.pop("heartbeat_timeout_seconds")
+        jax.distributed.initialize(**kwargs)
     return env

@@ -17,11 +17,32 @@ Two consumers:
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
 from dlrover_tpu.common.log import default_logger as logger
+
+# ---------------------------------------------------------------- tuning
+# Kernel-autotuning events (ops/tuning.py): each block-size decision —
+# cache hit, fresh measurement, or heuristic fallback — lands here so
+# the stats pipeline (and bench.py's JSON detail fields) can see what
+# the kernels actually ran with and what tuning cost at startup.
+
+_tuning_events: List[Dict[str, Any]] = []
+
+
+def record_tuning_event(**fields) -> None:
+    """Append one kernel-tuning decision (called by ops/tuning.py)."""
+    evt = dict(fields)
+    evt.setdefault("time", time.time())
+    _tuning_events.append(evt)
+    logger.info("kernel tuning event: %s", evt)
+
+
+def tuning_events() -> List[Dict[str, Any]]:
+    """All tuning decisions made by this process, oldest first."""
+    return list(_tuning_events)
 
 
 @dataclass

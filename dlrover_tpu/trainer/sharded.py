@@ -25,6 +25,19 @@ from dlrover_tpu.parallel import sharding as shd
 from dlrover_tpu.parallel.mesh import create_mesh
 
 
+def _donation_reshards_safely() -> bool:
+    """True when this jax can donate an input whose sharding differs
+    from the output's (resharding donation landed around 0.6; before
+    that XLA fails the compile with an INTERNAL aliasing error)."""
+    try:
+        major, minor = (
+            int(x) for x in jax.__version__.split(".")[:2]
+        )
+    except ValueError:
+        return True  # unparseable dev version: assume modern
+    return (major, minor) >= (0, 6)
+
+
 class ShardedTrainer:
     """Builds sharded init / train-step functions for a pytree model.
 
@@ -179,9 +192,16 @@ class ShardedTrainer:
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
+        # pre-0.6 jax cannot alias a donated input whose sharding
+        # differs from the out_sharding (XLA INTERNAL error at compile
+        # time), and callers legitimately pass replicated params into
+        # a sharded-output step (first step after init/restore) —
+        # donation is a memory optimization, correctness must not
+        # depend on it
+        donate = (0, 1) if _donation_reshards_safely() else ()
         self._jit_step = jax.jit(
             step,
-            donate_argnums=(0, 1),
+            donate_argnums=donate,
             out_shardings=(
                 self.param_shardings, self.opt_shardings, None,
             ),

@@ -15,6 +15,16 @@ os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")
 # subprocesses spawned by tests (agents, probes) must also land on CPU
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+# older jax has no jax_num_cpu_devices config option; the XLA flag
+# spells the same 8-device request in a form every version honors,
+# and MUST land in the env before jax imports (backend init reads it)
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 # XLA CPU kills a collective when participants arrive >40s apart;
 # causal ring attention at 16k trips it (see common/xla_flags.py)
 from dlrover_tpu.common.xla_flags import ensure_cpu_collective_timeout
@@ -24,7 +34,10 @@ ensure_cpu_collective_timeout()
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # pre-0.4.38 jax: the XLA_FLAGS fallback above covers it
 
 
 # -- CI shard policy (pyproject [tool.pytest.ini_options] markers) --------

@@ -7,9 +7,38 @@ import pytest
 from dlrover_tpu.trainer import compile_cache
 
 
+_REAL_SAFE_GATE = compile_cache._persistent_cache_safe
+
+
+@pytest.fixture(autouse=True)
+def _cache_load_safe(monkeypatch):
+    """Dir/permission logic under test is version-independent; pin the
+    executable-reload safety gate open so these tests run the same on
+    every jax (the gate itself is covered below)."""
+    monkeypatch.setattr(
+        compile_cache, "_persistent_cache_safe", lambda: True
+    )
+
+
 def test_disabled_values(monkeypatch):
     for v in ("off", "none", "0"):
         assert compile_cache.setup_compilation_cache(v) is None
+
+
+def test_unsafe_jax_build_refuses_cache(tmp_path, monkeypatch):
+    """A jax build that segfaults reloading serialized executables
+    must not get the cache armed (restarted workers would crash-loop);
+    the force env re-arms it."""
+    monkeypatch.setattr(
+        compile_cache, "_persistent_cache_safe", _REAL_SAFE_GATE
+    )
+    import jax
+
+    monkeypatch.setattr(jax, "__version__", "0.4.37")
+    d = str(tmp_path / "unsafe")
+    assert compile_cache.setup_compilation_cache(d) is None
+    monkeypatch.setenv(compile_cache.ENV_FORCE, "1")
+    assert compile_cache.setup_compilation_cache(d) == d
 
 
 def test_env_resolution_and_perms(tmp_path, monkeypatch):
@@ -42,6 +71,17 @@ def test_foreign_owned_dir_refused(tmp_path, monkeypatch):
         if p == d else real_stat(p, *a, **k),
     )
     assert compile_cache.setup_compilation_cache(d) is None
+
+
+def test_adopted_loose_dir_tightened_to_0700(tmp_path):
+    """makedirs(mode=0o700) only applies on creation: a pre-existing
+    same-uid dir with group/world access must be re-tightened before
+    executables are loaded from it (the documented 0700 contract)."""
+    d = str(tmp_path / "loose")
+    os.makedirs(d, mode=0o755)
+    os.chmod(d, 0o755)  # defeat umask
+    assert compile_cache.setup_compilation_cache(d) == d
+    assert (os.stat(d).st_mode & 0o777) == 0o700
 
 
 def test_default_dir_is_per_uid():

@@ -39,6 +39,14 @@ def _read_timings(path):
 
 
 def test_warm_restart_beats_cold_via_compile_cache():
+    from dlrover_tpu.trainer import compile_cache
+
+    if not compile_cache._persistent_cache_safe():
+        pytest.skip(
+            "this jax build cannot reload serialized executables; the "
+            "safety gate keeps the cache off, so there is no warm "
+            "path to measure"
+        )
     with tempfile.TemporaryDirectory() as tmp:
         out_file = os.path.join(tmp, "result.txt")
         timing_file = os.path.join(tmp, "timing.csv")
