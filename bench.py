@@ -278,14 +278,21 @@ def main():
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step / step_time
     flops_per_tok = llama.flops_per_token(cfg, seq)
-    achieved = tokens_per_sec * flops_per_tok
+    model_flops_per_step = tokens_per_step * flops_per_tok
     peak = peak_flops_per_chip(dev)
-    mfu = 100.0 * achieved / peak if on_tpu else 0.0
 
-    # XLA-counted program stats (trainer/profiler.py). NOTE: the
-    # backend's flop counter excludes custom-call (Pallas) kernels, so
-    # these are reported raw, not as an HFU claim.
     from dlrover_tpu.trainer import profiler
+
+    # MFU: analytic model flops over the measured step time (the
+    # headline); HFU: the XLA-counted hardware flops (remat recompute
+    # included) over the same denominator. CAVEAT on HFU: the backend
+    # flop counter excludes custom-call (Pallas) kernels, so on the
+    # flash-attention path it UNDERCOUNTS — reported as a floor, not a
+    # claim. Off-TPU both are 0 (peak undefined).
+    mfu = (
+        profiler.utilization(model_flops_per_step, step_time, peak)
+        if on_tpu else 0.0
+    )
 
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -293,6 +300,10 @@ def main():
     )
     prof = profiler.profile_step(
         trainer.train_step, *abstract, params=params
+    )
+    hfu = (
+        profiler.utilization(prof.flops, step_time, peak)
+        if on_tpu else 0.0
     )
 
     # which flash-attention blocks the step actually ran with, and
@@ -307,6 +318,9 @@ def main():
         "value": round(mfu, 2),
         "unit": "%",
         "vs_baseline": round(mfu / BASELINE_HFU_PERCENT, 3),
+        "mfu_percent": round(mfu, 2),
+        "hfu_percent": round(hfu, 2),
+        "model_flops_per_step": model_flops_per_step,
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "step_time_ms": round(step_time * 1e3, 1),
         "params_m": round(llama.param_count(cfg) / 1e6, 1),

@@ -39,6 +39,8 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.grpc_utils import find_free_port
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, record
+from dlrover_tpu.telemetry.http import start_metrics_server
 
 
 @dataclass
@@ -183,6 +185,9 @@ class ElasticTrainingAgent:
         self._remaining_restarts = config.max_restarts
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._restart_requested = threading.Event()
+        # per-host scrape point (the master serves its own): ephemeral
+        # port unless DLROVER_TPU_METRICS_PORT pins/disables it
+        self._metrics_server = start_metrics_server()
 
     def _start_heartbeat(self, interval: float = 15.0):
         """Feed the master's liveness watchdog and act on the directive
@@ -289,7 +294,9 @@ class ElasticTrainingAgent:
                         "Restarting workers (%d restarts left)",
                         self._remaining_restarts,
                     )
-                    self._restart_workers()
+                    self._restart_workers(
+                        "process_failure", rc=result.return_code
+                    )
                 else:
                     return result
             elif self._restart_requested.is_set():
@@ -297,12 +304,12 @@ class ElasticTrainingAgent:
                 logger.info(
                     "Restarting workers on master action (hang recovery)"
                 )
-                self._restart_workers()
+                self._restart_workers("master_action")
             elif self._membership_changed():
                 logger.info(
                     "Membership changed; re-rendezvous without job restart"
                 )
-                self._restart_workers()
+                self._restart_workers("membership_change")
         return RunResult(WorkerState.SUCCEEDED)
 
     def _initialize_workers(self):
@@ -312,6 +319,12 @@ class ElasticTrainingAgent:
         logger.info(
             "Round %d world=%s -> process_id=%d/%d coordinator=%s",
             rdzv_round, world, process_id, num_processes, coordinator,
+        )
+        record(
+            "rendezvous.joined", round=rdzv_round,
+            node_rank=self._config.node_rank, world=sorted(world),
+            process_id=process_id, num_processes=num_processes,
+            restart_count=self._restart_count,
         )
         env = dict(os.environ)
         env.update(self._config.env)
@@ -373,7 +386,16 @@ class ElasticTrainingAgent:
         (parity: training.py:446)."""
         return self._client.num_nodes_waiting() > 0
 
-    def _restart_workers(self):
+    def _restart_workers(self, reason: str = "unspecified", **extra):
+        counter(
+            "dlrover_agent_worker_restarts_total",
+            "Training-process restarts by trigger", ["reason"],
+        ).labels(reason=reason).inc()
+        record(
+            "scale.restart", reason=reason,
+            node_rank=self._config.node_rank,
+            restart_count=self._restart_count, **extra,
+        )
         self._kill_workers()
         self._initialize_workers()
 
@@ -409,6 +431,9 @@ class ElasticTrainingAgent:
     def stop(self):
         self._stopped = True
         self._kill_workers()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
 
 
 def launch_agent(config: ElasticLaunchConfig,

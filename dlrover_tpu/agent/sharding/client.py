@@ -13,6 +13,7 @@ from typing import Callable, Optional
 from dlrover_tpu.agent.master_client import get_master_client
 from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter
 
 #: default ceiling on one fetch_shard WAIT poll. The master's task
 #: watchdog requeues a dead peer's shard within its task timeout
@@ -97,6 +98,13 @@ class ShardingClient:
                 self._dataset_name, incarnation=self._incarnation
             )
             if task is not None and task.task_type == TaskType.WAIT:
+                # a sustained climb here = workers starving on a peer's
+                # in-flight shard (dead peer / stuck watchdog)
+                counter(
+                    "dlrover_shard_wait_polls_total",
+                    "WAIT answers received while polling for a shard",
+                    ["dataset"],
+                ).labels(dataset=self._dataset_name).inc()
                 if self._stopped:
                     return None
                 if deadline is not None and time.monotonic() > deadline:

@@ -27,6 +27,7 @@ from statistics import median
 from typing import Callable, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, record
 
 
 class HangingDetector:
@@ -134,6 +135,15 @@ class HangingDetector:
         logger.error(
             "Training hang: no step since step %d for %.1fs "
             "(threshold %.1fs)", step, elapsed, self.timeout(),
+        )
+        counter(
+            "dlrover_hang_stalls_total",
+            "Stalls the step-progress hang detector flagged",
+        ).inc()
+        record(
+            "hang.detected", step=step,
+            stalled_s=round(elapsed, 1),
+            threshold_s=round(self.timeout(), 1),
         )
         if self._report_fn is not None:
             self._report_fn(elapsed)

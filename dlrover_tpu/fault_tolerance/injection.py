@@ -39,6 +39,7 @@ from typing import List, Optional
 
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import record
 
 ENV_SPEC = "DLROVER_FAULT_INJECT"
 KV_PREFIX = "fault_inject"
@@ -151,6 +152,12 @@ class FaultInjector:
         logger.warning(
             "FAULT INJECTION: %s at step %d (arg=%r)",
             fault.kind, step, fault.arg,
+        )
+        # journaled BEFORE executing: crash/preempt never return, and
+        # the drill's timeline needs the cause ahead of the effect
+        record(
+            "fault.injected", fault=fault.kind, step=step,
+            arg=fault.arg, node_rank=self._node_rank,
         )
         if fault.kind == "crash":
             rc = int(fault.arg) if fault.arg else 17

@@ -32,6 +32,7 @@ from dlrover_tpu.master.stats import (
     JobMeta,
     LocalStatsReporter,
 )
+from dlrover_tpu.telemetry.http import start_metrics_server
 
 
 class DistributedJobMaster:
@@ -126,11 +127,16 @@ class DistributedJobMaster:
         self.port = self._server.port
         self._exit_code = 0
         self._exit_reason = ""
+        self._metrics_server = None
         self._wire_callbacks()
 
     @property
     def addr(self) -> str:
         return f"localhost:{self.port}"
+
+    @property
+    def metrics_port(self) -> int:
+        return self._metrics_server.port if self._metrics_server else 0
 
     def _wire_callbacks(self):
         """parity: event_callback.py — node events fan out to task
@@ -165,6 +171,9 @@ class DistributedJobMaster:
         self.task_manager.start()
         self.auto_scaler.start_auto_scaling()
         self._server.start()
+        # Prometheus /metrics + /journal (telemetry/http.py);
+        # DLROVER_TPU_METRICS_PORT pins the port, "off" disables
+        self._metrics_server = start_metrics_server()
         logger.info("Distributed master serving on port %d", self.port)
 
     def run(self, check_interval: float = 3.0) -> int:
@@ -228,3 +237,6 @@ class DistributedJobMaster:
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop(grace=1.0)
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None

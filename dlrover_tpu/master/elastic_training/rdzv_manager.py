@@ -20,6 +20,38 @@ from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import NetworkFailureReason
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, gauge, histogram, record
+
+#: seconds from first join to round completion: sub-second same-host
+#: re-forms up to multi-minute fleet-wide cold starts
+_ROUND_BUCKETS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+    600.0,
+)
+
+
+def _observe_round(name: str, rdzv_round: int, world: Dict[int, int],
+                   started_ts: float) -> None:
+    """One completed rendezvous round -> histogram + journal."""
+    duration = max(0.0, time.time() - started_ts) if started_ts else 0.0
+    counter(
+        "dlrover_rdzv_rounds_total",
+        "Completed rendezvous rounds", ["name"],
+    ).labels(name=name).inc()
+    histogram(
+        "dlrover_rdzv_round_duration_seconds",
+        "First join to round completion", ["name"],
+        buckets=_ROUND_BUCKETS,
+    ).labels(name=name).observe(duration)
+    gauge(
+        "dlrover_rdzv_world_size",
+        "Node count of the latest completed round", ["name"],
+    ).labels(name=name).set(len(world))
+    record(
+        "rendezvous.complete", name=name, round=rdzv_round,
+        nodes=sorted(world), world_size=len(world),
+        duration_s=round(duration, 3),
+    )
 
 
 class RendezvousParameters:
@@ -204,6 +236,10 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
                     "Rendezvous round %d complete: nodes %s",
                     self._rdzv_round, list(self._rdzv_nodes),
                 )
+                _observe_round(
+                    "training", self._rdzv_round, self._rdzv_nodes,
+                    self._start_rdzv_ts,
+                )
             # a node that has re-joined is waiting for the NEXT round —
             # never hand it the stale world it used to belong to
             if (
@@ -257,6 +293,10 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             if world is not None:
                 self._rdzv_round += 1
                 self._rdzv_nodes = dict(sorted(world.items()))
+                _observe_round(
+                    "network_check", self._rdzv_round,
+                    self._rdzv_nodes, self._start_rdzv_ts,
+                )
                 # bounded history, NOT a cycle clear: a new cohort's
                 # check (replacement/restored nodes probing each
                 # other) must not wipe other nodes' verdicts — a

@@ -22,6 +22,7 @@ from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.node.local_job_manager import LocalJobManager
 from dlrover_tpu.master.servicer import create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.telemetry.http import start_metrics_server
 
 
 class LocalJobMaster:
@@ -49,15 +50,23 @@ class LocalJobMaster:
         self.port = self._server.port
         self._exit_code = 0
         self._exit_reason = ""
+        self._metrics_server = None
 
     @property
     def addr(self) -> str:
         return f"localhost:{self.port}"
 
+    @property
+    def metrics_port(self) -> int:
+        return self._metrics_server.port if self._metrics_server else 0
+
     def prepare(self):
         self.job_manager.start()
         self.task_manager.start()
         self._server.start()
+        # Prometheus /metrics + /journal (telemetry/http.py);
+        # DLROVER_TPU_METRICS_PORT pins the port, "off" disables
+        self._metrics_server = start_metrics_server()
         logger.info("Local master serving on port %d", self.port)
 
     def run(self, check_interval: float = 3.0) -> int:
@@ -96,3 +105,6 @@ class LocalJobMaster:
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop(grace=1.0)
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None

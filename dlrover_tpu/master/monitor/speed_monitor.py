@@ -9,6 +9,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
 from dlrover_tpu.common.global_context import Context
+from dlrover_tpu.telemetry import gauge
 
 _context = Context.singleton_instance()
 
@@ -45,9 +46,17 @@ class SpeedMonitor:
 
     def add_running_worker(self, node_type: str, node_id: int):
         self._workers.add((node_type, node_id))
+        gauge(
+            "dlrover_training_workers",
+            "Workers the speed monitor counts as running",
+        ).set(len(self._workers))
 
     def remove_running_worker(self, node_type: str, node_id: int):
         self._workers.discard((node_type, node_id))
+        gauge(
+            "dlrover_training_workers",
+            "Workers the speed monitor counts as running",
+        ).set(len(self._workers))
 
     @property
     def running_workers(self):
@@ -84,6 +93,16 @@ class SpeedMonitor:
         self._sample_count += 1
         if len(self._global_step_records) > self._max_record_count:
             self._global_step_records.pop(0)
+        # scrape-able training telemetry: the same numbers the scaler
+        # and hang watchdog act on, visible at GET /metrics
+        gauge(
+            "dlrover_training_steps_per_second",
+            "Windowed global-step throughput (speed monitor)",
+        ).set(self.running_speed())
+        gauge(
+            "dlrover_training_global_step",
+            "Max global step reported to the master",
+        ).set(self._global_step)
 
     def collect_batch_done(self, batches: int, timestamp: float):
         """Shard-fed jobs with INDEPENDENT workers (the reference's

@@ -235,6 +235,22 @@ class AllreduceTrainingAutoScaler:
                 len(scale_plan.launch_nodes),
                 len(scale_plan.remove_nodes), plan.comment,
             )
+            from dlrover_tpu.telemetry import counter, record
+
+            direction = (
+                "up" if len(scale_plan.launch_nodes)
+                >= len(scale_plan.remove_nodes) else "down"
+            )
+            counter(
+                "dlrover_scale_plans_total",
+                "Executed scale plans", ["direction"],
+            ).labels(direction=direction).inc()
+            record(
+                "scale.plan", direction=direction,
+                launch=len(scale_plan.launch_nodes),
+                remove=len(scale_plan.remove_nodes),
+                comment=str(plan.comment)[:200],
+            )
             self._scaler.scale(scale_plan)
         return scale_plan
 

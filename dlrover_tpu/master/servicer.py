@@ -22,6 +22,13 @@ from dlrover_tpu.master.elastic_training.kv_store_service import (
     KVStoreService,
 )
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
+from dlrover_tpu.telemetry import counter, histogram, record
+
+#: sub-millisecond KV polls up to multi-second shard waits
+_RPC_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0,
+)
 
 
 class MasterServicer:
@@ -55,8 +62,30 @@ class MasterServicer:
     def handle(self, method: str, message):
         fn = getattr(self, f"rpc_{method}", None)
         if fn is None:
+            counter(
+                "dlrover_rpc_errors_total",
+                "RPCs that raised in the servicer", ["method"],
+            ).labels(method=method).inc()
             raise ValueError(f"unknown RPC method {method}")
-        return fn(message)
+        counter(
+            "dlrover_rpc_requests_total",
+            "RPCs dispatched by the master servicer", ["method"],
+        ).labels(method=method).inc()
+        t0 = time.perf_counter()
+        try:
+            return fn(message)
+        except Exception:
+            counter(
+                "dlrover_rpc_errors_total",
+                "RPCs that raised in the servicer", ["method"],
+            ).labels(method=method).inc()
+            raise
+        finally:
+            histogram(
+                "dlrover_rpc_latency_seconds",
+                "Master-side RPC handling latency", ["method"],
+                buckets=_RPC_BUCKETS,
+            ).labels(method=method).observe(time.perf_counter() - t0)
 
     # ------------------------------------------------------------ sharding
 
@@ -222,6 +251,10 @@ class MasterServicer:
                 success=False, reason="no auto scaler (local master?)"
             )
         ok = self._auto_scaler.manual_scale(req.node_num)
+        record(
+            "scale.request", source="rpc", node_num=req.node_num,
+            accepted=bool(ok),
+        )
         return comm.Response(success=bool(ok))
 
     # ------------------------------------------------------------- kv store
@@ -280,6 +313,12 @@ class MasterServicer:
         return comm.HeartbeatResponse(action=action)
 
     def rpc_report_failure(self, req: comm.NodeFailure) -> comm.Response:
+        record(
+            "fault.reported", node_type=req.node_type,
+            node_id=req.node_id, level=req.level,
+            restart_count=req.restart_count,
+            error=str(req.error_data)[:200],
+        )
         node = None
         if self._job_manager:
             node = self._job_manager.get_node(req.node_type, req.node_id)

@@ -410,11 +410,22 @@ def _record(key: TuningKey, blocks: Tuple[int, int], source: str,
                source=source)
     _last_selection = sel
     try:  # tuning telemetry must never take the hot path down
+        from dlrover_tpu.telemetry import counter, histogram
         from dlrover_tpu.trainer import profiler
 
         profiler.record_tuning_event(
             **sel, tuning_seconds=round(elapsed_s, 3)
         )
+        counter(
+            "dlrover_tuning_decisions_total",
+            "Kernel block-size decisions by provenance", ["source"],
+        ).labels(source=source).inc()
+        if source == "measured":
+            histogram(
+                "dlrover_tuning_sweep_seconds",
+                "On-device candidate-sweep wall time",
+                buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+            ).observe(elapsed_s)
     except Exception:
         pass
 

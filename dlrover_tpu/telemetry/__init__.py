@@ -1,0 +1,53 @@
+"""Unified telemetry: metrics registry, event journal, exposition.
+
+Three pieces, one substrate (ISSUE 2):
+
+  * :mod:`~dlrover_tpu.telemetry.registry` — thread-safe counters,
+    gauges, and label-aware histograms with Prometheus text + JSON
+    exposition;
+  * :mod:`~dlrover_tpu.telemetry.journal` — append-only structured
+    JSONL event journal (monotonic seq, wall time, host/process
+    attribution) all control-plane events write through;
+  * :mod:`~dlrover_tpu.telemetry.http` — the stdlib ``/metrics`` +
+    ``/journal`` endpoint the master and agents serve;
+  * ``python -m dlrover_tpu.telemetry.dump`` renders a journal into a
+    human-readable timeline.
+"""
+
+from dlrover_tpu.telemetry.journal import (
+    EventJournal,
+    configure,
+    default_journal,
+    read_journal,
+    record,
+    set_default_journal,
+)
+from dlrover_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    default_registry,
+    gauge,
+    histogram,
+    set_default_registry,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "EventJournal",
+    "counter",
+    "gauge",
+    "histogram",
+    "record",
+    "configure",
+    "default_registry",
+    "default_journal",
+    "set_default_registry",
+    "set_default_journal",
+    "read_journal",
+]

@@ -1,0 +1,93 @@
+"""``python -m dlrover_tpu.telemetry.dump`` — render a journal timeline.
+
+Turns the JSONL event journal (telemetry/journal.py) into a
+human-readable incident timeline: one line per event, wall-clock
+ordered across processes, with the delta to the previous event so
+stalls stand out. ``--kind`` filters (prefix match on dotted kinds),
+``--json`` re-emits the ordered events as JSONL (for piping into jq
+after the multi-process sort).
+
+Example::
+
+    $ python -m dlrover_tpu.telemetry.dump /tmp/job.journal
+    2026-08-04 10:00:01.202 +0.000s [host-0 p0] rendezvous.complete  round=1 nodes=[0, 1] duration_s=2.1
+    2026-08-04 10:00:43.910 +42.708s [host-0 p0] checkpoint.save     tier=ram step=100 ms=18.2
+"""
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.telemetry.journal import read_journal
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.3f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def format_event(event: Dict, prev_ts: Optional[float] = None) -> str:
+    ts = event.get("ts", 0.0)
+    stamp = time.strftime(
+        "%Y-%m-%d %H:%M:%S", time.localtime(ts)
+    ) + f".{int((ts % 1) * 1000):03d}"
+    delta = "" if prev_ts is None else f" +{ts - prev_ts:.3f}s"
+    proc = event.get("proc")
+    who = f"{event.get('host', '?')} p{proc if proc is not None else '?'}"
+    data = event.get("data") or {}
+    payload = " ".join(
+        f"{k}={_fmt_value(v)}" for k, v in data.items()
+    )
+    kind = event.get("kind", "?")
+    return f"{stamp}{delta} [{who}] {kind:<22s} {payload}".rstrip()
+
+
+def render(events: List[Dict], kind: Optional[str] = None,
+           as_json: bool = False) -> str:
+    if kind:
+        events = [
+            e for e in events
+            if e.get("kind") == kind
+            or str(e.get("kind", "")).startswith(kind + ".")
+        ]
+    if as_json:
+        return "\n".join(json.dumps(e, default=str) for e in events)
+    lines = []
+    prev: Optional[float] = None
+    for e in events:
+        lines.append(format_event(e, prev))
+        prev = e.get("ts", prev)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dlrover_tpu.telemetry.dump",
+        description="Render an event journal as a readable timeline",
+    )
+    ap.add_argument("journal", help="path to the JSONL journal file")
+    ap.add_argument("--kind", default=None,
+                    help="filter by event kind (dotted-prefix match)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit ordered JSONL instead of the timeline")
+    args = ap.parse_args(argv)
+    try:
+        events = read_journal(args.journal)
+    except OSError as e:
+        print(f"cannot read {args.journal}: {e}", file=sys.stderr)
+        return 2
+    out = render(events, kind=args.kind, as_json=args.as_json)
+    if out:
+        print(out)
+    print(
+        f"-- {len(events)} events"
+        + (f" (filter: {args.kind})" if args.kind else ""),
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""Tiny stdlib HTTP endpoint serving the telemetry surface.
+
+Runs on the master and on each agent (a scraper federates the fleet by
+hitting every host). Three routes:
+
+  * ``GET /metrics``  — Prometheus text exposition of the registry;
+  * ``GET /metrics.json`` — the same snapshot as JSON (tests/bench);
+  * ``GET /journal``  — the in-memory tail of the event journal
+    (``?n=50`` bounds it; ``?kind=checkpoint`` filters by kind prefix);
+  * ``GET /healthz``  — liveness probe.
+
+stdlib ``ThreadingHTTPServer`` on a daemon thread: no dependency, no
+lifecycle coupling — the process exiting takes the server with it, and
+``stop()`` exists for tests. Port 0 binds an ephemeral port (read
+``.port`` after ``start()``); ``DLROVER_TPU_METRICS_PORT=off`` disables
+the servers the master/agent start by default.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import journal as journal_mod
+from dlrover_tpu.telemetry import registry as registry_mod
+
+ENV_METRICS_PORT = "DLROVER_TPU_METRICS_PORT"
+
+_DISABLED = ("off", "none", "-1")
+
+__all__ = [
+    "ENV_METRICS_PORT",
+    "MetricsServer",
+    "start_metrics_server",
+]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dlrover-tpu-telemetry/1"
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API name)
+        url = urlparse(self.path)
+        reg = self.server.registry  # type: ignore[attr-defined]
+        jr = self.server.journal  # type: ignore[attr-defined]
+        if url.path == "/metrics":
+            body = reg.to_prometheus_text().encode()
+            # the content type Prometheus scrapers negotiate for the
+            # text format
+            self._send(
+                200, body,
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        elif url.path == "/metrics.json":
+            self._send(
+                200, reg.to_json().encode(), "application/json"
+            )
+        elif url.path == "/journal":
+            q = parse_qs(url.query)
+            kind = (q.get("kind") or [None])[0]
+            try:
+                n = int((q.get("n") or ["100"])[0])
+            except ValueError:
+                n = 100
+            events = jr.events(kind)[-max(0, n):] if jr else []
+            self._send(
+                200, json.dumps(events, default=str).encode(),
+                "application/json",
+            )
+        elif url.path == "/healthz":
+            self._send(200, b"ok\n", "text/plain")
+        else:
+            self._send(404, b"not found\n", "text/plain")
+
+    def log_message(self, format, *args):
+        # scrapes every few seconds must not spam the job log
+        pass
+
+
+class MetricsServer:
+    """Threaded exposition server over a registry (+ journal tail)."""
+
+    def __init__(
+        self,
+        registry: Optional[registry_mod.MetricsRegistry] = None,
+        journal: Optional[journal_mod.EventJournal] = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self._registry = registry or registry_mod.default_registry()
+        self._journal = journal or journal_mod.default_journal()
+        self._host = host
+        self._requested_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return (
+            self._httpd.server_address[1]
+            if self._httpd else self._requested_port
+        )
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self._httpd.registry = self._registry  # type: ignore[attr-defined]
+        self._httpd.journal = self._journal  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            daemon=True,
+            name="telemetry-http",
+        )
+        self._thread.start()
+        logger.info("telemetry endpoint on port %d (/metrics)", self.port)
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def start_metrics_server(
+    default_port: int = 0,
+    registry: Optional[registry_mod.MetricsRegistry] = None,
+    journal: Optional[journal_mod.EventJournal] = None,
+) -> Optional[MetricsServer]:
+    """Start the exposition endpoint honoring the env contract:
+    ``DLROVER_TPU_METRICS_PORT`` overrides the port, ``off`` disables.
+    Returns None when disabled or the bind fails — telemetry must never
+    take the master/agent down."""
+    import os
+
+    raw = os.getenv(ENV_METRICS_PORT, "").strip().lower()
+    if raw in _DISABLED:
+        return None
+    port = default_port
+    if raw:
+        try:
+            port = int(raw)
+        except ValueError:
+            logger.warning(
+                "%s=%r not a port; using %d", ENV_METRICS_PORT, raw,
+                default_port,
+            )
+    try:
+        return MetricsServer(
+            registry=registry, journal=journal, port=port
+        ).start()
+    except OSError as e:
+        logger.warning("telemetry endpoint failed to bind: %s", e)
+        return None

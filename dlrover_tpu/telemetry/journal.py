@@ -1,0 +1,202 @@
+"""Structured event journal: append-only JSONL with monotonic sequence.
+
+Every consequential control-plane and training-plane event — rendezvous
+rounds, scale actions, checkpoint save/restore, compile-cache state,
+kernel-tuning decisions, hang detections, fault injections — writes
+through here, so failure attribution after a restart reads one ordered
+timeline instead of grepping stderr across processes (the ElasWave /
+HSDP-at-100k lesson: elastic decisions are only auditable if the events
+that drove them are durable and ordered).
+
+Envelope per event (payload nested under ``data`` so domain fields —
+a tuning key's ``seq``, say — can never collide with the envelope)::
+
+    {"seq": 17, "ts": 1754300000.123, "host": "tpu-vm-3", "pid": 4242,
+     "proc": 2, "kind": "checkpoint.save", "data": {...payload}}
+
+``seq`` is monotonic PER PROCESS (the writer); ``ts`` is wall time;
+``proc`` is the JAX process index when known (the agent's NodeEnv
+contract, or :func:`dlrover_tpu.common.log.set_process_index` after
+``jax.distributed`` init). Multiple processes may append to one file:
+each event is a single ``os.write`` on an ``O_APPEND`` fd, which POSIX
+keeps atomic for these line sizes, and the dump CLI orders by ``ts``
+with ``(pid, seq)`` as the tiebreak.
+
+A bounded in-memory ring always mirrors the tail (tests and the
+``/journal`` HTTP view read it without touching disk); the JSONL file
+is written only when a path is configured — ``DLROVER_TPU_JOURNAL``
+in the env, or :func:`configure`. The env route is deliberate: the
+launcher exports it once and master, agent, and trainer all inherit
+the same timeline file.
+"""
+
+import json
+import os
+import socket
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.log import current_process_index
+from dlrover_tpu.common.log import default_logger as logger
+
+ENV_JOURNAL = "DLROVER_TPU_JOURNAL"
+
+__all__ = [
+    "ENV_JOURNAL",
+    "EventJournal",
+    "default_journal",
+    "set_default_journal",
+    "configure",
+    "record",
+    "read_journal",
+]
+
+
+class EventJournal:
+    """Append-only structured event sink (memory ring + optional JSONL)."""
+
+    def __init__(self, path: Optional[str] = None, capacity: int = 4096):
+        self.path = path
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._ring: deque = deque(maxlen=capacity)
+        self._fd: Optional[int] = None
+        self._host = socket.gethostname()
+        if path:
+            try:
+                os.makedirs(
+                    os.path.dirname(os.path.abspath(path)), exist_ok=True
+                )
+                self._fd = os.open(
+                    path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+                )
+            except OSError as e:
+                logger.warning(
+                    "event journal %s unavailable (%s); memory-only",
+                    path, e,
+                )
+                self.path = None
+
+    def record(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Append one event; returns the full envelope dict. Never
+        raises — telemetry must not take the instrumented path down."""
+        with self._lock:
+            self._seq += 1
+            event = {
+                "seq": self._seq,
+                "ts": __import__("time").time(),
+                "host": self._host,
+                "pid": os.getpid(),
+                "proc": current_process_index(),
+                "kind": kind,
+                "data": dict(fields),
+            }
+            self._ring.append(event)
+            if self._fd is not None:
+                try:
+                    line = json.dumps(event, default=str) + "\n"
+                    os.write(self._fd, line.encode())
+                except OSError as e:
+                    logger.warning(
+                        "journal write failed (%s); memory-only from "
+                        "here", e,
+                    )
+                    try:
+                        os.close(self._fd)
+                    except OSError:
+                        pass
+                    self._fd = None
+        return event
+
+    def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        """In-memory tail, oldest first; ``kind`` filters exact or by
+        dotted prefix (``"checkpoint"`` matches ``"checkpoint.save"``)."""
+        with self._lock:
+            evts = list(self._ring)
+        if kind is None:
+            return evts
+        return [
+            e for e in evts
+            if e["kind"] == kind or e["kind"].startswith(kind + ".")
+        ]
+
+    def tail(self, n: int = 100) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def close(self):
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+                self._fd = None
+
+
+_default_lock = threading.Lock()
+_default: Optional[EventJournal] = None
+
+
+def default_journal() -> EventJournal:
+    """The process-wide journal; file-backed iff ``DLROVER_TPU_JOURNAL``
+    is set when first touched."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = EventJournal(os.getenv(ENV_JOURNAL) or None)
+        return _default
+
+
+def set_default_journal(
+    journal: Optional[EventJournal],
+) -> EventJournal:
+    """Swap the process default (tests); None re-reads the env."""
+    global _default
+    with _default_lock:
+        # explicit None test: an EMPTY journal is falsy (__len__), and
+        # `journal or ...` would silently discard a fresh file-backed one
+        if journal is None:
+            journal = EventJournal(os.getenv(ENV_JOURNAL) or None)
+        _default = journal
+        return _default
+
+
+def configure(path: Optional[str],
+              capacity: int = 4096) -> EventJournal:
+    """Point the default journal at ``path`` (masters/launchers call
+    this; workers usually inherit the env var instead)."""
+    return set_default_journal(EventJournal(path, capacity=capacity))
+
+
+def record(kind: str, **fields: Any) -> Dict[str, Any]:
+    """Record on the default journal — the one-line instrumentation
+    call sites use."""
+    return default_journal().record(kind, **fields)
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL journal file; unparseable lines (a torn write from
+    a crashed process) are skipped, not fatal. Ordered by ``(ts, pid,
+    seq)`` so multi-process appends interleave into one timeline."""
+    events = []
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    events.sort(
+        key=lambda e: (
+            e.get("ts", 0.0), e.get("pid", 0), e.get("seq", 0)
+        )
+    )
+    return events

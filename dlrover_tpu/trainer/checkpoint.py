@@ -36,7 +36,33 @@ from typing import Any, Callable, Optional
 import jax
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, histogram, record
 from dlrover_tpu.trainer import ckpt_store
+
+#: RAM-tier saves are milliseconds; persist commits can run minutes
+_CKPT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 300.0,
+)
+
+
+def _observe_ckpt(op: str, tier: str, step: int, seconds: float,
+                  ok: bool = True, **extra) -> None:
+    """One checkpoint save/restore outcome -> metrics + journal."""
+    counter(
+        "dlrover_checkpoint_ops_total",
+        "Checkpoint saves/restores by tier and outcome",
+        ["op", "tier", "outcome"],
+    ).labels(op=op, tier=tier, outcome="ok" if ok else "error").inc()
+    histogram(
+        "dlrover_checkpoint_seconds",
+        "Checkpoint save/restore wall time", ["op", "tier"],
+        buckets=_CKPT_BUCKETS,
+    ).labels(op=op, tier=tier).observe(seconds)
+    record(
+        f"checkpoint.{op}", tier=tier, step=step,
+        duration_s=round(seconds, 4), ok=ok, **extra,
+    )
 
 
 def default_ram_dir(job_name: str = "job") -> str:
@@ -195,6 +221,9 @@ class FlashCheckpointer:
         self._write_ram(step, data)
         ram_ms = (time.time() - t0) * 1000
         logger.info("Flash save step %d: RAM tier in %.0f ms", step, ram_ms)
+        _observe_ckpt(
+            "save", "ram", step, ram_ms / 1000.0, bytes=len(data),
+        )
         if force_persist or (
             self.persist_interval > 0 and step % self.persist_interval == 0
         ):
@@ -243,6 +272,7 @@ class FlashCheckpointer:
         payload = [data]  # holder so the thread can drop the bytes
 
         def work():
+            t0 = time.time()
             try:
                 if self._manager is not None:
                     with self._persist_lock:
@@ -253,6 +283,10 @@ class FlashCheckpointer:
                             ).args.StandardSave(jax.device_get(state)),
                         )
                     logger.info("Persistent save step %d done", step)
+                    _observe_ckpt(
+                        "save", "persistent", step, time.time() - t0,
+                        backend="orbax",
+                    )
                     return
                 # the lock covers only the fast shard upload; the
                 # (possibly long) peer-await for COMMIT runs outside
@@ -286,15 +320,27 @@ class FlashCheckpointer:
                             self._store, self.max_persist_keep
                         )
                     logger.info("Persistent save step %d done", step)
+                    _observe_ckpt(
+                        "save", "persistent", step, time.time() - t0,
+                        backend="store",
+                    )
                 else:
                     logger.error(
                         "Persistent save step %d NOT committed: peer "
                         "shards missing after %.0fs", step,
                         self.commit_timeout,
                     )
+                    _observe_ckpt(
+                        "save", "persistent", step, time.time() - t0,
+                        ok=False, reason="commit_timeout",
+                    )
             except Exception as e:
                 logger.error("Persistent save step %d failed: %s",
                              step, e)
+                _observe_ckpt(
+                    "save", "persistent", step, time.time() - t0,
+                    ok=False, reason=str(e)[:200],
+                )
 
         t = threading.Thread(target=work, daemon=True,
                              name=f"persist-ckpt-{step}")
@@ -446,6 +492,7 @@ class FlashCheckpointer:
 
     def _restore_once(self, target: Any = None,
                       step: Optional[int] = None):
+        t0 = time.time()
         ram = dict(self._list_ram())
         auto_step = step is None
         # one store scan serves both step selection and the fallback
@@ -481,6 +528,9 @@ class FlashCheckpointer:
                     )
                 state = _restore_shards(snapshot, target)
                 logger.info("Restored step %d from RAM tier", step)
+                _observe_ckpt(
+                    "restore", "ram", step, time.time() - t0,
+                )
                 return state, step
             except Exception as e:
                 logger.warning("RAM restore failed (%s); trying persistent",
@@ -505,6 +555,10 @@ class FlashCheckpointer:
             else:
                 restored = self._manager.restore(step)
             logger.info("Restored step %d from persistent tier", step)
+            _observe_ckpt(
+                "restore", "persistent", step, time.time() - t0,
+                backend="orbax",
+            )
             return restored, step
         # auto-selection may land on a step whose persist shard is gone
         # (e.g. a RAM-tier step never persisted): fall back down the
@@ -540,6 +594,10 @@ class FlashCheckpointer:
                     "Step %d not restorable from persist tier; "
                     "restored older step %d", step, cand,
                 )
+            _observe_ckpt(
+                "restore", "persistent", cand, time.time() - t0,
+                backend="store", requested_step=step,
+            )
             return _restore_shards(snapshot, target), cand
         return None, None
 

@@ -33,6 +33,7 @@ from dlrover_tpu.common.cachedir import (
 )
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, gauge, record
 
 #: env contract (agent -> worker); value "off" disables the cache
 ENV_CACHE_DIR = NodeEnv.COMPILE_CACHE_DIR
@@ -119,6 +120,16 @@ def setup_compilation_cache(
     # stays at its default, bounding growth)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     logger.info("persistent compilation cache at %s", cache_dir)
+    global _armed_dir, _armed_entries
+    _armed_dir = cache_dir
+    _armed_entries = cache_entries(cache_dir)
+    gauge(
+        "dlrover_compile_cache_entries",
+        "Executables in the persistent compilation cache",
+    ).set(_armed_entries)
+    record(
+        "compile_cache.armed", dir=cache_dir, entries=_armed_entries,
+    )
     return cache_dir
 
 
@@ -131,3 +142,45 @@ def cache_entries(cache_dir: str) -> int:
         )
     except FileNotFoundError:
         return 0
+
+
+# -- hit/miss telemetry ------------------------------------------------
+# jax gives no per-program cache-hit callback, but the restart question
+# the telemetry must answer is coarser: did THIS incarnation's first
+# jit come from the warm pool (entry count unchanged) or compile fresh
+# (new entries persisted)? setup_compilation_cache snapshots the armed
+# entry count; report_first_compile classifies the delta after the
+# first step and journals it — the e2e warm-restart drill reads the
+# hit/miss straight off the timeline.
+
+_armed_dir: Optional[str] = None
+_armed_entries: int = 0
+
+
+def report_first_compile(
+    first_step_s: Optional[float] = None,
+) -> Optional[str]:
+    """Classify this process's first-jit outcome against the armed
+    cache; returns "hit"/"miss" (None when the cache is not armed).
+    Call once after the first jitted step has completed."""
+    if _armed_dir is None:
+        return None
+    entries = cache_entries(_armed_dir)
+    new = max(0, entries - _armed_entries)
+    outcome = "miss" if new > 0 else "hit"
+    counter(
+        "dlrover_compile_cache_events_total",
+        "First-jit persistent-cache outcomes", ["outcome"],
+    ).labels(outcome=outcome).inc()
+    gauge(
+        "dlrover_compile_cache_entries",
+        "Executables in the persistent compilation cache",
+    ).set(entries)
+    record(
+        f"compile_cache.{outcome}", dir=_armed_dir, entries=entries,
+        new_entries=new,
+        first_step_s=(
+            round(first_step_s, 3) if first_step_s is not None else None
+        ),
+    )
+    return outcome

@@ -12,6 +12,8 @@ from dataclasses import dataclass
 
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.log import set_process_index
+from dlrover_tpu.telemetry import record
 
 
 @dataclass
@@ -116,4 +118,14 @@ def init_from_env(timeout_s: int = 300) -> DistributedEnv:
             # world at all
             kwargs.pop("heartbeat_timeout_seconds")
         jax.distributed.initialize(**kwargs)
+    # the authoritative index is now known: tag log lines and the
+    # journal envelope with it (common/log.py), then journal the init
+    # so restarts are attributable on the timeline
+    set_process_index(env.process_id)
+    record(
+        "distributed.init", process_id=env.process_id,
+        num_processes=env.num_processes, node_rank=env.node_rank,
+        restart_count=env.restart_count,
+        coordinator=env.coordinator_addr,
+    )
     return env

@@ -107,6 +107,8 @@ class ElasticTrainer:
         self._global_step = 0
         self._hang_detector = None
         self._fault_injector = None
+        self._created_ts = time.monotonic()
+        self._first_step_seen = False
         self._init_fault_tolerance(hang_detection)
         self.set_world(cur_nodes)
 
@@ -230,6 +232,20 @@ class ElasticTrainer:
         self._global_step = step if step is not None else (
             self._global_step + 1
         )
+        if not self._first_step_seen:
+            # the first completed step carries the compile: classify
+            # warm (persistent-cache hit) vs cold for the journal
+            self._first_step_seen = True
+            try:
+                from dlrover_tpu.trainer.compile_cache import (
+                    report_first_compile,
+                )
+
+                report_first_compile(
+                    time.monotonic() - self._created_ts
+                )
+            except Exception as e:  # telemetry never stops training
+                logger.warning("compile-cache telemetry failed: %s", e)
         if self._hang_detector is not None:
             self._hang_detector.record_step(self._global_step)
         if self._trace_capture is not None:

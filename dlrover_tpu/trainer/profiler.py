@@ -22,27 +22,37 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import default_journal
 
 # ---------------------------------------------------------------- tuning
 # Kernel-autotuning events (ops/tuning.py): each block-size decision —
-# cache hit, fresh measurement, or heuristic fallback — lands here so
-# the stats pipeline (and bench.py's JSON detail fields) can see what
-# the kernels actually ran with and what tuning cost at startup.
+# cache hit, fresh measurement, or heuristic fallback — writes through
+# the structured event journal (telemetry/journal.py) as kind
+# ``tuning.decision``, so the decisions land on the same attributable
+# timeline as rendezvous/checkpoint/fault events. This adapter keeps
+# the original per-process API: ``tuning_events()`` returns the same
+# flat dicts it always did, now read back out of the journal ring.
 
-_tuning_events: List[Dict[str, Any]] = []
+_TUNING_KIND = "tuning.decision"
 
 
 def record_tuning_event(**fields) -> None:
-    """Append one kernel-tuning decision (called by ops/tuning.py)."""
+    """Record one kernel-tuning decision (called by ops/tuning.py)."""
     evt = dict(fields)
     evt.setdefault("time", time.time())
-    _tuning_events.append(evt)
+    default_journal().record(_TUNING_KIND, **evt)
     logger.info("kernel tuning event: %s", evt)
 
 
 def tuning_events() -> List[Dict[str, Any]]:
-    """All tuning decisions made by this process, oldest first."""
-    return list(_tuning_events)
+    """All tuning decisions made by this process, oldest first — the
+    pre-journal flat-dict shape (journal envelope stripped)."""
+    out = []
+    for event in default_journal().events(_TUNING_KIND):
+        evt = dict(event.get("data") or {})
+        evt.setdefault("time", event["ts"])
+        out.append(evt)
+    return out
 
 
 @dataclass
@@ -148,6 +158,19 @@ def measure_step_time(run_once: Callable[[], Any], steps: int = 10,
     return (time.perf_counter() - t0) / steps
 
 
+def utilization(flops_per_step: float, step_time_s: float,
+                peak_flops: float) -> float:
+    """Percent of peak: ``100 * (flops/step / step_time) / peak``.
+
+    Feed it analytic model flops for MFU, or the XLA-counted hardware
+    flops from :class:`StepProfile` (remat recompute included) for HFU
+    — same wall-clock denominator, so the two are directly comparable
+    in the bench JSON."""
+    if step_time_s <= 0 or peak_flops <= 0:
+        return 0.0
+    return 100.0 * (flops_per_step / step_time_s) / peak_flops
+
+
 def report_profile(master_client, prof: StepProfile,
                    batch_size: int = 0, seq_len: int = 0) -> bool:
     """Send the profile to the master's stats pipeline; False on error
@@ -185,6 +208,7 @@ class TraceCapture:
         self._start = start_step
         self._stop_after = start_step + num_steps
         self._active = False
+        self._atexit_registered = False
 
     @classmethod
     def from_env(cls) -> "TraceCapture | None":
@@ -201,13 +225,19 @@ class TraceCapture:
 
     def start(self):
         if not self._active:
-            import atexit
-
             jax.profiler.start_trace(self._dir)
             self._active = True
             # a window still open when the process ends (short run,
-            # restart action mid-window) must still flush the trace
-            atexit.register(self.stop)
+            # restart action mid-window) must still flush the trace.
+            # Registered ONCE per capture object: stop() is idempotent,
+            # and re-registering on every window open would grow the
+            # atexit stack by one callback per window for the life of
+            # the process
+            if not self._atexit_registered:
+                import atexit
+
+                atexit.register(self.stop)
+                self._atexit_registered = True
             logger.info("Trace capture started -> %s", self._dir)
 
     def stop(self):

@@ -14,6 +14,8 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+from dlrover_tpu.telemetry import counter
+
 
 class EventQueue:
     _instance = None
@@ -22,6 +24,7 @@ class EventQueue:
     def __init__(self, max_size: int = 1000):
         self._deque: deque = deque(maxlen=max_size)
         self._cond = threading.Condition()
+        self._dropped = 0
 
     @classmethod
     def singleton_instance(cls, max_size: int = 1000) -> "EventQueue":
@@ -32,7 +35,19 @@ class EventQueue:
 
     def put(self, event: Any) -> None:
         with self._cond:
-            self._deque.append(event)  # maxlen drops from the left
+            # maxlen drops from the left (oldest): deliberate — late
+            # scheduling news supersedes early news — but COUNTED, so
+            # a consumer falling behind is visible, not silent
+            if (
+                self._deque.maxlen is not None
+                and len(self._deque) == self._deque.maxlen
+            ):
+                self._dropped += 1
+                counter(
+                    "dlrover_event_queue_dropped_total",
+                    "Oldest events evicted by queue overflow",
+                ).inc()
+            self._deque.append(event)
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Any]:
@@ -50,6 +65,12 @@ class EventQueue:
                 if not self._cond.wait(remaining):
                     return None
             return self._deque.popleft()
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted (oldest-first) by overflow since creation."""
+        with self._cond:
+            return self._dropped
 
     def __len__(self) -> int:
         with self._cond:

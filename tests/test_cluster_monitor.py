@@ -233,3 +233,111 @@ def test_brain_outage_queues_write_even_for_vanished_pods(stub):
     monitor._reported["w0"] = "failure/1/None"
     assert monitor._handle(rec) is None
     assert flaky.events == [("host-1", "failure", "j")]
+
+
+# ===================================================================
+# SpeedMonitor: the other half of cluster monitoring — the throughput
+# window the autoscaler and hang watchdog act on (ISSUE 2 satellite).
+
+
+def _speed_monitor():
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+    return SpeedMonitor()
+
+
+def test_speed_monitor_window_eviction():
+    import time as _t
+
+    from dlrover_tpu.common.global_context import Context
+
+    sm = _speed_monitor()
+    cap = Context.singleton_instance().train_speed_record_num
+    sm.add_running_worker("worker", 0)
+    base = _t.time()
+    for i in range(cap + 25):
+        sm.collect_global_step(i, base + i)
+    # the window is bounded and keeps the NEWEST records
+    records = sm._global_step_records
+    assert len(records) == cap
+    assert records[-1].global_step == cap + 24
+    assert records[0].global_step == cap + 25 - cap
+    # completed_global_step survives eviction (it is a max, not a scan)
+    assert sm.completed_global_step == cap + 24
+
+
+def test_speed_monitor_running_speed_scoped_to_current_world():
+    import time as _t
+
+    sm = _speed_monitor()
+    base = _t.time() - 100
+    # 2-worker era: 1 step/s
+    sm.add_running_worker("worker", 0)
+    sm.add_running_worker("worker", 1)
+    for i in range(5):
+        sm.collect_global_step(i, base + i)
+    assert sm.running_speed() == pytest.approx(1.0)
+    # a third worker joins: the rate jumps to 4 steps/s — the speed
+    # must come from the trailing 3-worker records ONLY, not blend the
+    # 1 step/s era into the estimate
+    sm.add_running_worker("worker", 2)
+    t0 = base + 5
+    for j in range(4):
+        sm.collect_global_step(4 + 4 * (j + 1), t0 + j + 1)
+    assert sm.running_speed() == pytest.approx(4.0)
+
+
+def test_speed_monitor_speed_zero_on_worker_change_until_two_samples():
+    import time as _t
+
+    sm = _speed_monitor()
+    base = _t.time() - 50
+    sm.add_running_worker("worker", 0)
+    sm.collect_global_step(1, base)
+    sm.collect_global_step(2, base + 1)
+    assert sm.running_speed() > 0
+    # membership changed: exactly one record at the new world size
+    # carries no rate information yet
+    sm.remove_running_worker("worker", 0)
+    sm.collect_global_step(3, base + 2)
+    assert sm.running_speed() == 0.0
+    sm.collect_global_step(4, base + 3)
+    assert sm.running_speed() == pytest.approx(1.0)
+
+
+def test_speed_monitor_regrow_ignores_older_same_size_era():
+    """grow -> shrink -> regrow: an OLD era at the same worker count
+    must not blend into the current rate (the trailing-run rule)."""
+    import time as _t
+
+    sm = _speed_monitor()
+    base = _t.time() - 100
+    sm.add_running_worker("worker", 0)
+    sm.add_running_worker("worker", 1)
+    # slow 2-worker era: 0.5 step/s
+    for i in range(3):
+        sm.collect_global_step(i, base + 2 * i)
+    # shrink to 1 worker
+    sm.remove_running_worker("worker", 1)
+    sm.collect_global_step(4, base + 10)
+    # regrow to 2 workers, now fast: 5 steps/s
+    sm.add_running_worker("worker", 1)
+    t0 = base + 12
+    for j in range(3):
+        sm.collect_global_step(10 + 5 * j, t0 + j)
+    assert sm.running_speed() == pytest.approx(5.0)
+
+
+def test_speed_monitor_worker_count_recorded_per_sample():
+    import time as _t
+
+    sm = _speed_monitor()
+    base = _t.time()
+    sm.add_running_worker("worker", 0)
+    sm.collect_global_step(1, base)
+    sm.add_running_worker("worker", 1)
+    sm.collect_global_step(2, base + 1)
+    sm.remove_running_worker("worker", 0)
+    sm.remove_running_worker("worker", 1)
+    sm.collect_global_step(3, base + 2)
+    assert [r.worker_num for r in sm._global_step_records] == [1, 2, 0]

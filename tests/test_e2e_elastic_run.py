@@ -166,3 +166,62 @@ def test_dlrm_system_e2e_with_crash_resume():
         assert 0 < float(loss) < 1.0
         assert float(acc) > 0.55  # planted rule beats the base rate
         assert int(start) == 20  # resumed from the step-20 checkpoint
+
+
+def test_crash_drill_writes_ordered_event_journal():
+    """Acceptance (ISSUE 2): an elastic-run drill with a crash injection
+    produces ONE journal file — appended by master, agent, and both
+    worker incarnations — whose timeline shows rendezvous, checkpoint
+    saves, the injected fault, and the post-restart restore in causal
+    order."""
+    from dlrover_tpu.telemetry import read_journal
+
+    with tempfile.TemporaryDirectory() as tmp:
+        journal = os.path.join(tmp, "job.journal")
+        proc, out_file = _run_launcher(
+            tmp,
+            extra_env={
+                "DLROVER_FAULT_INJECT": "crash@15",
+                "DLROVER_TPU_JOURNAL": journal,
+            },
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        step, _, start = open(out_file).read().split(",")
+        assert int(step) == 30 and int(start) == 10
+        events = read_journal(journal)
+        kinds = [e["kind"] for e in events]
+        # the control-plane arc is present...
+        assert "rendezvous.complete" in kinds
+        assert "checkpoint.save" in kinds
+        assert "fault.injected" in kinds
+        assert "scale.restart" in kinds
+        assert "checkpoint.restore" in kinds
+        # ...and in causal order: a save precedes the injected crash,
+        # which precedes the agent's restart, which precedes the
+        # resumed process's restore
+        assert kinds.index("checkpoint.save") < kinds.index(
+            "fault.injected"
+        )
+        assert kinds.index("fault.injected") < kinds.index(
+            "scale.restart"
+        )
+        assert kinds.index("scale.restart") < kinds.index(
+            "checkpoint.restore"
+        )
+        restore = next(
+            e for e in events if e["kind"] == "checkpoint.restore"
+        )
+        assert restore["data"]["step"] == 10
+        assert restore["data"]["tier"] == "ram"
+        # multi-process: at least master + worker pids interleaved
+        assert len({e["pid"] for e in events}) >= 2
+        # and the dump CLI renders the same file
+        import subprocess as sp
+
+        dump = sp.run(
+            [sys.executable, "-m", "dlrover_tpu.telemetry.dump",
+             journal],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert dump.returncode == 0
+        assert "fault.injected" in dump.stdout

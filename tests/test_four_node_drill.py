@@ -18,6 +18,11 @@ import signal
 import subprocess
 import sys
 import time
+import pytest
+
+# tier-1 budget (ISSUE 2 satellite): this module costs >50s of the
+# 870s budget on a 1-core box; the nightly/full shard still runs it
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

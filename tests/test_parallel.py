@@ -10,6 +10,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
+
+# tier-1 budget (ISSUE 2 satellite): this module costs >50s of the
+# 870s budget on a 1-core box; the nightly/full shard still runs it
+pytestmark = pytest.mark.slow
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.models import llama

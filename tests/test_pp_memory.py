@@ -11,6 +11,11 @@ from dlrover_tpu.parallel.mesh import create_mesh
 from dlrover_tpu.parallel.pipeline import pipeline_llama_forward
 
 PP, MICRO, CHUNKS = 2, 4, 2
+import pytest
+
+# tier-1 budget (ISSUE 2 satellite): this module costs >50s of the
+# 870s budget on a 1-core box; the nightly/full shard still runs it
+pytestmark = pytest.mark.slow
 
 
 def _temp_bytes(remat: str) -> int:
