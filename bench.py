@@ -14,6 +14,7 @@ so the script always produces a line.
 """
 
 import json
+import os
 import time
 
 import jax
@@ -189,6 +190,18 @@ def main():
         "reference's DeepRec autoscaling claim) instead of the "
         "headline Llama MFU",
     )
+    ap.add_argument(
+        "--ckpt-interval", type=int, default=0,
+        help="flash-save (params, opt_state) every N timed steps and "
+        "report the measured train-thread stall (ckpt_stall_ms) in "
+        "the JSON line; 0 disables checkpointing (default); llama "
+        "bench only",
+    )
+    ap.add_argument(
+        "--ckpt-dir", default="",
+        help="checkpoint directory for --ckpt-interval (default: a "
+        "fresh temp dir, removed after the run)",
+    )
     args = ap.parse_args()
     _honor_platform_env()
     if args.model == "dlrm":
@@ -249,6 +262,27 @@ def main():
     def next_mb():
         return mb if batches is None else next(batches)
 
+    ckpt = None
+    ckpt_tmp = None
+    ckpt_stalls = []
+    if args.ckpt_interval > 0:
+        import tempfile
+
+        from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+        ckpt_dir = args.ckpt_dir
+        if not ckpt_dir:
+            ckpt_tmp = tempfile.TemporaryDirectory(prefix="bench_ckpt_")
+            ckpt_dir = ckpt_tmp.name
+        # RAM tier only: the bench measures the train-thread stall of
+        # the zero-stall save path (benchmarks/ckpt_stall.py covers
+        # the persist pipeline under a slow store)
+        ckpt = FlashCheckpointer(
+            persist_dir=os.path.join(ckpt_dir, "persist"),
+            ram_dir=os.path.join(ckpt_dir, "ram"),
+            persist_interval=0, use_orbax=False,
+        )
+
     for _ in range(warmup):
         params, opt_state, loss = trainer.train_step(
             params, opt_state, next_mb()
@@ -257,14 +291,23 @@ def main():
     # honor block_until_ready)
 
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
         params, opt_state, loss = trainer.train_step(
             params, opt_state, next_mb()
         )
+        if ckpt is not None and (i + 1) % args.ckpt_interval == 0:
+            ckpt_stalls.append(
+                ckpt.save(i + 1, (params, opt_state))
+            )
     # one sync at the end: the final loss depends on the whole step chain,
     # so this waits for all 20 steps without a per-step host round-trip
     loss_val = float(loss)
     dt = time.perf_counter() - t0
+
+    if ckpt is not None:
+        ckpt.close()  # outside the timed window: drains the pipeline
+        if ckpt_tmp is not None:
+            ckpt_tmp.cleanup()
 
     if loader is not None:
         # same shutdown order as ElasticShmDataLoader.shutdown: EOF the
@@ -337,6 +380,17 @@ def main():
         "attn_block_k": sel["block_k"] if sel else None,
         "attn_tuning_source": sel["source"] if sel else None,
     }
+    if ckpt_stalls:
+        # train-thread cost of the flash saves inside the timed loop
+        # (docs/CHECKPOINT.md "BENCH conventions"); step_time_ms above
+        # already absorbs these stalls — checkpointing overhead is
+        # visible, not hidden
+        result["ckpt_stall_ms"] = round(
+            sum(ckpt_stalls) / len(ckpt_stalls), 3
+        )
+        result["ckpt_stall_ms_max"] = round(max(ckpt_stalls), 3)
+        result["ckpt_saves"] = len(ckpt_stalls)
+        result["ckpt_interval"] = args.ckpt_interval
     print(json.dumps(result))
 
 

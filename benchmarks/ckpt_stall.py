@@ -1,0 +1,194 @@
+"""Measured checkpoint save stall: zero-stall pipeline vs sync path.
+
+ISSUE 3 acceptance evidence: for a >=100 MB training state the
+train-thread cost of a RAM-tier flash save must be >=5x lower than the
+synchronous path (blocking device->host + full npz serialization +
+tmpfs write), with peak extra host RSS during the async save bounded
+by ~1.1x the archive size (the staged host copy — never a second
+in-memory copy of the archive, which is what the old
+``snapshot_to_bytes`` BytesIO + ``getvalue()`` cost).
+
+Prints ONE JSON line (BENCH conventions, docs/CHECKPOINT.md):
+
+  save_stall_ms      train-thread stall of FlashCheckpointer.save()
+  save_total_ms      save() -> archive durable in the RAM tier
+  sync_save_ms       the synchronous baseline for the same state
+  stall_speedup      sync_save_ms / save_stall_ms
+  peak_rss_delta_mb  extra host RSS while the async save ran
+  sync_rss_delta_mb  extra host RSS of the synchronous baseline
+  state_mb / archive_mb / platform / saves
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/ckpt_stall.py [--mb 128]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+class _RssSampler:
+    """Peak process RSS (MB) over a window, sampled from /proc."""
+
+    def __init__(self, interval: float = 0.001):
+        self._interval = interval
+        self._peak = 0.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._page = os.sysconf("SC_PAGE_SIZE")
+
+    def _read(self) -> float:
+        try:
+            with open("/proc/self/statm") as f:
+                return int(f.read().split()[1]) * self._page / 2**20
+        except (OSError, ValueError, IndexError):
+            return 0.0
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._peak = max(self._peak, self._read())
+            self._stop.wait(self._interval)
+
+    def __enter__(self):
+        self.base = self._read()
+        self._peak = self.base
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+        self._peak = max(self._peak, self._read())
+        self.delta_mb = self._peak - self.base
+        return False
+
+
+def _make_state(total_mb: float):
+    """A training-state-shaped pytree of jax arrays >= total_mb."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    n_leaves = 16
+    per_leaf = int(total_mb * 2**20 / 4 / n_leaves)
+    state = {
+        "params": {
+            f"layer{i}": jnp.asarray(
+                rng.standard_normal(per_leaf, dtype=np.float32)
+            )
+            for i in range(n_leaves // 2)
+        },
+        "opt_state": {
+            f"mu{i}": jnp.asarray(
+                rng.standard_normal(per_leaf, dtype=np.float32)
+            )
+            for i in range(n_leaves // 2)
+        },
+        "step": jnp.asarray(0),
+    }
+    import jax
+
+    nbytes = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(state)
+        if hasattr(x, "size")
+    )
+    return state, nbytes
+
+
+def sync_save_ms(state, path: str) -> float:
+    """The pre-pipeline path: blocking shard device_get + whole-archive
+    serialization + write, all on the caller's thread."""
+    from dlrover_tpu.trainer import ckpt_store
+    from dlrover_tpu.trainer.checkpoint import _local_shards
+
+    t0 = time.perf_counter()
+    snapshot = _local_shards(state)
+    data = ckpt_store.snapshot_to_bytes(snapshot, 0)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    dt = (time.perf_counter() - t0) * 1e3
+    del data
+    return dt
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=float, default=128.0,
+                    help="state size to checkpoint (>=100 for the "
+                    "acceptance measurement)")
+    ap.add_argument("--saves", type=int, default=3,
+                    help="async saves to time (reported: best stall, "
+                    "i.e. steady state without back-pressure)")
+    args = ap.parse_args()
+
+    if os.getenv("JAX_PLATFORMS", "").startswith("cpu"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+    dev = jax.devices()[0]
+    state, state_bytes = _make_state(args.mb)
+
+    with tempfile.TemporaryDirectory(prefix="ckpt_stall_") as tmp:
+        # --- synchronous baseline -----------------------------------
+        sync_path = os.path.join(tmp, "sync.ckpt")
+        sync_save_ms(state, sync_path)  # warm numpy/zip paths
+        with _RssSampler() as sync_rss:
+            sync_ms = sync_save_ms(state, sync_path)
+        archive_bytes = os.path.getsize(sync_path)
+        os.remove(sync_path)
+
+        # --- zero-stall pipeline ------------------------------------
+        ckpt = FlashCheckpointer(
+            persist_dir=os.path.join(tmp, "persist"),
+            ram_dir=os.path.join(tmp, "ram"),
+            persist_interval=0,  # RAM tier: the per-step stall path
+            use_orbax=False,
+            max_ram_keep=1,
+        )
+        stalls, totals = [], []
+        with _RssSampler() as async_rss:
+            for i in range(max(1, args.saves)):
+                t0 = time.perf_counter()
+                stall = ckpt.save(i + 1, state)
+                ckpt.wait()  # drain so saves don't back-pressure
+                totals.append((time.perf_counter() - t0) * 1e3)
+                stalls.append(stall)
+        ckpt.close()
+
+    best_stall = min(stalls)
+    result = {
+        "metric": "ckpt_save_stall_ms",
+        "value": round(best_stall, 3),
+        "unit": "ms",
+        "save_stall_ms": round(best_stall, 3),
+        "save_stall_ms_mean": round(sum(stalls) / len(stalls), 3),
+        "save_total_ms": round(min(totals), 1),
+        "sync_save_ms": round(sync_ms, 1),
+        "stall_speedup": round(sync_ms / max(best_stall, 1e-6), 1),
+        "peak_rss_delta_mb": round(async_rss.delta_mb, 1),
+        "sync_rss_delta_mb": round(sync_rss.delta_mb, 1),
+        "state_mb": round(state_bytes / 2**20, 1),
+        "archive_mb": round(archive_bytes / 2**20, 1),
+        "saves": len(stalls),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "platform": dev.platform,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,24 +25,61 @@ via a COMMIT marker written after the data objects (object stores have
 no rename — see ckpt_store.py for the layout). Archives are the npz+
 manifest format from ckpt_store (``numpy.load(allow_pickle=False)``) —
 no pickle on any tier, a corrupt or foreign file is rejected, not run.
+
+Zero-stall save pipeline (ISSUE 3; the decomposition Orbax async and
+Universal Checkpointing both converge on — a fast snapshot barrier on
+the critical path, transfer/serialize/commit pipelined behind it):
+
+    train thread          serializer lane           persist worker
+    ------------          ---------------           --------------
+    stage (dispatch   ->  materialize D2H       ->  stream archive to
+    copy_to_host_async    stream npz to tmpfs       the store / Orbax,
+    on all shards,        (snapshot_to_file),       COMMIT barrier, gc
+    ~free)                gc RAM tier
+
+``save()`` costs the train thread only the copy *dispatch*; the next
+step's compute overlaps the D2H DMA. The serializer lane is depth-1
+(one running + one pending — a third concurrent save blocks, honest
+back-pressure instead of unbounded staged handles), and the persist
+worker sits behind a bounded queue with an explicit overflow policy:
+oldest skippable entry dropped + counted (newest data wins), forced
+saves never skipped (their submitters block for room). See
+docs/CHECKPOINT.md for the stall budget, knobs, and the
+donation-safety contract (``wait_staged``).
 """
 
+import atexit
 import os
 import threading
 import time
-from dataclasses import dataclass
-from typing import Any, Callable, Optional
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.telemetry import counter, histogram, record
+from dlrover_tpu.telemetry import counter, gauge, histogram, record
 from dlrover_tpu.trainer import ckpt_store
+
+#: DLROVER_TPU_CKPT_QUEUE_DEPTH — max persist archives in flight
+#: (queued + running); DLROVER_TPU_CKPT_STAGE — "async" (default:
+#: background D2H materialization) or "sync" (Orbax-style blocking
+#: D2H on the train thread; serialization/persist still async).
+ENV_QUEUE_DEPTH = "DLROVER_TPU_CKPT_QUEUE_DEPTH"
+ENV_STAGE = "DLROVER_TPU_CKPT_STAGE"
 
 #: RAM-tier saves are milliseconds; persist commits can run minutes
 _CKPT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
     30.0, 60.0, 300.0,
+)
+
+#: the zero-stall budget: staging dispatch is expected in the
+#: sub-millisecond buckets; anything above ~25ms means back-pressure
+_STALL_BUCKETS = (
+    0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 5.0, 30.0,
 )
 
 
@@ -70,16 +107,37 @@ def default_ram_dir(job_name: str = "job") -> str:
     return os.path.join(base, f"dlrover_tpu_ckpt_{job_name}")
 
 
-def _local_shards(pytree):
-    """Snapshot process-local shard data + index metadata of a pytree of
-    (possibly sharded, possibly multi-host) jax.Arrays."""
+def _is_snap_leaf(x) -> bool:
+    return isinstance(x, dict) and x.get("__jax_shards__") is True
+
+
+def _stage_local_shards(pytree, sync: bool = False):
+    """Start the device->host snapshot of a pytree's *addressable*
+    shards and return a staged pytree (shard-snap dicts whose shard
+    data are device handles, or host arrays when ``sync=True``).
+
+    Async mode dispatches ``copy_to_host_async()`` on EVERY shard up
+    front — the train thread pays only copy dispatch and all shards'
+    DMA overlaps the next step's compute — then hands the handles to
+    :func:`_materialize_staged` on the serializer thread. Sync mode
+    blocks for each shard's transfer here (the Orbax-async model: the
+    D2H is the only train-thread cost; use it when donated buffers
+    can't be guaranteed to outlive staging — see docs/CHECKPOINT.md).
+    """
 
     def snap(x):
         if isinstance(x, jax.Array):
-            shards = [
-                (s.index, jax.device_get(s.data))
-                for s in x.addressable_shards
-            ]
+            shards = []
+            for s in x.addressable_shards:
+                d = s.data
+                if sync:
+                    d = _owned_host_array(d)
+                else:
+                    try:
+                        d.copy_to_host_async()
+                    except (AttributeError, RuntimeError):
+                        pass  # backend without async D2H: asarray later
+                shards.append((s.index, d))
             return {
                 "__jax_shards__": True,
                 "shape": tuple(x.shape),
@@ -89,6 +147,48 @@ def _local_shards(pytree):
         return x
 
     return jax.tree.map(snap, pytree)
+
+
+def _owned_host_array(d) -> np.ndarray:
+    """Host copy of one shard that OWNS its memory. On the CPU backend
+    ``np.asarray`` returns a zero-copy view of the device buffer —
+    donation/deletion of the source array would leave the snapshot
+    pointing at freed memory, so a view is copied out; on TPU the host
+    transfer already produced an owned buffer and no extra copy runs."""
+    arr = np.asarray(d)
+    if arr.base is not None and isinstance(d, jax.Array):
+        try:
+            platform = next(iter(d.devices())).platform
+        except Exception:
+            platform = None
+        if platform == "cpu":
+            arr = np.array(arr)
+    return arr
+
+
+def _materialize_staged(staged):
+    """Complete a staged snapshot: wait out the async copies and turn
+    every shard handle into an owned host array (the layout
+    ``snapshot_to_file`` serializes). Runs on the serializer thread."""
+
+    def mat(x):
+        if _is_snap_leaf(x):
+            return {
+                **x,
+                "shards": [
+                    (idx, _owned_host_array(d)) for idx, d in x["shards"]
+                ],
+            }
+        return x
+
+    return jax.tree.map(mat, staged, is_leaf=_is_snap_leaf)
+
+
+def _local_shards(pytree):
+    """Blocking snapshot of process-local shard data + index metadata
+    (stage + materialize in one call; the synchronous baseline and the
+    restore-side test helper)."""
+    return _materialize_staged(_stage_local_shards(pytree))
 
 
 def _restore_shards(snapshot, target=None):
@@ -143,12 +243,227 @@ class CheckpointRecord:
     tier: str  # "ram" | "persistent"
 
 
-class FlashCheckpointer:
-    """Two-tier async checkpointer.
+@dataclass
+class _SaveJob:
+    """One save() handed to the serializer lane."""
 
-    save(step, state): synchronous RAM-tier snapshot (fast: local shards to
-    tmpfs), then schedules the persistent Orbax save in the background when
-    ``step % persist_interval == 0``.
+    step: int
+    staged: Any
+    persist_due: bool
+    force: bool
+    #: set once the staged snapshot is fully materialized on the host —
+    #: after this, the source device buffers may be donated/deleted
+    staged_evt: threading.Event = field(default_factory=threading.Event)
+
+
+@dataclass
+class _PersistJob:
+    """One persist handed to the bounded persist queue.
+
+    ``payload`` is ``("store", ram_file_path)`` — the worker streams
+    the already-serialized tmpfs archive into the object store (never
+    a full in-memory copy) — or ``("orbax", snapshot)`` holding the
+    materialized host snapshot captured at save() time (NEVER re-read
+    from device state on the background thread: with donation the
+    train loop may have invalidated those buffers long ago)."""
+
+    step: int
+    payload: Tuple[str, Any]
+    force: bool
+    abandon: Callable[[], None] = lambda: None
+
+
+class _SerializerLane:
+    """Depth-1 background serializer: at most one snapshot being
+    serialized plus one staged save pending. A third concurrent save()
+    BLOCKS in submit — honest back-pressure instead of staged
+    device-handle pytrees piling up when serialization can't keep up."""
+
+    def __init__(self, run_fn: Callable[[Any], None], name: str):
+        self._run = run_fn
+        self._cond = threading.Condition()
+        self._pending: Optional[Any] = None
+        self._busy = False
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=name
+        )
+        self._thread.start()
+
+    def submit(self, job) -> None:
+        with self._cond:
+            while self._pending is not None and not self._closed:
+                self._cond.wait()
+            if self._closed:
+                raise RuntimeError("checkpointer is closed")
+            self._pending = job
+            self._cond.notify_all()
+
+    def drain(self) -> None:
+        if threading.current_thread() is self._thread:
+            return
+        with self._cond:
+            while self._pending is not None or self._busy:
+                if self._closed:
+                    return
+                self._cond.wait(timeout=0.2)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10.0)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None and self._closed:
+                    return
+                job, self._pending = self._pending, None
+                self._busy = True
+                self._cond.notify_all()
+            try:
+                self._run(job)
+            except Exception as e:  # never kill the lane
+                logger.error("checkpoint serializer failed: %s", e)
+            with self._cond:
+                self._busy = False
+                self._cond.notify_all()
+
+
+class _PersistQueue:
+    """Single persist worker behind a bounded queue.
+
+    In-flight persists (queued + running) never exceed ``depth`` — a
+    slow store can pin at most ``depth`` archives, not one per save.
+    Overflow policy: a same-step entry is superseded in place; else the
+    oldest NON-forced queued entry is dropped and counted
+    (``dlrover_checkpoint_persist_skipped_total`` — newest data wins);
+    if nothing is skippable the incoming non-forced save is the one
+    skipped. Forced saves are never dropped: their submitter blocks
+    until there is room (back-pressure on ``force_persist``)."""
+
+    def __init__(self, run_fn: Callable[[_PersistJob], None],
+                 depth: int, on_skip: Callable[[_PersistJob, str], None]):
+        self._run = run_fn
+        self._depth = max(1, int(depth))
+        self._on_skip = on_skip
+        self._cond = threading.Condition()
+        self._q: List[_PersistJob] = []
+        self._busy = False
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ckpt-persist"
+        )
+        self._thread.start()
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def _inflight_locked(self) -> int:
+        return len(self._q) + (1 if self._busy else 0)
+
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight_locked()
+
+    def _gauge_locked(self) -> None:
+        gauge(
+            "dlrover_checkpoint_persist_queue_depth",
+            "Persist archives in flight (queued + running)",
+        ).set(self._inflight_locked())
+
+    def submit(self, job: _PersistJob) -> bool:
+        """Returns True when the job was accepted (queued or
+        superseded a queued same-step entry), False when skipped."""
+        with self._cond:
+            if self._closed:
+                job.abandon()
+                return False
+            for i, queued in enumerate(self._q):
+                if queued.step == job.step:
+                    self._q[i] = job
+                    self._cond.notify_all()
+                    self._on_skip(queued, "superseded")
+                    return True
+            if job.force:
+                while (
+                    self._inflight_locked() >= self._depth
+                    and not self._closed
+                ):
+                    self._cond.wait(timeout=0.5)
+                if self._closed:
+                    job.abandon()
+                    return False
+            elif self._inflight_locked() >= self._depth:
+                idx = next(
+                    (i for i, e in enumerate(self._q) if not e.force),
+                    None,
+                )
+                if idx is None:
+                    self._on_skip(job, "queue_full")
+                    return False
+                self._on_skip(self._q.pop(idx), "overflow")
+            self._q.append(job)
+            self._gauge_locked()
+            self._cond.notify_all()
+            return True
+
+    def drain(self) -> None:
+        if threading.current_thread() is self._thread:
+            return
+        with self._cond:
+            while self._q or self._busy:
+                if self._closed:
+                    return
+                self._cond.wait(timeout=0.2)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10.0)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._closed:
+                    self._cond.wait()
+                if not self._q and self._closed:
+                    return
+                job = self._q.pop(0)
+                self._busy = True
+                self._gauge_locked()
+                self._cond.notify_all()
+            try:
+                self._run(job)
+            except Exception as e:  # worker survives any one failure
+                logger.error(
+                    "persist worker failed for step %d: %s", job.step, e
+                )
+            with self._cond:
+                self._busy = False
+                self._gauge_locked()
+                self._cond.notify_all()
+
+
+class FlashCheckpointer:
+    """Two-tier async checkpointer with a zero-stall save path.
+
+    save(step, state): stages the device->host snapshot (copy dispatch
+    only — the stall is microseconds, independent of serialization and
+    near-independent of state size) and returns; the serializer lane
+    materializes the staged shards and streams the archive to the RAM
+    tier (tmpfs), then hands the persistent save to a bounded persist
+    worker when ``step % persist_interval == 0`` (or force_persist).
+
+    ``queue_depth`` bounds in-flight persist archives (default 2, env
+    ENV_QUEUE_DEPTH); ``stage`` picks async (default) or sync D2H
+    staging (env ENV_STAGE; see the donation-safety contract in
+    docs/CHECKPOINT.md and :meth:`wait_staged`).
     """
 
     def __init__(
@@ -160,6 +475,8 @@ class FlashCheckpointer:
         max_persist_keep: int = 3,
         use_orbax: bool = True,
         commit_timeout: float = 300.0,
+        queue_depth: Optional[int] = None,
+        stage: Optional[str] = None,
     ):
         self.persist_dir = (
             persist_dir if ckpt_store.is_url(persist_dir)
@@ -185,8 +502,25 @@ class FlashCheckpointer:
 
         self._attempt = os.getenv(NodeEnv.RDZV_ROUND, "0")
         os.makedirs(self.ram_dir, exist_ok=True)
-        self._persist_lock = threading.Lock()
-        self._pending_persist: Optional[threading.Thread] = None
+        if queue_depth is None:
+            queue_depth = int(os.getenv(ENV_QUEUE_DEPTH, "2") or 2)
+        self.queue_depth = max(1, queue_depth)
+        if stage is None:
+            stage = os.getenv(ENV_STAGE, "async")
+        if stage not in ("async", "sync"):
+            raise ValueError(f"stage must be async|sync, got {stage!r}")
+        self._stage_sync = stage == "sync"
+        # workers start lazily on the first save(): restore-only
+        # instances (evaluator, spare hosts) never spawn threads
+        self._workers_lock = threading.Lock()
+        self._serializer: Optional[_SerializerLane] = None
+        self._persistq: Optional[_PersistQueue] = None
+        self._last_save: Optional[_SaveJob] = None
+        self._closed = False
+        # RAM-tier files referenced by queued/running persist jobs must
+        # survive _gc_ram until the upload finished
+        self._pin_lock = threading.Lock()
+        self._pinned: Dict[str, int] = {}
         self._use_orbax = use_orbax
         self._manager = None
         self._store: Optional[ckpt_store.ObjectStore] = None
@@ -212,46 +546,157 @@ class FlashCheckpointer:
 
     # ------------------------------------------------------------------ save
 
-    def save(self, step: int, state: Any, force_persist: bool = False):
-        """RAM snapshot now; persistent save (async) on cadence."""
-        t0 = time.time()
-        snapshot = _local_shards(state)
-        # serialize ONCE; both tiers write the same archive bytes
-        data = ckpt_store.snapshot_to_bytes(snapshot, step)
-        self._write_ram(step, data)
-        ram_ms = (time.time() - t0) * 1000
-        logger.info("Flash save step %d: RAM tier in %.0f ms", step, ram_ms)
-        _observe_ckpt(
-            "save", "ram", step, ram_ms / 1000.0, bytes=len(data),
+    def save(self, step: int, state: Any,
+             force_persist: bool = False,
+             durable: bool = False) -> float:
+        """Stage the snapshot and return; serialization + both tier
+        writes happen behind the step loop. Returns the train-thread
+        stall in milliseconds.
+
+        ``durable=True`` additionally blocks until the RAM-tier
+        archive is on tmpfs (surviving an immediate HARD kill of this
+        process — ``os._exit``, SIGKILL). That is the pre-pipeline
+        cost profile: use it only where a drill/caller needs
+        crash-durability at a specific step; a normal step loop keeps
+        the zero-stall default and accepts a serialize-window of
+        durability lag (docs/CHECKPOINT.md)."""
+        t0 = time.perf_counter()
+        staged = _stage_local_shards(state, sync=self._stage_sync)
+        job = _SaveJob(
+            step=step,
+            staged=staged,
+            persist_due=force_persist or (
+                self.persist_interval > 0
+                and step % self.persist_interval == 0
+            ),
+            force=force_persist,
         )
-        if force_persist or (
-            self.persist_interval > 0 and step % self.persist_interval == 0
-        ):
-            self._persist_async(step, state, data)
-        return ram_ms
+        if self._stage_sync:
+            job.staged_evt.set()  # host copies already owned
+        self._ensure_workers()
+        self._last_save = job
+        self._serializer.submit(job)  # blocks only when the lane is full
+        if durable:
+            self._serializer.drain()
+        stall_s = time.perf_counter() - t0
+        histogram(
+            "dlrover_checkpoint_save_stall_seconds",
+            "Train-thread stall per checkpoint save (staging only)",
+            buckets=_STALL_BUCKETS,
+        ).observe(stall_s)
+        logger.info(
+            "Flash save step %d: staged in %.2f ms (train-thread stall)",
+            step, stall_s * 1e3,
+        )
+        return stall_s * 1e3
+
+    def wait_staged(self, timeout: Optional[float] = None) -> bool:
+        """Block until the most recent save()'s snapshot is fully
+        materialized on the host. THE DONATION SYNC POINT: a train
+        loop whose step donates the state buffers must call this
+        before dispatching the step that invalidates them (or
+        construct the checkpointer with ``stage="sync"``)."""
+        job = self._last_save
+        return job.staged_evt.wait(timeout) if job is not None else True
+
+    def _ensure_workers(self) -> None:
+        if self._serializer is not None:
+            return
+        with self._workers_lock:
+            if self._serializer is not None:
+                return
+            if self._closed:
+                raise RuntimeError("checkpointer is closed")
+            self._persistq = _PersistQueue(
+                self._run_persist, self.queue_depth, self._skip_persist
+            )
+            self._serializer = _SerializerLane(
+                self._serialize_job, "ckpt-serialize"
+            )
+            atexit.register(self._atexit_flush)
+
+    def _atexit_flush(self) -> None:
+        # daemon workers die with the interpreter; a clean exit right
+        # after a save must still land it (examples/drills exit the
+        # step loop and return without close())
+        try:
+            self.wait()
+        except Exception:
+            pass
+
+    def _serialize_job(self, job: _SaveJob) -> None:
+        """Serializer lane: materialize the staged D2H copies, stream
+        the archive to the RAM tier, then hand off persistence."""
+        t0 = time.perf_counter()
+        try:
+            snapshot = _materialize_staged(job.staged)
+            job.staged = None  # drop device handles promptly
+            job.staged_evt.set()
+            nbytes = self._write_ram(job.step, snapshot)
+            dt = time.perf_counter() - t0
+            logger.info(
+                "Flash save step %d: RAM tier in %.0f ms (pipelined)",
+                job.step, dt * 1e3,
+            )
+            _observe_ckpt(
+                "save", "ram", job.step, dt, bytes=nbytes,
+            )
+            self._gc_ram()
+        except Exception as e:
+            job.staged_evt.set()
+            logger.error(
+                "RAM-tier save step %d failed: %s", job.step, e
+            )
+            _observe_ckpt(
+                "save", "ram", job.step, time.perf_counter() - t0,
+                ok=False, reason=str(e)[:200],
+            )
+            return
+        if job.persist_due:
+            self._enqueue_persist(job.step, snapshot, job.force)
 
     def _ram_path(self, step: int) -> str:
         return os.path.join(
             self.ram_dir, f"step-{step}-proc-{self._process_index}"
         )
 
-    def _write_ram(self, step: int, data: bytes):
+    def _write_ram(self, step: int, snapshot: Any) -> int:
         path = self._ram_path(step)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(data)
+            nbytes = ckpt_store.snapshot_to_file(snapshot, step, f)
         os.replace(tmp, path)
-        self._gc_ram()
+        return nbytes
+
+    def _pin(self, path: str) -> None:
+        with self._pin_lock:
+            self._pinned[path] = self._pinned.get(path, 0) + 1
+
+    def _unpin(self, path: str) -> None:
+        with self._pin_lock:
+            n = self._pinned.get(path, 0) - 1
+            if n <= 0:
+                self._pinned.pop(path, None)
+            else:
+                self._pinned[path] = n
 
     def _gc_ram(self):
         records = self._list_ram()
+        with self._pin_lock:
+            pinned = set(self._pinned)
         for step, path in records[: -self.max_ram_keep]:
+            if path in pinned:
+                continue  # a persist upload still streams from it
             try:
                 os.remove(path)
             except OSError:
                 pass
 
     def _list_ram(self):
+        # let queued saves land first so listings (and the gc/consensus
+        # decisions built on them) see every save already issued;
+        # no-op when called from the serializer lane itself (gc)
+        self._drain_saves()
         records = []
         suffix = f"-proc-{self._process_index}"
         try:
@@ -268,96 +713,133 @@ class FlashCheckpointer:
             pass
         return sorted(records)
 
-    def _persist_async(self, step: int, state: Any, data: bytes):
-        payload = [data]  # holder so the thread can drop the bytes
+    def _enqueue_persist(self, step: int, snapshot: Any,
+                         force: bool) -> None:
+        """Serializer lane -> persist queue handoff. The store branch
+        references the RAM-tier file (pinned against gc) so a queued
+        persist costs a tmpfs path, not an in-memory archive; the
+        Orbax branch carries the host snapshot captured at save() time
+        — the background worker must NEVER touch the live device state
+        (donation may have invalidated it by then)."""
+        if self._manager is not None:
+            job = _PersistJob(step, ("orbax", snapshot), force)
+        else:
+            path = self._ram_path(step)
+            self._pin(path)
+            job = _PersistJob(
+                step, ("store", path), force,
+                abandon=lambda: self._unpin(path),
+            )
+        self._persistq.submit(job)
 
-        def work():
-            t0 = time.time()
-            try:
-                if self._manager is not None:
-                    with self._persist_lock:
-                        self._manager.save(
-                            step,
-                            args=__import__(
-                                "orbax.checkpoint", fromlist=["args"]
-                            ).args.StandardSave(jax.device_get(state)),
-                        )
-                    logger.info("Persistent save step %d done", step)
-                    _observe_ckpt(
-                        "save", "persistent", step, time.time() - t0,
-                        backend="orbax",
-                    )
-                    return
-                # the lock covers only the fast shard upload; the
-                # (possibly long) peer-await for COMMIT runs outside
-                # it, and the archive bytes are released first —
-                # otherwise a dead peer stalls every queued save and
-                # each queued thread pins a full archive in memory
-                with self._persist_lock:
-                    ckpt_store.put_shard(
-                        self._store, step, self._process_index,
-                        payload.pop(), attempt=self._attempt,
-                    )
-                if self._process_index != 0:
-                    # only rank 0 knows whether the step COMMITs;
-                    # claiming "done" here misleads incident triage
-                    # when the commit barrier later times out
-                    logger.info(
-                        "Persistent save step %d: shard uploaded "
-                        "(awaiting rank-0 commit)", step,
-                    )
-                    return
-                committed = ckpt_store.commit_step(
-                    self._store, step, self._n_processes,
-                    attempt=self._attempt,
-                    timeout=self.commit_timeout,
+    def _skip_persist(self, job: _PersistJob, reason: str) -> None:
+        job.abandon()
+        counter(
+            "dlrover_checkpoint_persist_skipped_total",
+            "Persistent saves dropped by the bounded queue",
+            ["reason"],
+        ).labels(reason=reason).inc()
+        record(
+            "checkpoint.persist_skipped", step=job.step, reason=reason,
+            queue_depth=self.queue_depth,
+        )
+        logger.warning(
+            "Persistent save step %d skipped (%s): persist queue "
+            "bounded at %d", job.step, reason, self.queue_depth,
+        )
+
+    def _run_persist(self, job: _PersistJob) -> None:
+        t0 = time.time()
+        step = job.step
+        kind, payload = job.payload
+        try:
+            if kind == "orbax":
+                # single-host assembly of the staged snapshot; parity
+                # with the old jax.device_get(state) tree, minus the
+                # background-thread device access
+                host_state = _restore_shards(payload)
+                self._manager.save(
+                    step,
+                    args=__import__(
+                        "orbax.checkpoint", fromlist=["args"]
+                    ).args.StandardSave(host_state),
                 )
-                if committed:
-                    with self._persist_lock:
-                        # one gc'er: concurrent per-process deletes
-                        # of the same objects race for no benefit
-                        ckpt_store.gc_steps(
-                            self._store, self.max_persist_keep
-                        )
-                    logger.info("Persistent save step %d done", step)
-                    _observe_ckpt(
-                        "save", "persistent", step, time.time() - t0,
-                        backend="store",
-                    )
-                else:
-                    logger.error(
-                        "Persistent save step %d NOT committed: peer "
-                        "shards missing after %.0fs", step,
-                        self.commit_timeout,
-                    )
-                    _observe_ckpt(
-                        "save", "persistent", step, time.time() - t0,
-                        ok=False, reason="commit_timeout",
-                    )
-            except Exception as e:
-                logger.error("Persistent save step %d failed: %s",
-                             step, e)
+                logger.info("Persistent save step %d done", step)
                 _observe_ckpt(
                     "save", "persistent", step, time.time() - t0,
-                    ok=False, reason=str(e)[:200],
+                    backend="orbax",
                 )
-
-        t = threading.Thread(target=work, daemon=True,
-                             name=f"persist-ckpt-{step}")
-        t.start()
-        self._pending_persist = t
+                return
+            try:
+                with open(payload, "rb") as f:
+                    size = os.fstat(f.fileno()).st_size
+                    ckpt_store.put_shard_stream(
+                        self._store, step, self._process_index, f,
+                        attempt=self._attempt, size=size,
+                    )
+            finally:
+                job.abandon()  # upload done/failed: unpin the RAM file
+            if self._process_index != 0:
+                # only rank 0 knows whether the step COMMITs;
+                # claiming "done" here misleads incident triage
+                # when the commit barrier later times out
+                logger.info(
+                    "Persistent save step %d: shard uploaded "
+                    "(awaiting rank-0 commit)", step,
+                )
+                return
+            committed = ckpt_store.commit_step(
+                self._store, step, self._n_processes,
+                attempt=self._attempt,
+                timeout=self.commit_timeout,
+            )
+            if committed:
+                ckpt_store.gc_steps(self._store, self.max_persist_keep)
+                logger.info("Persistent save step %d done", step)
+                _observe_ckpt(
+                    "save", "persistent", step, time.time() - t0,
+                    backend="store",
+                )
+            else:
+                logger.error(
+                    "Persistent save step %d NOT committed: peer "
+                    "shards missing after %.0fs", step,
+                    self.commit_timeout,
+                )
+                _observe_ckpt(
+                    "save", "persistent", step, time.time() - t0,
+                    ok=False, reason="commit_timeout",
+                )
+        except Exception as e:
+            logger.error("Persistent save step %d failed: %s", step, e)
+            _observe_ckpt(
+                "save", "persistent", step, time.time() - t0,
+                ok=False, reason=str(e)[:200],
+            )
 
     def wait(self):
-        """Block until in-flight persistent saves finish."""
-        t = self._pending_persist
-        if t is not None:
-            t.join()
+        """Block until EVERY in-flight save — staged, serializing, and
+        queued/running persists — has finished (not just the last one:
+        close() must never orphan an uncommitted save)."""
+        if self._serializer is not None:
+            self._serializer.drain()
+        if self._persistq is not None:
+            self._persistq.drain()
         if self._manager is not None:
             self._manager.wait_until_finished()
 
     # --------------------------------------------------------------- restore
 
+    def _drain_saves(self) -> None:
+        """Make queued-but-unserialized saves visible to readers: the
+        RAM tier is written by the serializer lane, so listings and
+        restores first let in-flight saves land (no-op from the
+        pipeline's own threads)."""
+        if self._serializer is not None:
+            self._serializer.drain()
+
     def latest_step(self) -> Optional[int]:
+        self._drain_saves()
         ram = self._list_ram()
         ram_step = ram[-1][0] if ram else None
         persist_step = None
@@ -431,6 +913,7 @@ class FlashCheckpointer:
         either every process restores the consensus step or every
         process starts fresh — never a mix.
         """
+        self._drain_saves()
         auto_mode = step is None
         if not (auto_mode and self._n_processes > 1):
             # no agreement collective on this path: let failures
@@ -523,8 +1006,8 @@ class FlashCheckpointer:
         if step in ram:
             try:
                 with open(ram[step], "rb") as f:
-                    snapshot, _ = ckpt_store.snapshot_from_bytes(
-                        f.read(), target
+                    snapshot, _ = ckpt_store.snapshot_from_file(
+                        f, target
                     )
                 state = _restore_shards(snapshot, target)
                 logger.info("Restored step %d from RAM tier", step)
@@ -576,12 +1059,12 @@ class FlashCheckpointer:
             ]
         for cand in candidates:
             try:
-                data = ckpt_store.read_step(
+                with ckpt_store.open_step(
                     self._store, cand, self._process_index
-                )
-                snapshot, _ = ckpt_store.snapshot_from_bytes(
-                    data, target
-                )
+                ) as f:
+                    snapshot, _ = ckpt_store.snapshot_from_file(
+                        f, target
+                    )
             except (KeyError, ckpt_store.ArchiveError) as e:
                 # missing OR corrupt: keep walking down — an unreadable
                 # newest step must not abort the promised fallback
@@ -621,6 +1104,23 @@ class FlashCheckpointer:
             return ok
 
     def close(self):
+        """Flush every in-flight save, then stop the pipeline threads.
+        Idempotent; the instance refuses new saves afterwards."""
         self.wait()
+        with self._workers_lock:
+            if self._closed:
+                return
+            self._closed = True
+            serializer, self._serializer = self._serializer, None
+            persistq, self._persistq = self._persistq, None
+        if serializer is not None:
+            serializer.close()
+        if persistq is not None:
+            persistq.close()
+        if serializer is not None or persistq is not None:
+            try:
+                atexit.unregister(self._atexit_flush)
+            except Exception:
+                pass
         if self._manager is not None:
             self._manager.close()

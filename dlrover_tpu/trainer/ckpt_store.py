@@ -35,9 +35,10 @@ disk cannot provide that).
 import io
 import json
 import os
+import shutil
 import zipfile
 from abc import ABC, abstractmethod
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, BinaryIO, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -48,8 +49,13 @@ __all__ = [
     "GcsStore",
     "get_store",
     "snapshot_to_bytes",
+    "snapshot_to_file",
     "snapshot_from_bytes",
+    "snapshot_from_file",
 ]
+
+#: chunk size for streaming copies between files and object stores
+_STREAM_CHUNK = 1 << 20
 
 
 class ArchiveError(ValueError):
@@ -106,9 +112,14 @@ def _is_snap(x) -> bool:
     return isinstance(x, dict) and x.get("__jax_shards__") is True
 
 
-def snapshot_to_bytes(snapshot: Any, step: int) -> bytes:
-    """Serialize a local-shard snapshot pytree to a safe archive.
+def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO) -> int:
+    """Stream a local-shard snapshot pytree to ``fileobj`` as a safe
+    archive; returns the bytes written (-1 if the file can't tell()).
 
+    Each npy member is written directly into the zip as the tree is
+    walked, so peak extra memory is ONE shard's staging buffer — never
+    a full in-memory copy of the archive (the old ``snapshot_to_bytes``
+    BytesIO held archive + ``getvalue()`` copy, ~2-3x state size).
     Leaves may be shard-snap dicts (from ``_local_shards``), numpy
     arrays/scalars, or JSON primitives; anything else raises
     ArchiveError at SAVE time (loud, not latent).
@@ -127,60 +138,82 @@ def snapshot_to_bytes(snapshot: Any, step: int) -> bytes:
         # carry ml_dtypes types (they load back as void)
         "encodings": {},
     }
-    arrays: Dict[str, np.ndarray] = {}
+    counter = [0]
 
-    def add_array(arr) -> str:
-        name = f"a{len(arrays)}"
-        arr = np.asarray(arr)
-        if arr.dtype.kind == "V" or arr.dtype.name not in np.sctypeDict:
-            manifest["encodings"][name] = {
-                "dtype": arr.dtype.name,
-                "shape": list(arr.shape),
-            }
-            arr = np.frombuffer(arr.tobytes(), dtype=np.uint8)
-        arrays[name] = arr
-        return name
+    with zipfile.ZipFile(
+        fileobj, "w", zipfile.ZIP_STORED, allowZip64=True
+    ) as zf:
 
-    for path, leaf in leaves:
-        entry: Dict[str, Any] = {"path": _path_components(path)}
-        if _is_snap(leaf):
-            entry["kind"] = "shards"
-            entry["shape"] = list(leaf["shape"])
-            entry["dtype"] = str(leaf["dtype"])
-            entry["shards"] = [
-                {"idx": _index_to_json(idx), "a": add_array(data)}
-                for idx, data in leaf["shards"]
-            ]
-        elif isinstance(leaf, (np.ndarray, np.generic)):
-            entry["kind"] = "array"
-            entry["a"] = add_array(leaf)
-        elif leaf is None or isinstance(leaf, (bool, int, float, str)):
-            entry["kind"] = "py"
-            entry["v"] = leaf
-        else:
-            raise ArchiveError(
-                f"unserializable checkpoint leaf of type "
-                f"{type(leaf).__name__} at {path}"
-            )
-        manifest["leaves"].append(entry)
+        def add_array(arr) -> str:
+            name = f"a{counter[0]}"
+            counter[0] += 1
+            arr = np.asarray(arr)
+            if (
+                arr.dtype.kind == "V"
+                or arr.dtype.name not in np.sctypeDict
+            ):
+                manifest["encodings"][name] = {
+                    "dtype": arr.dtype.name,
+                    "shape": list(arr.shape),
+                }
+                arr = np.frombuffer(arr.tobytes(), dtype=np.uint8)
+            if not arr.flags["C_CONTIGUOUS"]:
+                # ascontiguousarray only when needed: it promotes 0-d
+                # scalars to 1-d, which would corrupt shard shapes
+                arr = np.ascontiguousarray(arr)
+            with zf.open(name + ".npy", "w", force_zip64=True) as m:
+                np.lib.format.write_array(m, arr, allow_pickle=False)
+            return name
 
+        for path, leaf in leaves:
+            entry: Dict[str, Any] = {"path": _path_components(path)}
+            if _is_snap(leaf):
+                entry["kind"] = "shards"
+                entry["shape"] = list(leaf["shape"])
+                entry["dtype"] = str(leaf["dtype"])
+                entry["shards"] = [
+                    {"idx": _index_to_json(idx), "a": add_array(data)}
+                    for idx, data in leaf["shards"]
+                ]
+            elif isinstance(leaf, (np.ndarray, np.generic)):
+                entry["kind"] = "array"
+                entry["a"] = add_array(leaf)
+            elif leaf is None or isinstance(leaf, (bool, int, float, str)):
+                entry["kind"] = "py"
+                entry["v"] = leaf
+            else:
+                raise ArchiveError(
+                    f"unserializable checkpoint leaf of type "
+                    f"{type(leaf).__name__} at {path}"
+                )
+            manifest["leaves"].append(entry)
+
+        zf.writestr(
+            _MANIFEST, json.dumps(manifest, separators=(",", ":"))
+        )
+    try:
+        return fileobj.tell()
+    except (OSError, AttributeError):
+        return -1
+
+
+def snapshot_to_bytes(snapshot: Any, step: int) -> bytes:
+    """Serialize a snapshot to bytes (compat wrapper; prefer
+    :func:`snapshot_to_file` which never double-buffers the archive)."""
     buf = io.BytesIO()
-    # npz is a zip of .npy members; we add the manifest as one more
-    # member so a single object carries the whole per-process snapshot
-    np.savez(buf, **arrays)
-    buf.seek(0, io.SEEK_END)
-    with zipfile.ZipFile(buf, "a") as zf:
-        zf.writestr(_MANIFEST, json.dumps(manifest, separators=(",", ":")))
+    snapshot_to_file(snapshot, step, buf)
     return buf.getvalue()
 
 
-def _load_archive(data: bytes):
+def _load_archive_file(fileobj: BinaryIO):
+    """Parse + validate an archive from a SEEKABLE binary file object
+    (tmpfs file, store stream, or BytesIO) without requiring the whole
+    archive as a bytes value first."""
     try:
-        buf = io.BytesIO(data)
-        with zipfile.ZipFile(buf) as zf:
+        with zipfile.ZipFile(fileobj) as zf:
             manifest = json.loads(zf.read(_MANIFEST).decode("utf-8"))
-        buf.seek(0)
-        arrays = np.load(buf, allow_pickle=False)
+        fileobj.seek(0)
+        arrays = np.load(fileobj, allow_pickle=False)
         # materialize while the file object is open
         arrays = {k: arrays[k] for k in arrays.files if k != _MANIFEST}
     except ArchiveError:
@@ -212,6 +245,10 @@ def _load_archive(data: bytes):
                 f"encoding: {e}"
             )
     return manifest, arrays
+
+
+def _load_archive(data: bytes):
+    return _load_archive_file(io.BytesIO(data))
 
 
 def _leaf_from_entry(entry, arrays):
@@ -253,9 +290,16 @@ def snapshot_from_bytes(data: bytes, target: Any = None):
     and dict components both become dict keys) — enough for consumers
     like the evaluator that read params by name.
     """
+    return snapshot_from_file(io.BytesIO(data), target)
+
+
+def snapshot_from_file(fileobj: BinaryIO, target: Any = None):
+    """:func:`snapshot_from_bytes` over a seekable file object — the
+    streaming read half: restore never needs the raw archive bytes as
+    one in-memory value."""
     import jax
 
-    manifest, arrays = _load_archive(data)
+    manifest, arrays = _load_archive_file(fileobj)
     entries = manifest["leaves"]
     step = int(manifest["step"])
 
@@ -330,6 +374,19 @@ class ObjectStore(ABC):
         except KeyError:
             return False
 
+    def put_stream(self, key: str, fileobj: BinaryIO,
+                   size: Optional[int] = None) -> None:
+        """Upload from a file object. The base default buffers (small
+        stores/tests); LocalFsStore and GcsStore stream in chunks so a
+        multi-GB archive never needs a contiguous bytes value."""
+        self.put(key, fileobj.read())
+
+    def open_read(self, key: str) -> BinaryIO:
+        """A seekable binary reader for ``key`` (KeyError if absent).
+        The base default wraps ``get``; LocalFsStore opens the backing
+        file directly (no whole-object copy)."""
+        return io.BytesIO(self.get(key))
+
 
 class LocalFsStore(ObjectStore):
     """Directory-backed shim with object-store semantics (the test
@@ -387,6 +444,21 @@ class LocalFsStore(ObjectStore):
         # metadata-only: the base-class default get()s the whole blob
         return os.path.isfile(self._fs_path(key))
 
+    def put_stream(self, key: str, fileobj: BinaryIO,
+                   size: Optional[int] = None) -> None:
+        path = self._fs_path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            shutil.copyfileobj(fileobj, f, _STREAM_CHUNK)
+        os.replace(tmp, path)
+
+    def open_read(self, key: str) -> BinaryIO:
+        try:
+            return open(self._fs_path(key), "rb")
+        except FileNotFoundError:
+            raise KeyError(key)
+
 
 class GcsStore(ObjectStore):  # pragma: no cover - needs cloud creds
     """gs:// bucket via google.cloud.storage (gated: not in this image)."""
@@ -434,6 +506,20 @@ class GcsStore(ObjectStore):  # pragma: no cover - needs cloud creds
     def exists(self, key: str) -> bool:
         # metadata-only HEAD, not a full download
         return self._bucket.blob(self._key(key)).exists()
+
+    def put_stream(self, key: str, fileobj: BinaryIO,
+                   size: Optional[int] = None) -> None:
+        # resumable chunked upload: the client never holds the whole
+        # archive; pairs with snapshot_to_file's streaming writer
+        self._bucket.blob(self._key(key)).upload_from_file(
+            fileobj, size=size
+        )
+
+    def open_read(self, key: str) -> BinaryIO:
+        blob = self._bucket.blob(self._key(key))
+        if not blob.exists():
+            raise KeyError(key)
+        return blob.open("rb")
 
 
 def get_store(url: str) -> ObjectStore:
@@ -494,6 +580,26 @@ def put_shard(store: ObjectStore, step: int, process_index: int,
               data: bytes, attempt: str = "0") -> None:
     """The fast half of write_step: upload this process's shard."""
     store.put(step_key(step, process_index, attempt), data)
+
+
+def put_shard_stream(store: ObjectStore, step: int, process_index: int,
+                     fileobj: BinaryIO, attempt: str = "0",
+                     size: Optional[int] = None) -> None:
+    """put_shard from a file object (the RAM-tier tmpfs archive) —
+    chunked upload, never a full in-memory copy of the archive."""
+    store.put_stream(
+        step_key(step, process_index, attempt), fileobj, size=size
+    )
+
+
+def open_step(store: ObjectStore, step: int,
+              process_index: int) -> BinaryIO:
+    """Streaming read of this process's shard for a COMMITTED step
+    (KeyError if uncommitted or missing)."""
+    manifest = _commit_manifest(store, step)
+    return store.open_read(
+        step_key(step, process_index, str(manifest.get("attempt", "0")))
+    )
 
 
 def commit_step(store: ObjectStore, step: int, n_processes: int,

@@ -105,6 +105,8 @@ class ElasticTrainer:
         self._report_interval = report_interval
         self._step_cache = {}
         self._global_step = 0
+        self._checkpointer = None
+        self._ckpt_interval = 0
         self._hang_detector = None
         self._fault_injector = None
         self._created_ts = time.monotonic()
@@ -262,6 +264,41 @@ class ElasticTrainer:
                 )
             except Exception as e:
                 logger.warning("report_global_step failed: %s", e)
+
+    # ---------------------------------------------------------- checkpoint
+
+    def attach_checkpointer(self, checkpointer,
+                            save_interval: int = 10) -> None:
+        """Register a :class:`~dlrover_tpu.trainer.checkpoint.
+        FlashCheckpointer` on the step cadence. The save path is
+        zero-stall (async D2H staging + background serialization), so
+        a small ``save_interval`` is cheap — failover loses at most
+        ``save_interval`` steps, not a persist interval."""
+        self._checkpointer = checkpointer
+        self._ckpt_interval = max(0, int(save_interval))
+
+    def maybe_checkpoint(self, state, step: Optional[int] = None,
+                         force: bool = False) -> Optional[float]:
+        """Save ``state`` when the attached cadence is due (call after
+        each step with the post-update state). Returns the train-thread
+        stall in ms when a save was issued, else None. Checkpoint
+        failures are reported, never raised into the step loop."""
+        if self._checkpointer is None:
+            return None
+        step = self._global_step if step is None else step
+        due = force or (
+            self._ckpt_interval > 0 and step > 0
+            and step % self._ckpt_interval == 0
+        )
+        if not due:
+            return None
+        try:
+            return self._checkpointer.save(
+                step, state, force_persist=force
+            )
+        except Exception as e:  # checkpointing never stops training
+            logger.warning("flash save at step %d failed: %s", step, e)
+            return None
 
     @property
     def global_step(self) -> int:

@@ -119,6 +119,10 @@ def main():
                 step,
                 {"params": params, "opt_state": opt_state,
                  "step": jnp.array(step)},
+                # durable: the failover drills hard-kill (os._exit)
+                # shortly after a cadence step — the archive must
+                # already be on tmpfs, not in the async serializer
+                durable=True,
             )
 
     loss_val = float(loss) if loss is not None else float("nan")
@@ -127,6 +131,9 @@ def main():
     acc = float(
         jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(labels[:256]))
     )
+    # flush the async save pipeline before exit: the final
+    # checkpoint must land even though save() no longer blocks
+    ckpt.close()
     print(f"FINAL step={step} loss={loss_val:.6f} acc={acc:.3f}",
           flush=True)
     if args.out:

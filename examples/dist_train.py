@@ -139,12 +139,19 @@ def main():
                 {"params": jax.device_get(params),
                  "opt_state": jax.device_get(opt_state),
                  "step": jnp.array(step)},
+                # durable: the failover drills hard-kill (os._exit)
+                # shortly after a cadence step — the archive must
+                # already be on tmpfs, not in the async serializer
+                durable=True,
             )
         dt = time.time() - t0
         if dt < args.step_time:
             time.sleep(args.step_time - dt)
 
     loss_val = float(loss) if loss is not None else float("nan")
+    # flush the async save pipeline before exit: the final
+    # checkpoint must land even though save() no longer blocks
+    ckpt.close()
     print(f"FINAL step={step} loss={loss_val:.6f} world={world}",
           flush=True)
     if args.out:

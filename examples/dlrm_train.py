@@ -125,6 +125,10 @@ def main():
                 step,
                 {"params": params, "opt_state": opt_state,
                  "step": jnp.array(step)},
+                # durable: the failover drills hard-kill (os._exit)
+                # shortly after a cadence step — the archive must
+                # already be on tmpfs, not in the async serializer
+                durable=True,
             )
 
     loss_val = float(loss) if loss is not None else float("nan")
@@ -136,6 +140,9 @@ def main():
     acc = float(jnp.mean(
         (logits > 0).astype(jnp.int32) == jnp.asarray(labels[:512])
     ))
+    # flush the async save pipeline before exit: the final
+    # checkpoint must land even though save() no longer blocks
+    ckpt.close()
     print(f"FINAL step={step} loss={loss_val:.6f} acc={acc:.3f}",
           flush=True)
     if args.out:

@@ -150,6 +150,10 @@ def main():
                     step,
                     {"params": params, "opt_state": opt_state,
                      "step": jax.numpy.array(step)},
+                    # durable: the failover drills hard-kill (os._exit)
+                    # shortly after a cadence step — the archive must
+                    # already be on tmpfs, not in the async serializer
+                    durable=True,
                 )
             if step >= args.steps:
                 break
@@ -157,6 +161,9 @@ def main():
         loader.shutdown()
 
     loss_val = float(loss) if loss is not None else float("nan")
+    # flush the async save pipeline before exit: the final
+    # checkpoint must land even though save() no longer blocks
+    ckpt.close()
     print(f"FINAL step={step} loss={loss_val:.6f}", flush=True)
     if args.out:
         with open(args.out, "w") as f:

@@ -109,11 +109,18 @@ def main():
                 step,
                 {"params": params, "opt_state": opt_state,
                  "step": jnp.array(step)},
+                # durable: the failover drills hard-kill (os._exit)
+                # shortly after a cadence step — the archive must
+                # already be on tmpfs, not in the async serializer
+                durable=True,
             )
 
     # loss stays None when the loop body never ran (e.g. restored checkpoint
     # already at/after --steps, or the dataset was exhausted immediately)
     loss_val = float(loss) if loss is not None else float("nan")
+    # flush the async save pipeline before exit: the final
+    # checkpoint must land even though save() no longer blocks
+    ckpt.close()
     print(f"FINAL step={step} loss={loss_val:.6f}", flush=True)
     if args.out:
         with open(args.out, "w") as f:
