@@ -49,6 +49,7 @@ donation-safety contract (``wait_staged``).
 """
 
 import atexit
+import io
 import os
 import threading
 import time
@@ -262,10 +263,13 @@ class _PersistJob:
 
     ``payload`` is ``("store", ram_file_path)`` — the worker streams
     the already-serialized tmpfs archive into the object store (never
-    a full in-memory copy) — or ``("orbax", snapshot)`` holding the
-    materialized host snapshot captured at save() time (NEVER re-read
-    from device state on the background thread: with donation the
-    train loop may have invalidated those buffers long ago)."""
+    a full in-memory copy) — or ``("orbax", snapshot)`` /
+    ``("snapshot", snapshot)`` holding the materialized host snapshot
+    captured at save() time (NEVER re-read from device state on the
+    background thread: with donation the train loop may have
+    invalidated those buffers long ago). The ``"snapshot"`` kind is
+    the store branch's RAM-write-failure fallback: the worker builds
+    the archive in memory so a due persist is never silently lost."""
 
     step: int
     payload: Tuple[str, Any]
@@ -559,7 +563,10 @@ class FlashCheckpointer:
         cost profile: use it only where a drill/caller needs
         crash-durability at a specific step; a normal step loop keeps
         the zero-stall default and accepts a serialize-window of
-        durability lag (docs/CHECKPOINT.md)."""
+        durability lag (docs/CHECKPOINT.md). The returned stall covers
+        the full durable drain, but the stall histogram keeps
+        recording staging dispatch only — durable saves must not skew
+        the zero-stall budget it alerts on."""
         t0 = time.perf_counter()
         staged = _stage_local_shards(state, sync=self._stage_sync)
         job = _SaveJob(
@@ -576,14 +583,20 @@ class FlashCheckpointer:
         self._ensure_workers()
         self._last_save = job
         self._serializer.submit(job)  # blocks only when the lane is full
-        if durable:
-            self._serializer.drain()
         stall_s = time.perf_counter() - t0
         histogram(
             "dlrover_checkpoint_save_stall_seconds",
             "Train-thread stall per checkpoint save (staging only)",
             buckets=_STALL_BUCKETS,
         ).observe(stall_s)
+        if durable:
+            self._serializer.drain()
+            total_s = time.perf_counter() - t0
+            logger.info(
+                "Flash save step %d: staged in %.2f ms, durable on "
+                "tmpfs in %.0f ms", step, stall_s * 1e3, total_s * 1e3,
+            )
+            return total_s * 1e3
         logger.info(
             "Flash save step %d: staged in %.2f ms (train-thread stall)",
             step, stall_s * 1e3,
@@ -626,12 +639,35 @@ class FlashCheckpointer:
 
     def _serialize_job(self, job: _SaveJob) -> None:
         """Serializer lane: materialize the staged D2H copies, stream
-        the archive to the RAM tier, then hand off persistence."""
+        the archive to the RAM tier, then hand off persistence. A
+        RAM-tier write failure must NOT drop a due persist — the
+        materialized snapshot is still good, so the persist proceeds
+        from it (forced persists are guaranteed never skipped); only a
+        staging failure truly loses the save, and that loss is counted
+        (``persist_skipped{reason="stage_failed"}``) so failover
+        drills can detect it."""
         t0 = time.perf_counter()
         try:
             snapshot = _materialize_staged(job.staged)
             job.staged = None  # drop device handles promptly
             job.staged_evt.set()
+        except Exception as e:
+            job.staged_evt.set()
+            logger.error(
+                "staging snapshot for step %d failed: %s", job.step, e
+            )
+            _observe_ckpt(
+                "save", "ram", job.step, time.perf_counter() - t0,
+                ok=False, reason=str(e)[:200],
+            )
+            if job.persist_due:
+                self._skip_persist(
+                    _PersistJob(job.step, ("none", None), job.force),
+                    "stage_failed",
+                )
+            return
+        ram_ok = True
+        try:
             nbytes = self._write_ram(job.step, snapshot)
             dt = time.perf_counter() - t0
             logger.info(
@@ -643,7 +679,7 @@ class FlashCheckpointer:
             )
             self._gc_ram()
         except Exception as e:
-            job.staged_evt.set()
+            ram_ok = False
             logger.error(
                 "RAM-tier save step %d failed: %s", job.step, e
             )
@@ -651,9 +687,10 @@ class FlashCheckpointer:
                 "save", "ram", job.step, time.perf_counter() - t0,
                 ok=False, reason=str(e)[:200],
             )
-            return
         if job.persist_due:
-            self._enqueue_persist(job.step, snapshot, job.force)
+            self._enqueue_persist(
+                job.step, snapshot, job.force, ram_ok=ram_ok
+            )
 
     def _ram_path(self, step: int) -> str:
         return os.path.join(
@@ -714,22 +751,32 @@ class FlashCheckpointer:
         return sorted(records)
 
     def _enqueue_persist(self, step: int, snapshot: Any,
-                         force: bool) -> None:
+                         force: bool, ram_ok: bool = True) -> None:
         """Serializer lane -> persist queue handoff. The store branch
         references the RAM-tier file (pinned against gc) so a queued
         persist costs a tmpfs path, not an in-memory archive; the
         Orbax branch carries the host snapshot captured at save() time
         — the background worker must NEVER touch the live device state
-        (donation may have invalidated it by then)."""
+        (donation may have invalidated it by then). When the RAM write
+        failed (``ram_ok=False``) the store branch falls back to
+        carrying the snapshot itself and the worker builds the archive
+        in memory — the only persist path paying a full in-memory
+        copy, and still bounded by the queue like any other job."""
         if self._manager is not None:
             job = _PersistJob(step, ("orbax", snapshot), force)
-        else:
+        elif ram_ok:
             path = self._ram_path(step)
             self._pin(path)
             job = _PersistJob(
                 step, ("store", path), force,
                 abandon=lambda: self._unpin(path),
             )
+        else:
+            logger.warning(
+                "RAM tier for step %d unavailable; persisting from "
+                "the in-memory snapshot", step,
+            )
+            job = _PersistJob(step, ("snapshot", snapshot), force)
         self._persistq.submit(job)
 
     def _skip_persist(self, job: _PersistJob, reason: str) -> None:
@@ -744,8 +791,8 @@ class FlashCheckpointer:
             queue_depth=self.queue_depth,
         )
         logger.warning(
-            "Persistent save step %d skipped (%s): persist queue "
-            "bounded at %d", job.step, reason, self.queue_depth,
+            "Persistent save step %d skipped (%s; queue depth %d)",
+            job.step, reason, self.queue_depth,
         )
 
     def _run_persist(self, job: _PersistJob) -> None:
@@ -770,15 +817,26 @@ class FlashCheckpointer:
                     backend="orbax",
                 )
                 return
-            try:
-                with open(payload, "rb") as f:
-                    size = os.fstat(f.fileno()).st_size
-                    ckpt_store.put_shard_stream(
-                        self._store, step, self._process_index, f,
-                        attempt=self._attempt, size=size,
-                    )
-            finally:
-                job.abandon()  # upload done/failed: unpin the RAM file
+            extra = {}
+            if kind == "store":
+                try:
+                    with open(payload, "rb") as f:
+                        size = os.fstat(f.fileno()).st_size
+                        ckpt_store.put_shard_stream(
+                            self._store, step, self._process_index, f,
+                            attempt=self._attempt, size=size,
+                        )
+                finally:
+                    job.abandon()  # upload done/failed: unpin RAM file
+            else:  # "snapshot": RAM tier failed — archive from memory
+                buf = io.BytesIO()
+                size = ckpt_store.snapshot_to_file(payload, step, buf)
+                buf.seek(0)
+                ckpt_store.put_shard_stream(
+                    self._store, step, self._process_index, buf,
+                    attempt=self._attempt, size=size,
+                )
+                extra = {"source": "memory"}
             if self._process_index != 0:
                 # only rank 0 knows whether the step COMMITs;
                 # claiming "done" here misleads incident triage
@@ -798,7 +856,7 @@ class FlashCheckpointer:
                 logger.info("Persistent save step %d done", step)
                 _observe_ckpt(
                     "save", "persistent", step, time.time() - t0,
-                    backend="store",
+                    backend="store", **extra,
                 )
             else:
                 logger.error(

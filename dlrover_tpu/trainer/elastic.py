@@ -180,11 +180,31 @@ class ElasticTrainer:
     @property
     def train_step(self):
         key = self._accum_steps
-        if key not in self._step_cache:
-            self._step_cache[key] = make_elastic_train_step(
+        step_fn = self._step_cache.get(key)
+        if step_fn is None:
+            jitted = make_elastic_train_step(
                 self._loss_fn, self._optimizer, key
             )
-        return self._step_cache[key]
+
+            def step_fn(params, opt_state, batches):
+                # donation-safety contract (docs/CHECKPOINT.md): the
+                # jitted step donates (params, opt_state), and an
+                # async flash save may still hold un-materialized
+                # device handles on them — wait out the staging before
+                # the dispatch that invalidates the buffers. No save
+                # in flight (or sync staging) makes this a no-op.
+                ckpt = self._checkpointer
+                if ckpt is not None:
+                    wait = getattr(ckpt, "wait_staged", None)
+                    if wait is not None:
+                        wait()
+                return jitted(params, opt_state, batches)
+
+            # profiler.profile_step reuses the shared jit cache via
+            # .lower — keep it reachable through the wrapper
+            step_fn.lower = jitted.lower
+            self._step_cache[key] = step_fn
+        return step_fn
 
     def microbatch(self, batch):
         """Split a per-host batch into the accum microbatch layout
@@ -273,7 +293,16 @@ class ElasticTrainer:
         FlashCheckpointer` on the step cadence. The save path is
         zero-stall (async D2H staging + background serialization), so
         a small ``save_interval`` is cheap — failover loses at most
-        ``save_interval`` steps, not a persist interval."""
+        ``save_interval`` steps, not a persist interval.
+
+        Donation safety: :attr:`train_step` donates (params,
+        opt_state); once a checkpointer is attached it calls
+        ``wait_staged()`` before each dispatch, so an async-staged
+        save owns its host copies before donation can invalidate the
+        source buffers. A step loop driving its OWN donating jit
+        function must call ``checkpointer.wait_staged()`` itself (or
+        build the checkpointer with ``stage="sync"``) — see
+        docs/CHECKPOINT.md."""
         self._checkpointer = checkpointer
         self._ckpt_interval = max(0, int(save_interval))
 

@@ -271,6 +271,31 @@ def test_durable_save_lands_on_tmpfs_before_returning(tmp_path):
     ckpt.close()
 
 
+def test_durable_drain_excluded_from_stall_histogram(tmp_path,
+                                                     monkeypatch):
+    """durable=True blocks for the serializer drain, but the stall
+    histogram is the staging-only zero-stall budget — the drain must
+    not skew it (alerting keys off the ~25ms back-pressure buckets).
+    The return value still reports the full train-thread cost."""
+    real = ckpt_store.snapshot_to_file
+
+    def slow(snapshot, step, fileobj):
+        time.sleep(0.3)
+        return real(snapshot, step, fileobj)
+
+    monkeypatch.setattr(ckpt_store, "snapshot_to_file", slow)
+    ckpt = _ckpt(tmp_path, persist_interval=0)
+    ret = ckpt.save(9, _state(), durable=True)
+    assert ret >= 300.0  # the drain is the caller's visible cost
+    hist = T.default_registry().get(
+        "dlrover_checkpoint_save_stall_seconds"
+    )
+    child = hist._default_child()
+    assert child.count == 1
+    assert child.sum < 0.25  # the 0.3s serialize drain stayed out
+    ckpt.close()
+
+
 def test_stage_then_materialize_owns_memory():
     staged = _stage_local_shards({"w": jnp.arange(8.0)})
     snap = _materialize_staged(staged)
@@ -457,6 +482,55 @@ def test_ram_gc_spares_files_pinned_by_pending_persist(tmp_path):
     assert step == 1
 
 
+def test_ram_write_failure_still_persists_due_save(tmp_path,
+                                                   monkeypatch):
+    """A RAM-tier write failure must not silently drop a due persist
+    (forced persists are documented as never skipped): the worker
+    falls back to building the archive in memory from the snapshot
+    materialized at save() time."""
+    ckpt = _ckpt(tmp_path, persist_interval=1)
+    state = _state()
+
+    def boom(step, snapshot):
+        raise OSError("tmpfs full")
+
+    monkeypatch.setattr(ckpt, "_write_ram", boom)
+    ckpt.save(4, state, force_persist=True)
+    ckpt.wait()
+    ckpt.close()
+    assert ckpt_store.committed_steps(ckpt._store) == [4]
+    data = ckpt_store.read_step(ckpt._store, 4, 0)
+    got, step = ckpt_store.snapshot_from_bytes(data, target=state)
+    assert step == 4
+    np.testing.assert_array_equal(
+        got["params"]["w"]["shards"][0][1],
+        np.asarray(state["params"]["w"]),
+    )
+
+
+def test_stage_failure_counts_lost_persist(tmp_path, monkeypatch):
+    """When staging itself fails there is nothing to persist — the
+    loss must be observable (persist_skipped{reason=stage_failed} +
+    journal), never just a log line a failover drill can't see."""
+    import dlrover_tpu.trainer.checkpoint as ckpt_mod
+
+    def boom(staged):
+        raise RuntimeError("D2H failed")
+
+    monkeypatch.setattr(ckpt_mod, "_materialize_staged", boom)
+    ckpt = _ckpt(tmp_path, persist_interval=1)
+    ckpt.save(2, _state(), force_persist=True)
+    ckpt.wait()
+    ckpt.close()
+    skipped = T.default_registry().get(
+        "dlrover_checkpoint_persist_skipped_total"
+    )
+    assert skipped is not None
+    assert sum(c._value for _, c in skipped._snapshot()) >= 1
+    evts = T.default_journal().events("checkpoint.persist_skipped")
+    assert any(e["data"].get("reason") == "stage_failed" for e in evts)
+
+
 # --------------------------------------------------------------- elastic tie
 
 
@@ -487,3 +561,103 @@ def test_elastic_trainer_save_cadence(tmp_path):
         lambda p, b: 0.0, optax.sgd(0.1), max_nodes=1, cur_nodes=1,
     )
     assert trainer2.maybe_checkpoint(state) is None
+
+
+def test_elastic_train_step_calls_wait_staged_when_attached():
+    """ElasticTrainer's jitted step donates (params, opt_state): with
+    a checkpointer attached, every train_step dispatch must hit the
+    donation sync point first (docs/CHECKPOINT.md contract)."""
+    import optax
+
+    from dlrover_tpu.trainer.elastic import ElasticTrainer
+
+    class SpyCkpt:
+        def __init__(self):
+            self.waits = 0
+
+        def wait_staged(self, timeout=None):
+            self.waits += 1
+            return True
+
+    optimizer = optax.sgd(0.1)
+    trainer = ElasticTrainer(
+        lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+        optimizer, max_nodes=1, cur_nodes=1,
+    )
+    params = {"w": jnp.ones((3, 1))}
+    opt_state = optimizer.init(params)
+    batches = (jnp.ones((1, 4, 3)), jnp.zeros((1, 4, 1)))
+    # unattached: no sync point, the step runs as-is
+    params, opt_state, _ = trainer.train_step(params, opt_state, batches)
+    spy = SpyCkpt()
+    trainer.attach_checkpointer(spy, save_interval=1)
+    for _ in range(2):
+        params, opt_state, _ = trainer.train_step(
+            params, opt_state, batches
+        )
+    assert spy.waits == 2
+    # profiler path still reaches the shared jit cache
+    assert hasattr(trainer.train_step, "lower")
+
+
+def test_elastic_train_step_blocks_until_staging_materializes(
+        tmp_path, monkeypatch):
+    """The donation race end-to-end: an async save's device handles
+    are still un-materialized when the next (donating) step would
+    dispatch — the wrapped train_step must block until the serializer
+    owns host copies, and the checkpoint must restore the pre-step
+    values."""
+    import optax
+
+    import dlrover_tpu.trainer.checkpoint as ckpt_mod
+    from dlrover_tpu.trainer.elastic import ElasticTrainer
+
+    optimizer = optax.sgd(0.1)
+    trainer = ElasticTrainer(
+        lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+        optimizer, max_nodes=1, cur_nodes=1,
+    )
+    params = {"w": jnp.ones((3, 1))}
+    opt_state = optimizer.init(params)
+    batches = (jnp.ones((1, 4, 3)), jnp.zeros((1, 4, 1)))
+    # warm the jit cache so the blocking assertion below never
+    # measures compile time
+    params, opt_state, _ = trainer.train_step(params, opt_state, batches)
+
+    entered = threading.Event()
+    release = threading.Event()
+    real = ckpt_mod._materialize_staged
+
+    def gated(staged):
+        entered.set()
+        assert release.wait(10.0), "test deadlock"
+        return real(staged)
+
+    monkeypatch.setattr(ckpt_mod, "_materialize_staged", gated)
+    ckpt = _ckpt(tmp_path, persist_interval=0)
+    trainer.attach_checkpointer(ckpt, save_interval=1)
+    expect = np.asarray(params["w"]).copy()
+    trainer.report_step()
+    assert trainer.maybe_checkpoint((params, opt_state)) is not None
+    assert entered.wait(5.0)
+
+    done = threading.Event()
+
+    def run():
+        out = trainer.train_step(params, opt_state, batches)
+        jax.block_until_ready(out[:2])
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # the donating dispatch is gated on staging materialization
+    assert not done.wait(0.5)
+    release.set()
+    assert done.wait(10.0)
+    ckpt.wait()
+    restored, step = ckpt.restore()
+    assert step == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored[0]["w"]), expect
+    )
+    ckpt.close()
