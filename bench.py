@@ -291,6 +291,16 @@ def main():
     float(loss)  # host transfer = hard sync (the axon tunnel does not
     # honor block_until_ready)
 
+    # per-phase breakdown via span tracing (docs/TELEMETRY.md): ring
+    # only, armed AFTER warmup so compile time never pollutes the
+    # phase means. The spans measure TRAIN-THREAD time: "data" is the
+    # host-side wait on the feed, "dispatch" the step call (async
+    # dispatch until the device queue back-pressures)
+    from dlrover_tpu.telemetry import tracing
+
+    tracing.clear()
+    tracing.enable()
+
     t0 = time.perf_counter()
     ckpt_pending = False
     for i in range(steps):
@@ -301,12 +311,16 @@ def main():
             # host copies before this dispatch invalidates the source
             # buffers; reported separately from the dispatch stall
             tw = time.perf_counter()
-            ckpt.wait_staged()
+            with tracing.span("ckpt.wait_staged"):
+                ckpt.wait_staged()
             ckpt_waits.append((time.perf_counter() - tw) * 1e3)
             ckpt_pending = False
-        params, opt_state, loss = trainer.train_step(
-            params, opt_state, next_mb()
-        )
+        with tracing.span("data"):
+            b = next_mb()
+        with tracing.span("dispatch"):
+            params, opt_state, loss = trainer.train_step(
+                params, opt_state, b
+            )
         if ckpt is not None and (i + 1) % args.ckpt_interval == 0:
             ckpt_stalls.append(
                 ckpt.save(i + 1, (params, opt_state))
@@ -316,6 +330,10 @@ def main():
     # so this waits for all 20 steps without a per-step host round-trip
     loss_val = float(loss)
     dt = time.perf_counter() - t0
+    phases = tracing.summarize(
+        ("data", "dispatch", "ckpt.wait_staged", "ckpt.stage")
+    )
+    tracing.disable()
 
     if ckpt is not None:
         ckpt.close()  # outside the timed window: drains the pipeline
@@ -392,6 +410,22 @@ def main():
         "attn_block_q": sel["block_q"] if sel else None,
         "attn_block_k": sel["block_k"] if sel else None,
         "attn_tuning_source": sel["source"] if sel else None,
+        # per-phase train-thread breakdown from the span layer (where
+        # step time goes: feed wait vs dispatch; docs/TELEMETRY.md) —
+        # ckpt_wait_staged_ms / ckpt_stall_ms below stay the donation
+        # and staging costs when --ckpt-interval is on
+        "data_ms": round(
+            phases.get("data", {}).get("mean_ms", 0.0), 3
+        ),
+        "data_ms_max": round(
+            phases.get("data", {}).get("max_ms", 0.0), 3
+        ),
+        "dispatch_ms": round(
+            phases.get("dispatch", {}).get("mean_ms", 0.0), 3
+        ),
+        "dispatch_ms_max": round(
+            phases.get("dispatch", {}).get("max_ms", 0.0), 3
+        ),
     }
     if ckpt_stalls:
         # train-thread cost of the flash saves inside the timed loop

@@ -98,6 +98,13 @@ class HangingDetector:
                 return 0.0
             return time.monotonic() - self._last_step_time
 
+    @property
+    def last_step(self) -> int:
+        """The last completed step (-1 before the first one) — the
+        ``/healthz`` degraded payload and flight records carry it."""
+        with self._lock:
+            return self._last_step
+
     def is_hanged(self) -> bool:
         elapsed = self.stalled_for()
         return elapsed > 0 and elapsed > self.timeout()
@@ -140,10 +147,24 @@ class HangingDetector:
             "dlrover_hang_stalls_total",
             "Stalls the step-progress hang detector flagged",
         ).inc()
+        # flight record FIRST: the report_fn path can end in the master
+        # restarting this process — the stacks must be on disk by then
+        dump_path = None
+        try:
+            from dlrover_tpu.telemetry import flight_recorder
+
+            dump_path = flight_recorder.dump_on_hang(
+                stalled_for=elapsed, step=step,
+                threshold=self.timeout(),
+            )
+        except Exception as e:  # diagnosis never blocks the report
+            logger.warning("hang flight record failed: %s", e)
         record(
             "hang.detected", step=step,
+            stalled_for=round(elapsed, 1),
             stalled_s=round(elapsed, 1),
             threshold_s=round(self.timeout(), 1),
+            flight_record=dump_path,
         )
         if self._report_fn is not None:
             self._report_fn(elapsed)

@@ -20,7 +20,7 @@ from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import NetworkFailureReason
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.telemetry import counter, gauge, histogram, record
+from dlrover_tpu.telemetry import counter, gauge, histogram, record, tracing
 
 #: seconds from first join to round completion: sub-second same-host
 #: re-forms up to multi-minute fleet-wide cold starts
@@ -51,6 +51,14 @@ def _observe_round(name: str, rdzv_round: int, world: Dict[int, int],
         "rendezvous.complete", name=name, round=rdzv_round,
         nodes=sorted(world), world_size=len(world),
         duration_s=round(duration, 3),
+    )
+    # retroactive span (first join -> completion): rendezvous rounds
+    # show up on the merged timeline next to the step/checkpoint spans
+    tracing.add_span(
+        "rdzv." + name,
+        started_ts if started_ts else time.time() - duration,
+        duration,
+        attrs={"round": rdzv_round, "world_size": len(world)},
     )
 
 

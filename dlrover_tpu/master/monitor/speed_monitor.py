@@ -1,17 +1,42 @@
-"""Global-step throughput monitor.
+"""Global-step throughput monitor + per-host straggler diagnosis.
 
 Parity reference: dlrover/python/master/monitor/speed_monitor.py:43
 (GlobalStepRecord, collect_global_step:81, running_speed:113).
+
+Straggler scoring (ISSUE 4): every ``report_global_step`` RPC carries
+the reporting host's node_id, so the monitor keeps a per-host window of
+step durations (the host's own report cadence — seconds per step seen
+from that host). A host whose rolling median runs more than
+``straggler_ratio`` × the fleet's rolling median for
+``straggler_window`` consecutive evaluations is journaled as
+``straggler.detected`` and surfaces in :meth:`straggler_ranks`, the
+hint :class:`~dlrover_tpu.master.node.job_auto_scaler.
+AllreduceTrainingAutoScaler` unions with the network-check verdicts.
+Training is collective, so one slow host drags EVERY host's cadence —
+but the straggler's reports arrive late relative to its own previous
+reports only when the slowness is local (data stall, host-side GC,
+thermal throttle), which is exactly the case the network-check probe
+cannot see once training started.
 """
 
+import os
 import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Set, Tuple
+from statistics import median
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from dlrover_tpu.common.global_context import Context
-from dlrover_tpu.telemetry import gauge
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, gauge, histogram, record
 
 _context = Context.singleton_instance()
+
+#: per-host step durations: millisecond steps up to multi-minute ones
+_STEP_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 300.0,
+)
 
 
 @dataclass
@@ -24,7 +49,8 @@ class GlobalStepRecord:
 class SpeedMonitor:
     """Sliding window of global-step records -> running speed (steps/s)."""
 
-    def __init__(self):
+    def __init__(self, straggler_ratio: Optional[float] = None,
+                 straggler_window: Optional[int] = None):
         self._global_step_records: List[GlobalStepRecord] = []
         self._workers: Set[Tuple[str, int]] = set()
         self._max_record_count = _context.train_speed_record_num
@@ -36,6 +62,24 @@ class SpeedMonitor:
         self._task_completed_times: Dict[int, float] = {}
         self._has_step_reports = False
         self._batches_done = 0
+        # ---- per-host straggler scoring state (ISSUE 4) ----
+        # a host is flagged when its rolling-median step duration runs
+        # > straggler_ratio x the fleet median for straggler_window
+        # consecutive evaluations (persistence beats one slow sample)
+        if straggler_ratio is None:
+            straggler_ratio = float(
+                os.getenv("DLROVER_TPU_STRAGGLER_RATIO", "1.5")
+            )
+        if straggler_window is None:
+            straggler_window = int(
+                os.getenv("DLROVER_TPU_STRAGGLER_WINDOW", "3")
+            )
+        self._straggler_ratio = max(1.01, straggler_ratio)
+        self._straggler_window = max(1, straggler_window)
+        self._host_last: Dict[int, Tuple[int, float]] = {}
+        self._host_durations: Dict[int, Deque[float]] = {}
+        self._straggler_strikes: Dict[int, int] = {}
+        self._stragglers: Set[int] = set()
 
     def set_target_worker_num(self, worker_num: int):
         self._target_worker_num = worker_num
@@ -57,6 +101,14 @@ class SpeedMonitor:
             "dlrover_training_workers",
             "Workers the speed monitor counts as running",
         ).set(len(self._workers))
+        # a removed host's history must not keep skewing the fleet
+        # median (nor keep it on the straggler list after eviction)
+        self._host_last.pop(node_id, None)
+        self._host_durations.pop(node_id, None)
+        self._straggler_strikes.pop(node_id, None)
+        if node_id in self._stragglers:
+            self._stragglers.discard(node_id)
+            self._set_straggler_gauge()
 
     @property
     def running_workers(self):
@@ -75,7 +127,10 @@ class SpeedMonitor:
         return self._global_step
 
     def collect_global_step(self, global_step: int, timestamp: float,
-                            _source: str = "step"):
+                            _source: str = "step",
+                            node_id: Optional[int] = None):
+        if _source == "step" and node_id is not None and node_id >= 0:
+            self._observe_host_step(node_id, global_step, timestamp)
         if _source == "step" and not self._has_step_reports:
             self._has_step_reports = True
             if self._batches_done:
@@ -118,6 +173,108 @@ class SpeedMonitor:
         self.collect_global_step(
             self._batches_done, timestamp, _source="batch"
         )
+
+    # ------------------------------------------------ straggler diagnosis
+
+    def _observe_host_step(self, node_id: int, global_step: int,
+                           timestamp: float) -> None:
+        """Fold one host's step report into its duration window, then
+        re-score. Durations are per-host deltas between the host's OWN
+        consecutive reports — cross-host clock skew cancels out."""
+        last = self._host_last.get(node_id)
+        self._host_last[node_id] = (global_step, timestamp)
+        if last is None:
+            return
+        s0, t0 = last
+        if global_step <= s0 or timestamp <= t0:
+            return  # restart/replay or duplicate report: no signal
+        duration = (timestamp - t0) / (global_step - s0)
+        histogram(
+            "dlrover_host_step_duration_seconds",
+            "Per-host step duration seen from that host's reports",
+            ["node"], buckets=_STEP_BUCKETS,
+        ).labels(node=str(node_id)).observe(duration)
+        durs = self._host_durations.setdefault(
+            node_id, deque(maxlen=self._max_record_count)
+        )
+        durs.append(duration)
+        self._score_stragglers()
+
+    def _set_straggler_gauge(self) -> None:
+        gauge(
+            "dlrover_straggler_hosts",
+            "Hosts currently flagged by the step-cadence scorer",
+        ).set(len(self._stragglers))
+
+    def _score_stragglers(self) -> None:
+        """One scoring pass over the per-host rolling medians. Needs
+        at least two samples per host and two reporting hosts — a
+        fleet of one has no peer to be slower than."""
+        per_host = {
+            n: median(d)
+            for n, d in self._host_durations.items() if len(d) >= 2
+        }
+        if len(per_host) < 2:
+            return
+        fleet = median(per_host.values())
+        if fleet <= 0:
+            return
+        for node_id, dur in per_host.items():
+            ratio = dur / fleet
+            gauge(
+                "dlrover_host_step_duration_ratio",
+                "Host rolling-median step duration over fleet median",
+                ["node"],
+            ).labels(node=str(node_id)).set(round(ratio, 3))
+            if dur > self._straggler_ratio * fleet:
+                strikes = self._straggler_strikes.get(node_id, 0) + 1
+                self._straggler_strikes[node_id] = strikes
+                if (
+                    strikes >= self._straggler_window
+                    and node_id not in self._stragglers
+                ):
+                    self._stragglers.add(node_id)
+                    self._set_straggler_gauge()
+                    counter(
+                        "dlrover_stragglers_detected_total",
+                        "Hosts flagged by the step-cadence scorer",
+                    ).inc()
+                    record(
+                        "straggler.detected", node=node_id,
+                        step_duration_s=round(dur, 4),
+                        fleet_median_s=round(fleet, 4),
+                        ratio=round(ratio, 3),
+                        window=self._straggler_window,
+                        step=self._global_step,
+                    )
+                    logger.warning(
+                        "Straggler: node %d runs %.2fx the fleet "
+                        "median step time (%.3fs vs %.3fs)",
+                        node_id, ratio, dur, fleet,
+                    )
+            else:
+                self._straggler_strikes.pop(node_id, None)
+                if node_id in self._stragglers:
+                    self._stragglers.discard(node_id)
+                    self._set_straggler_gauge()
+                    record(
+                        "straggler.recovered", node=node_id,
+                        step_duration_s=round(dur, 4),
+                        fleet_median_s=round(fleet, 4),
+                        step=self._global_step,
+                    )
+
+    def straggler_ranks(self) -> List[int]:
+        """Hosts currently over the straggler threshold — the speed
+        hint the auto-scaler unions with network-check verdicts."""
+        return sorted(self._stragglers)
+
+    def host_step_durations(self) -> Dict[int, float]:
+        """Per-host rolling-median step duration (diagnostics/tests)."""
+        return {
+            n: median(d)
+            for n, d in self._host_durations.items() if d
+        }
 
     def running_speed(self) -> float:
         """Steps/sec over the windowed records of the CURRENT world

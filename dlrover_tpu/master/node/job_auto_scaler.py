@@ -135,9 +135,28 @@ class AllreduceTrainingAutoScaler:
             return
         live = mgr.unfinished_nodes()
         live_ranks = {n.rank_index for n in live}
-        stragglers = [
-            r for r in (self._straggler_fn() or []) if r in live_ranks
-        ]
+        hints = set(self._straggler_fn() or [])
+        # the speed monitor's step-cadence scorer feeds a second hint
+        # stream (ISSUE 4): hosts whose own report cadence ran over the
+        # fleet median for a sustained window. Network-check verdicts
+        # see link slowness before training; the cadence scorer sees
+        # host-local slowness DURING training — union them.
+        speed_hint_fn = getattr(monitor, "straggler_ranks", None)
+        if speed_hint_fn is not None:
+            try:
+                speed_hints = set(speed_hint_fn() or [])
+            except Exception:
+                speed_hints = set()
+            fresh = speed_hints - hints
+            if fresh:
+                from dlrover_tpu.telemetry import record
+
+                record(
+                    "straggler.hint", source="speed_monitor",
+                    nodes=sorted(fresh),
+                )
+            hints |= speed_hints
+        stragglers = sorted(r for r in hints if r in live_ranks)
         if not stragglers:
             return
         plan = self._job_optimizer.generate_straggler_shrink_plan(

@@ -22,7 +22,7 @@ from dlrover_tpu.master.elastic_training.kv_store_service import (
     KVStoreService,
 )
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
-from dlrover_tpu.telemetry import counter, histogram, record
+from dlrover_tpu.telemetry import counter, histogram, record, tracing
 
 #: sub-millisecond KV polls up to multi-second shard waits
 _RPC_BUCKETS = (
@@ -73,7 +73,8 @@ class MasterServicer:
         ).labels(method=method).inc()
         t0 = time.perf_counter()
         try:
-            return fn(message)
+            with tracing.span("rpc." + method):
+                return fn(message)
         except Exception:
             counter(
                 "dlrover_rpc_errors_total",
@@ -357,7 +358,11 @@ class MasterServicer:
 
     def rpc_report_global_step(self, req: comm.GlobalStep) -> comm.Response:
         if self._speed_monitor:
-            self._speed_monitor.collect_global_step(req.step, req.timestamp)
+            # node_id attributes the report to its host so the speed
+            # monitor can score per-host step cadence (stragglers)
+            self._speed_monitor.collect_global_step(
+                req.step, req.timestamp, node_id=req.node_id
+            )
         if self._job_metric_collector:
             self._job_metric_collector.collect_runtime_stats(
                 self._speed_monitor,

@@ -9,9 +9,17 @@ Three pieces, one substrate (ISSUE 2):
     JSONL event journal (monotonic seq, wall time, host/process
     attribution) all control-plane events write through;
   * :mod:`~dlrover_tpu.telemetry.http` — the stdlib ``/metrics`` +
-    ``/journal`` endpoint the master and agents serve;
+    ``/journal`` (+ ``/debug/stacks``, ``/debug/trace``) endpoint the
+    master and agents serve;
+  * :mod:`~dlrover_tpu.telemetry.tracing` — low-overhead span timing
+    with per-process write-through files and Chrome trace export
+    (ISSUE 4);
+  * :mod:`~dlrover_tpu.telemetry.flight_recorder` — crash-dump capture
+    (all-thread stacks, span tail, journal tail, metrics snapshot) on
+    hangs and fatal signals (ISSUE 4);
   * ``python -m dlrover_tpu.telemetry.dump`` renders a journal into a
-    human-readable timeline.
+    human-readable timeline (``--trace`` merges per-process span files
+    into one Chrome trace).
 """
 
 from dlrover_tpu.telemetry.journal import (
@@ -33,8 +41,10 @@ from dlrover_tpu.telemetry.registry import (
     histogram,
     set_default_registry,
 )
+from dlrover_tpu.telemetry import tracing
 
 __all__ = [
+    "tracing",
     "Counter",
     "Gauge",
     "Histogram",

@@ -7,11 +7,19 @@ stalls stand out. ``--kind`` filters (prefix match on dotted kinds),
 ``--json`` re-emits the ordered events as JSONL (for piping into jq
 after the multi-process sort).
 
+``--trace`` switches modes: the path is a trace directory written by
+span tracing (``DLROVER_TPU_TRACE_DIR`` — one ``spans-<host>-<pid>.
+jsonl`` per process) and the output is ONE merged Chrome trace-event
+JSON covering every process, loadable in Perfetto / chrome://tracing
+(``-o merged.json`` writes a file; default stdout).
+
 Example::
 
     $ python -m dlrover_tpu.telemetry.dump /tmp/job.journal
     2026-08-04 10:00:01.202 +0.000s [host-0 p0] rendezvous.complete  round=1 nodes=[0, 1] duration_s=2.1
     2026-08-04 10:00:43.910 +42.708s [host-0 p0] checkpoint.save     tier=ram step=100 ms=18.2
+
+    $ python -m dlrover_tpu.telemetry.dump /tmp/job-trace --trace -o merged.json
 """
 
 import argparse
@@ -62,17 +70,62 @@ def render(events: List[Dict], kind: Optional[str] = None,
     return "\n".join(lines)
 
 
+def dump_trace(path: str, out: str = "") -> int:
+    """Merge a span-trace directory (or one span file) into a single
+    Chrome trace JSON; deterministic for fixed inputs."""
+    from dlrover_tpu.telemetry import tracing
+
+    try:
+        trace = tracing.merge_trace_dir(path)
+    except OSError as e:
+        print(f"cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    events = trace["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    pids = sorted({e["pid"] for e in spans})
+    body = json.dumps(trace, default=str, sort_keys=True)
+    if out:
+        with open(out, "w") as f:
+            f.write(body)
+    else:
+        print(body)
+    print(
+        f"-- {len(spans)} spans from {len(pids)} process(es)"
+        f" {pids if pids else ''}"
+        + (f" -> {out}" if out else ""),
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dlrover_tpu.telemetry.dump",
-        description="Render an event journal as a readable timeline",
+        description="Render an event journal as a readable timeline, "
+        "or merge a span-trace directory into Chrome trace JSON",
     )
-    ap.add_argument("journal", help="path to the JSONL journal file")
+    ap.add_argument(
+        "journal",
+        help="path to the JSONL journal file (or, with --trace, the "
+        "trace directory holding per-process spans-*.jsonl files)",
+    )
     ap.add_argument("--kind", default=None,
                     help="filter by event kind (dotted-prefix match)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit ordered JSONL instead of the timeline")
+    ap.add_argument(
+        "--trace", action="store_true", dest="as_trace",
+        help="merge per-process span files into one Chrome "
+        "trace-event JSON (chrome://tracing / Perfetto)",
+    )
+    ap.add_argument(
+        "-o", "--out", default="",
+        help="with --trace: write the merged trace here (default "
+        "stdout)",
+    )
     args = ap.parse_args(argv)
+    if args.as_trace:
+        return dump_trace(args.journal, args.out)
     try:
         events = read_journal(args.journal)
     except OSError as e:

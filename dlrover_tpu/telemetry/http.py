@@ -1,13 +1,20 @@
 """Tiny stdlib HTTP endpoint serving the telemetry surface.
 
 Runs on the master and on each agent (a scraper federates the fleet by
-hitting every host). Three routes:
+hitting every host). Routes:
 
   * ``GET /metrics``  — Prometheus text exposition of the registry;
   * ``GET /metrics.json`` — the same snapshot as JSON (tests/bench);
   * ``GET /journal``  — the in-memory tail of the event journal
     (``?n=50`` bounds it; ``?kind=checkpoint`` filters by kind prefix);
-  * ``GET /healthz``  — liveness probe.
+  * ``GET /healthz``  — liveness probe. With a hang detector attached
+    (:func:`attach_hang_detector`) a stalled training loop turns the
+    probe into 503 + ``{"status": "degraded", "stalled_for": ...}`` so
+    a K8s liveness/readiness probe can act on hangs, not just deaths;
+  * ``GET /debug/stacks`` — live all-thread Python stacks (the flight
+    recorder's view, on demand);
+  * ``GET /debug/trace`` — the span ring as Chrome trace-event JSON
+    (``?n=500`` bounds it); load it in Perfetto / chrome://tracing.
 
 stdlib ``ThreadingHTTPServer`` on a daemon thread: no dependency, no
 lifecycle coupling — the process exiting takes the server with it, and
@@ -34,7 +41,61 @@ __all__ = [
     "ENV_METRICS_PORT",
     "MetricsServer",
     "start_metrics_server",
+    "attach_hang_detector",
+    "set_health_check",
 ]
+
+# -------------------------------------------------------------- health state
+#
+# Module-level, not per-server: the HangingDetector lives wherever the
+# training loop runs, the server wherever the process started one — a
+# process-global attach point means whichever server this process runs
+# reports the degradation without threading a reference through every
+# constructor.
+
+_health_lock = threading.Lock()
+_health_check = None  # () -> Optional[dict]; truthy dict == degraded
+
+
+def set_health_check(fn) -> None:
+    """Install the process-wide degraded-state probe: a zero-arg
+    callable returning None when healthy, or a JSON-able payload dict
+    when degraded (served as 503). None clears it."""
+    global _health_check
+    with _health_lock:
+        _health_check = fn
+
+
+def attach_hang_detector(detector) -> None:
+    """Point ``/healthz`` at a
+    :class:`~dlrover_tpu.fault_tolerance.hanging_detector.
+    HangingDetector`: while it observes a stall the probe answers 503
+    with the stall age, so an orchestrator can restart a hung (but
+    alive) process."""
+
+    def check():
+        if not detector.is_hanged():
+            return None
+        return {
+            "stalled_for": round(detector.stalled_for(), 1),
+            "threshold": round(detector.timeout(), 1),
+            "last_step": detector.last_step,
+        }
+
+    set_health_check(check)
+
+
+def _current_health():
+    with _health_lock:
+        check = _health_check
+    if check is None:
+        return None
+    try:
+        return check()
+    except Exception as e:  # a broken probe must read as healthy-ish,
+        # not take the endpoint down
+        logger.warning("health check failed: %s", e)
+        return None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -76,7 +137,33 @@ class _Handler(BaseHTTPRequestHandler):
                 "application/json",
             )
         elif url.path == "/healthz":
-            self._send(200, b"ok\n", "text/plain")
+            degraded = _current_health()
+            if degraded:
+                body = json.dumps(
+                    {"status": "degraded", **degraded}, default=str
+                ).encode()
+                self._send(503, body, "application/json")
+            else:
+                self._send(200, b"ok\n", "text/plain")
+        elif url.path == "/debug/stacks":
+            from dlrover_tpu.telemetry import flight_recorder
+
+            self._send(
+                200, flight_recorder.format_stacks().encode(),
+                "text/plain; charset=utf-8",
+            )
+        elif url.path == "/debug/trace":
+            from dlrover_tpu.telemetry import tracing
+
+            q = parse_qs(url.query)
+            try:
+                n = int((q.get("n") or ["500"])[0])
+            except ValueError:
+                n = 500
+            body = json.dumps(
+                tracing.chrome_trace(tracing.tail(n)), default=str
+            ).encode()
+            self._send(200, body, "application/json")
         else:
             self._send(404, b"not found\n", "text/plain")
 

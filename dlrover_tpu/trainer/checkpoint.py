@@ -60,7 +60,7 @@ import jax
 import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.telemetry import counter, gauge, histogram, record
+from dlrover_tpu.telemetry import counter, gauge, histogram, record, tracing
 from dlrover_tpu.trainer import ckpt_store
 
 #: DLROVER_TPU_CKPT_QUEUE_DEPTH — max persist archives in flight
@@ -568,6 +568,7 @@ class FlashCheckpointer:
         recording staging dispatch only — durable saves must not skew
         the zero-stall budget it alerts on."""
         t0 = time.perf_counter()
+        ts_wall = time.time()
         staged = _stage_local_shards(state, sync=self._stage_sync)
         job = _SaveJob(
             step=step,
@@ -589,6 +590,11 @@ class FlashCheckpointer:
             "Train-thread stall per checkpoint save (staging only)",
             buckets=_STALL_BUCKETS,
         ).observe(stall_s)
+        # the train-thread slice of the save on the trace timeline;
+        # serialize/persist appear as their own lanes' spans
+        tracing.add_span(
+            "ckpt.stage", ts_wall, stall_s, attrs={"step": step}
+        )
         if durable:
             self._serializer.drain()
             total_s = time.perf_counter() - t0
@@ -646,6 +652,10 @@ class FlashCheckpointer:
         staging failure truly loses the save, and that loss is counted
         (``persist_skipped{reason="stage_failed"}``) so failover
         drills can detect it."""
+        with tracing.span("ckpt.serialize", {"step": job.step}):
+            self._serialize_job_inner(job)
+
+    def _serialize_job_inner(self, job: _SaveJob) -> None:
         t0 = time.perf_counter()
         try:
             snapshot = _materialize_staged(job.staged)
@@ -796,6 +806,12 @@ class FlashCheckpointer:
         )
 
     def _run_persist(self, job: _PersistJob) -> None:
+        with tracing.span(
+            "ckpt.persist", {"step": job.step, "kind": job.payload[0]}
+        ):
+            self._run_persist_inner(job)
+
+    def _run_persist_inner(self, job: _PersistJob) -> None:
         t0 = time.time()
         step = job.step
         kind, payload = job.payload

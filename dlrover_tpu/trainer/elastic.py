@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import tracing
 
 
 def compute_accum_steps(max_nodes: int, cur_nodes: int) -> int:
@@ -164,6 +165,19 @@ class ElasticTrainer:
                 os.environ.get("DLROVER_HANG_MULTIPLIER", "10")
             ),
         ).start()
+        # observability wiring around the detector (ISSUE 4): /healthz
+        # on any telemetry endpoint in THIS process reports the stall
+        # (503 + stalled_for) instead of a bare liveness 200, and a
+        # SIGTERM mid-run leaves a flight record (all-thread stacks +
+        # last spans) before the process dies
+        try:
+            from dlrover_tpu.telemetry import flight_recorder
+            from dlrover_tpu.telemetry.http import attach_hang_detector
+
+            attach_hang_detector(self._hang_detector)
+            flight_recorder.install_signal_hook()
+        except Exception as e:  # telemetry never stops training
+            logger.warning("flight-recorder wiring failed: %s", e)
 
     def set_world(self, cur_nodes: int):
         self._cur_nodes = cur_nodes
@@ -197,8 +211,10 @@ class ElasticTrainer:
                 if ckpt is not None:
                     wait = getattr(ckpt, "wait_staged", None)
                     if wait is not None:
-                        wait()
-                return jitted(params, opt_state, batches)
+                        with tracing.span("train.wait_staged"):
+                            wait()
+                with tracing.span("train.dispatch"):
+                    return jitted(params, opt_state, batches)
 
             # profiler.profile_step reuses the shared jit cache via
             # .lower — keep it reachable through the wrapper
@@ -254,6 +270,8 @@ class ElasticTrainer:
         self._global_step = step if step is not None else (
             self._global_step + 1
         )
+        # spans and flight records carry the step they happened at
+        tracing.set_step(self._global_step)
         if not self._first_step_seen:
             # the first completed step carries the compile: classify
             # warm (persistent-cache hit) vs cold for the journal

@@ -12,6 +12,10 @@ as long as no backend has initialized yet.
 import os
 
 os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")
+# hang-detector tests trip on purpose; flight-recorder dumps to the
+# shared temp dir would be side effects — tests that assert on dumps
+# opt back in with monkeypatch
+os.environ.setdefault("DLROVER_TPU_FLIGHT_RECORDER", "0")
 # subprocesses spawned by tests (agents, probes) must also land on CPU
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = "8"
