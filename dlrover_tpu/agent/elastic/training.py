@@ -109,26 +109,43 @@ class MasterRendezvousHandler:
         """Block until a world forms. Returns
         (round, world, process_id, num_processes, coordinator_addr)."""
         start = time.time()
-        if self._rdzv_params is not None:
-            try:
-                self._client.report_rdzv_params(*self._rdzv_params)
-            except Exception as e:
-                logger.warning("rdzv params report failed: %s", e)
-        rdzv_round = self._client.join_rendezvous(
-            self._node_rank, self._local_world_size, self._rdzv_name
-        )
-        while True:
-            rdzv_round, group, world = self._client.get_comm_world(
-                self._rdzv_name, self._node_rank
+
+        def _hello():
+            if self._rdzv_params is not None:
+                try:
+                    self._client.report_rdzv_params(*self._rdzv_params)
+                except Exception as e:
+                    logger.warning("rdzv params report failed: %s", e)
+            return self._client.join_rendezvous(
+                self._node_rank, self._local_world_size, self._rdzv_name
             )
-            if world and self._node_rank in world:
-                break
-            if time.time() - start > self._join_timeout:
-                raise TimeoutError(
-                    f"Rendezvous {self._rdzv_name} timed out after "
-                    f"{self._join_timeout}s; world={world}"
+
+        rdzv_round = _hello()
+        # a master replaced DURING the poll below lost our join (the
+        # waiting set is not part of its durable state) — re-hello on
+        # every reconnect or the poll spins on an empty world until
+        # join_timeout. Scoped to the poll: re-joining outside a
+        # rendezvous would signal a spurious membership change.
+        add_hook = getattr(self._client, "add_reconnect_hook", None)
+        if add_hook is not None:
+            add_hook(f"rdzv:{self._rdzv_name}", _hello)
+        try:
+            while True:
+                rdzv_round, group, world = self._client.get_comm_world(
+                    self._rdzv_name, self._node_rank
                 )
-            time.sleep(RendezvousConstant.POLL_INTERVAL)
+                if world and self._node_rank in world:
+                    break
+                if time.time() - start > self._join_timeout:
+                    raise TimeoutError(
+                        f"Rendezvous {self._rdzv_name} timed out after "
+                        f"{self._join_timeout}s; world={world}"
+                    )
+                time.sleep(RendezvousConstant.POLL_INTERVAL)
+        finally:
+            remove = getattr(self._client, "remove_reconnect_hook", None)
+            if remove is not None:
+                remove(f"rdzv:{self._rdzv_name}")
 
         sorted_ranks = sorted(world)
         # processes are laid out host-major in join order of node rank
@@ -230,6 +247,17 @@ class ElasticTrainingAgent:
     def run(self) -> RunResult:
         """The agent main loop (parity: _invoke_run training.py:365)."""
         self._client.update_node_status(NodeStatus.RUNNING)
+        # re-hello: a replaced master rebuilds its node table from agent
+        # traffic — re-announce RUNNING on every reconnect so the
+        # heartbeat watchdog doesn't declare this live node dead
+        add_hook = getattr(self._client, "add_reconnect_hook", None)
+        if add_hook is not None:
+            add_hook(
+                "node-status",
+                lambda: self._client.update_node_status(
+                    NodeStatus.RUNNING, "", self._restart_count
+                ),
+            )
         self._start_heartbeat(self._config.heartbeat_interval)
         try:
             result = self._invoke_run()
@@ -239,6 +267,7 @@ class ElasticTrainingAgent:
                 str(e), TrainingExceptionLevel.NODE_ERROR,
                 self._restart_count,
             )
+            self._remove_rehello_hook()
             self._client.update_node_status(NodeStatus.FAILED, str(e))
             return RunResult(WorkerState.FAILED, 1)
         status = (
@@ -246,8 +275,16 @@ class ElasticTrainingAgent:
             if result.state == WorkerState.SUCCEEDED
             else NodeStatus.FAILED
         )
+        # drop the hook BEFORE the terminal status report: a reconnect
+        # after SUCCEEDED must not resurrect the node as RUNNING
+        self._remove_rehello_hook()
         self._client.update_node_status(status)
         return result
+
+    def _remove_rehello_hook(self):
+        remove = getattr(self._client, "remove_reconnect_hook", None)
+        if remove is not None:
+            remove("node-status")
 
     def _invoke_run(self) -> RunResult:
         self._initialize_workers()

@@ -1,43 +1,244 @@
-"""Agent-side client for every master RPC.
+"""Agent-side client for every master RPC, behind reconnect supervision.
 
 Parity reference: dlrover/python/elastic_agent/master_client.py:51
-(MasterClient, retry_grpc_request:28, build_master_client:466,
-GlobalMasterClient:479). Adds a LocalMasterClient fallback that serves the
-sharding protocol in-process when no master address is configured
-(reference LocalDataset behavior).
+(MasterClient, build_master_client:466, GlobalMasterClient:479). Adds a
+LocalMasterClient fallback that serves the sharding protocol in-process
+when no master address is configured (reference LocalDataset behavior).
+
+The reference retried every RPC blindly (retry_grpc_request: 10x6s,
+masking app errors and giving up mid-master-reschedule). Here every
+public RPC runs under a ConnectionSupervisor instead:
+
+* errors are CLASSIFIED — only connection-level failures (UNAVAILABLE /
+  DEADLINE_EXCEEDED / socket errors) enter the reconnect loop;
+  application errors surface to the caller immediately;
+* reconnects back off with decorrelated jitter up to a hard deadline
+  (``DLROVER_TPU_MASTER_RECONNECT_TIMEOUT``, default 600 s — generous
+  enough to cover a master pod reschedule);
+* recovery is probed with a raw ping, then registered re-hello hooks
+  run BEFORE the original call retries (re-register the node,
+  re-report dataset params) so the restarted master has the context
+  the retried RPC assumes;
+* the outage is observable: ``agent.master_lost`` /
+  ``agent.master_reconnected`` journal events and a reconnect-attempts
+  counter.
 """
 
 import functools
 import os
+import random
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
+
+import grpc
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName, TaskType
 from dlrover_tpu.common.grpc_utils import GenericRpcClient
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, record
+
+#: hard reconnect deadline (seconds) — how long a worker rides out a
+#: master outage before giving up. Default covers a pod reschedule plus
+#: image pull with room to spare.
+ENV_RECONNECT_TIMEOUT = "DLROVER_TPU_MASTER_RECONNECT_TIMEOUT"
+DEFAULT_RECONNECT_TIMEOUT = 600.0
+
+#: decorrelated-jitter backoff bounds for the reconnect probe loop
+ENV_BACKOFF_CAP = "DLROVER_TPU_MASTER_RECONNECT_BACKOFF_MAX"
+BACKOFF_BASE = 0.25
+DEFAULT_BACKOFF_CAP = 15.0
+
+#: public MasterClient methods deliberately NOT supervised (the AST lint
+#: in tests/test_reconnect_supervisor.py enforces this list is the only
+#: gap): ``ping`` IS the supervisor's liveness probe and its contract is
+#: an immediate True/False — blocking it for the reconnect deadline
+#: would deadlock the probe and stall every caller that just wants a
+#: health answer.
+UNSUPERVISED_RPCS = ("ping",)
 
 
-def retry_rpc_request(func):
-    """Retry an RPC 10x with 6s backoff (parity: master_client.py:28)."""
+class MasterLostError(ConnectionError):
+    """The master stayed unreachable past the reconnect deadline."""
+
+
+def is_connection_error(exc: BaseException) -> bool:
+    """Connection-level (reconnect-worthy) vs application error.
+
+    The generic RPC server aborts INVALID_ARGUMENT on wire errors and
+    INTERNAL on handler exceptions (common/grpc_utils.py) — those are
+    the remote code talking and must surface immediately. A dead or
+    rescheduling master manifests as UNAVAILABLE / DEADLINE_EXCEEDED or
+    a raw socket error."""
+    if isinstance(exc, grpc.RpcError):
+        code = getattr(exc, "code", lambda: None)()
+        return code in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+    return isinstance(exc, (ConnectionError, OSError))
+
+
+class ConnectionSupervisor:
+    """Shared reconnect state machine for one MasterClient.
+
+    Any number of threads (heartbeat, shard prefetch, rendezvous
+    polling) may hit the outage concurrently; the first records
+    ``agent.master_lost``, exactly one at a time probes the master, and
+    the winning probe runs the re-hello hooks once before any supervised
+    call retries."""
+
+    def __init__(self, client: GenericRpcClient, node_desc: str = "",
+                 reconnect_timeout: Optional[float] = None):
+        self._client = client
+        self._node_desc = node_desc
+        if reconnect_timeout is None:
+            reconnect_timeout = float(
+                os.getenv(ENV_RECONNECT_TIMEOUT, "")
+                or DEFAULT_RECONNECT_TIMEOUT
+            )
+        self.reconnect_timeout = reconnect_timeout
+        self._backoff_cap = float(
+            os.getenv(ENV_BACKOFF_CAP, "") or DEFAULT_BACKOFF_CAP
+        )
+        self._hooks: Dict[str, Callable[[], None]] = {}
+        self._state_lock = threading.Lock()
+        self._connected = True
+        self._lost_at = 0.0
+        self._local = threading.local()
+
+    # ------------------------------------------------------------- hooks
+
+    def add_hook(self, name: str, fn: Callable[[], None]):
+        """Register an idempotent re-hello, run (in registration order)
+        after every reconnect BEFORE supervised calls retry. Hooks may
+        freely call supervised RPCs — supervision is bypassed inside."""
+        self._hooks[name] = fn
+
+    def remove_hook(self, name: str):
+        self._hooks.pop(name, None)
+
+    # -------------------------------------------------------------- core
+
+    def call(self, method: str, fn: Callable):
+        if getattr(self._local, "bypass", False):
+            return fn()
+        deadline = None
+        sleep = BACKOFF_BASE
+        attempts = 0
+        first_error: Optional[BaseException] = None
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                if not is_connection_error(e):
+                    raise
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + self.reconnect_timeout
+                    first_error = e
+                    self._note_lost(method, e)
+                # probe-and-backoff until reconnected or out of time;
+                # fn() only retries AFTER a successful probe ran the
+                # re-hello hooks (the retried call may assume them)
+                while True:
+                    if time.monotonic() >= deadline:
+                        raise MasterLostError(
+                            f"master unreachable for "
+                            f"{self.reconnect_timeout:.0f}s "
+                            f"({attempts} reconnect attempts) during "
+                            f"RPC {method}"
+                        ) from first_error
+                    attempts += 1
+                    counter(
+                        "dlrover_agent_master_reconnect_attempts_total",
+                        "Reconnect probes sent while the master was "
+                        "unreachable",
+                    ).inc()
+                    # decorrelated jitter: spreads a whole fleet's
+                    # probes instead of synchronized thundering herds
+                    sleep = min(
+                        self._backoff_cap,
+                        random.uniform(BACKOFF_BASE, sleep * 3),
+                    )
+                    time.sleep(
+                        max(0.02, min(sleep,
+                                      deadline - time.monotonic()))
+                    )
+                    if self._try_reconnect():
+                        break
+
+    # ----------------------------------------------------------- plumbing
+
+    def _raw_ping(self) -> bool:
+        try:
+            res = self._client.call("ping", comm.BaseRequest())
+            return bool(getattr(res, "success", True))
+        except Exception:
+            return False
+
+    def _note_lost(self, method: str, exc: BaseException):
+        with self._state_lock:
+            if not self._connected:
+                return
+            self._connected = False
+            self._lost_at = time.time()
+        logger.warning(
+            "Master connection lost during RPC %s: %s — entering "
+            "reconnect supervision (deadline %.0fs)",
+            method, exc, self.reconnect_timeout,
+        )
+        record(
+            "agent.master_lost", method=method, error=str(exc)[:200],
+            node=self._node_desc,
+        )
+
+    def _try_reconnect(self) -> bool:
+        """Probe the master; on success run re-hello hooks and flip back
+        to connected. Serialized: concurrent stranded threads wait on
+        the lock and see _connected already True."""
+        with self._state_lock:
+            if self._connected:
+                return True
+            if not self._raw_ping():
+                return False
+            self._local.bypass = True
+            try:
+                for name, hook in list(self._hooks.items()):
+                    try:
+                        hook()
+                    except Exception as e:
+                        logger.warning(
+                            "re-hello hook %s failed after "
+                            "reconnect: %s", name, e,
+                        )
+            finally:
+                self._local.bypass = False
+            outage = time.time() - self._lost_at
+            self._connected = True
+        logger.info(
+            "Master reconnected after %.1fs outage; re-hello hooks "
+            "done", outage,
+        )
+        record(
+            "agent.master_reconnected",
+            outage_seconds=round(outage, 3), node=self._node_desc,
+        )
+        return True
+
+
+def supervised_rpc(func):
+    """Route a MasterClient RPC method through its ConnectionSupervisor
+    (classification + reconnect + re-hello; see module docstring)."""
 
     @functools.wraps(func)
     def wrapped(self, *args, **kwargs):
-        retry = 10
-        exception = None
-        for i in range(retry):
-            try:
-                return func(self, *args, **kwargs)
-            except Exception as e:
-                exception = e
-                logger.warning(
-                    "Retry %d/%d for RPC %s: %s", i + 1, retry,
-                    func.__name__, e,
-                )
-                if i < retry - 1:
-                    time.sleep(6)
-        raise exception
+        return self._supervisor.call(
+            func.__name__, lambda: func(self, *args, **kwargs)
+        )
 
+    wrapped._supervised_rpc = True
     return wrapped
 
 
@@ -45,11 +246,25 @@ class MasterClient:
     """One client instance per agent/worker process."""
 
     def __init__(self, master_addr: str, node_id: int, node_type: str,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 reconnect_timeout: Optional[float] = None):
         self._client = GenericRpcClient(master_addr, timeout=timeout)
         self._node_id = node_id
         self._node_type = node_type
         self.master_addr = master_addr
+        self._supervisor = ConnectionSupervisor(
+            self._client,
+            node_desc=f"{node_type}-{node_id}",
+            reconnect_timeout=reconnect_timeout,
+        )
+
+    def add_reconnect_hook(self, name: str, fn: Callable[[], None]):
+        """Register an idempotent re-hello run after every reconnect
+        (e.g. re-register this node, re-report dataset params)."""
+        self._supervisor.add_hook(name, fn)
+
+    def remove_reconnect_hook(self, name: str):
+        self._supervisor.remove_hook(name)
 
     def _call(self, method: str, message):
         return self._client.call(method, message)
@@ -61,7 +276,7 @@ class MasterClient:
 
     # ------------------------------------------------------------ sharding
 
-    @retry_rpc_request
+    @supervised_rpc
     def report_dataset_shard_params(
         self, batch_size: int, num_epochs: int, dataset_size: int,
         shuffle: bool, num_minibatches_per_shard: int, dataset_name: str,
@@ -76,6 +291,7 @@ class MasterClient:
         ))
         return self._call("report_dataset_shard_params", req)
 
+    @supervised_rpc
     def get_task(self, dataset_name: str,
                  incarnation: int = -1) -> comm.Task:
         req = self._fill(comm.TaskRequest(
@@ -83,7 +299,7 @@ class MasterClient:
         ))
         return self._call("get_task", req)
 
-    @retry_rpc_request
+    @supervised_rpc
     def report_task_result(self, dataset_name: str, task_id: int,
                            err_message: str = ""):
         req = self._fill(comm.TaskResult(
@@ -92,7 +308,7 @@ class MasterClient:
         ))
         return self._call("report_task_result", req)
 
-    @retry_rpc_request
+    @supervised_rpc
     def get_shard_checkpoint(self, dataset_name: str) -> str:
         req = self._fill(
             comm.ShardCheckpointRequest(dataset_name=dataset_name)
@@ -100,20 +316,20 @@ class MasterClient:
         res = self._call("get_shard_checkpoint", req)
         return res.content
 
-    @retry_rpc_request
+    @supervised_rpc
     def report_shard_checkpoint(self, content: str):
         return self._call(
             "report_shard_checkpoint", comm.ShardCheckpoint(content=content)
         )
 
-    @retry_rpc_request
+    @supervised_rpc
     def get_dataset_epoch(self, dataset_name: str) -> int:
         req = self._fill(comm.DatasetEpochRequest(dataset_name=dataset_name))
         return self._call("get_dataset_epoch", req).epoch
 
     # ---------------------------------------------------------- rendezvous
 
-    @retry_rpc_request
+    @supervised_rpc
     def report_rdzv_params(self, min_nodes: int, max_nodes: int,
                            waiting_timeout: float, node_unit: int,
                            join_timeout: float = 600.0):
@@ -124,6 +340,7 @@ class MasterClient:
         ))
         return self._call("report_rdzv_params", req)
 
+    @supervised_rpc
     def join_rendezvous(self, node_rank: int, local_world_size: int,
                         rdzv_name: str = RendezvousName.TRAINING) -> int:
         req = comm.JoinRendezvousRequest(
@@ -132,6 +349,7 @@ class MasterClient:
         )
         return self._call("join_rendezvous", req).round
 
+    @supervised_rpc
     def get_comm_world(
         self, rdzv_name: str, node_rank: int
     ):
@@ -141,6 +359,7 @@ class MasterClient:
         res = self._call("get_comm_world", req)
         return res.rdzv_round, res.group, res.world
 
+    @supervised_rpc
     def num_nodes_waiting(
         self, rdzv_name: str = RendezvousName.TRAINING
     ) -> int:
@@ -148,9 +367,14 @@ class MasterClient:
         try:
             return self._call("num_nodes_waiting", req).waiting_num
         except Exception as e:
+            # connection loss must reach the supervisor (it owns the
+            # reconnect loop); only APP errors degrade to "0 waiting"
+            if is_connection_error(e):
+                raise
             logger.warning("num_nodes_waiting failed: %s", e)
             return 0
 
+    @supervised_rpc
     def report_node_check_status(self, rdzv_round: int, normal: bool,
                                  elapsed_time: float):
         req = self._fill(comm.NodeCheckStatus(
@@ -158,14 +382,17 @@ class MasterClient:
         ))
         return self._call("report_node_check_status", req)
 
+    @supervised_rpc
     def network_check_success(self):
         req = self._fill(comm.NetworkReadyRequest())
         res = self._call("network_check_success", req)
         return res.success, res.reason
 
+    @supervised_rpc
     def get_fault_nodes(self) -> List[int]:
         return self._call("get_fault_nodes", self._fill(comm.BaseRequest()))
 
+    @supervised_rpc
     def get_straggler_nodes(self) -> List[int]:
         return self._call(
             "get_straggler_nodes", self._fill(comm.BaseRequest())
@@ -173,16 +400,19 @@ class MasterClient:
 
     # ------------------------------------------------------------- kv store
 
+    @supervised_rpc
     def kv_store_set(self, key: str, value: bytes):
         return self._call(
             "kv_store_set", comm.KVStoreSetRequest(key=key, value=value)
         )
 
+    @supervised_rpc
     def kv_store_get(self, key: str) -> bytes:
         return self._call(
             "kv_store_get", comm.KVStoreGetRequest(key=key)
         ).value
 
+    @supervised_rpc
     def kv_store_add(self, key: str, amount: int) -> int:
         return self._call(
             "kv_store_add", comm.KVStoreAddRequest(key=key, amount=amount)
@@ -190,7 +420,7 @@ class MasterClient:
 
     # ---------------------------------------------------------- node status
 
-    @retry_rpc_request
+    @supervised_rpc
     def update_node_status(self, status: str, exit_reason: str = "",
                            restart_count: int = 0):
         req = self._fill(comm.NodeStatusRequest(
@@ -199,15 +429,17 @@ class MasterClient:
         ))
         return self._call("update_node_status", req)
 
-    @retry_rpc_request
+    @supervised_rpc
     def update_node_address(self, address: str):
         req = self._fill(comm.NodeAddressRequest(address=address))
         return self._call("update_node_address", req)
 
+    @supervised_rpc
     def report_heartbeat(self) -> str:
         req = self._fill(comm.HeartBeat(timestamp=time.time()))
         return self._call("report_heartbeat", req).action
 
+    @supervised_rpc
     def report_failure(self, error_data: str, level: str,
                        restart_count: int = 0):
         req = self._fill(comm.NodeFailure(
@@ -216,8 +448,11 @@ class MasterClient:
         try:
             return self._call("report_failure", req)
         except Exception as e:
+            if is_connection_error(e):
+                raise
             logger.warning("report_failure failed: %s", e)
 
+    @supervised_rpc
     def report_used_resource(self, cpu_percent: float, memory_mb: int,
                              tpu_stats: Optional[List[Dict]] = None):
         req = self._fill(comm.ResourceStats(
@@ -226,10 +461,12 @@ class MasterClient:
         ))
         return self._call("report_used_resource", req)
 
+    @supervised_rpc
     def query_running_nodes(self) -> List[Dict]:
         req = self._fill(comm.RunningNodesRequest())
         return self._call("query_running_nodes", req).nodes
 
+    @supervised_rpc
     def request_scale(self, node_num: int) -> bool:
         """Operator-requested manual scaling (parity: manualScaling)."""
         req = self._fill(comm.ScaleRequest(node_num=node_num))
@@ -238,6 +475,7 @@ class MasterClient:
 
     # -------------------------------------------------------------- metrics
 
+    @supervised_rpc
     def report_global_step(self, step: int,
                            timestamp: Optional[float] = None):
         req = self._fill(comm.GlobalStep(
@@ -245,12 +483,14 @@ class MasterClient:
         ))
         return self._call("report_global_step", req)
 
+    @supervised_rpc
     def report_custom_data(self, data: Dict):
         """Free-form metrics into the stats pipeline (evaluator
         results; parity: report_customized_data)."""
         req = self._fill(comm.CustomData(data=dict(data)))
         return self._call("report_custom_data", req)
 
+    @supervised_rpc
     def report_model_info(self, param_count: int, flops_per_step: float,
                           batch_size: int, seq_len: int = 0,
                           extra: Optional[Dict] = None):
@@ -262,20 +502,24 @@ class MasterClient:
 
     # ----------------------------------------------------------------- sync
 
+    @supervised_rpc
     def join_sync(self, sync_name: str) -> bool:
         req = self._fill(comm.SyncJoin(sync_name=sync_name))
         return self._call("join_sync", req).success
 
+    @supervised_rpc
     def sync_finished(self, sync_name: str) -> bool:
         req = self._fill(comm.SyncFinish(sync_name=sync_name))
         return self._call("sync_finished", req).success
 
+    @supervised_rpc
     def barrier(self, barrier_name: str, notify: bool = False) -> bool:
         req = self._fill(comm.SyncBarrier(
             barrier_name=barrier_name, notify=notify,
         ))
         return self._call("barrier", req).success
 
+    @supervised_rpc
     def get_elastic_run_config(self) -> Dict[str, str]:
         req = self._fill(comm.ElasticRunConfigRequest())
         return self._call("get_elastic_run_config", req).configs

@@ -56,7 +56,7 @@ class ShardingClient:
         self._incarnation = int(
             os.getenv(NodeEnv.RESTART_COUNT, "-1") or -1
         )
-        self._master_client.report_dataset_shard_params(
+        self._dataset_params = dict(
             batch_size=batch_size,
             num_epochs=num_epochs,
             dataset_size=dataset_size,
@@ -66,6 +66,21 @@ class ShardingClient:
             task_type=task_type,
             storage_type=storage_type,
         )
+        self._master_client.report_dataset_shard_params(
+            **self._dataset_params
+        )
+        # re-hello: a master that came back WITHOUT a state journal has
+        # never heard of this dataset — re-report the params on every
+        # reconnect (idempotent: new_dataset is a no-op when the master
+        # restored the dataset from its journal)
+        add_hook = getattr(self._master_client, "add_reconnect_hook", None)
+        if add_hook is not None:
+            add_hook(
+                f"dataset:{dataset_name}",
+                lambda: self._master_client.report_dataset_shard_params(
+                    **self._dataset_params
+                ),
+            )
 
     @property
     def dataset_name(self):
@@ -128,6 +143,11 @@ class ShardingClient:
     def stop(self):
         """Interrupt any in-progress WAIT poll; subclasses extend."""
         self._stopped = True
+        remove = getattr(
+            self._master_client, "remove_reconnect_hook", None
+        )
+        if remove is not None:
+            remove(f"dataset:{self._dataset_name}")
 
     def report_batch_done(self, batch_size: Optional[int] = None) -> bool:
         """Accumulate minibatch completions; report the oldest pending task

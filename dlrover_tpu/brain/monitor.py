@@ -37,6 +37,12 @@ from typing import Dict, Optional, Tuple
 from dlrover_tpu.brain.client import BrainClient, build_brain_client
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.scheduler.gke import K8sApi, PodRecord
+from dlrover_tpu.telemetry import counter
+
+#: ceiling on the Brain-outage retry queue: a long outage during a
+#: crash storm must not grow memory without bound — oldest incidents
+#: are dropped first (the blacklist wants recent evidence anyway)
+MAX_PENDING_INCIDENTS = 1000
 
 #: health-event kinds (the blacklist treats kinds uniformly; these
 #: names match what job masters / optimizers already report)
@@ -114,13 +120,33 @@ class ClusterMonitor:
             logger.warning(
                 "brain event write failed (queued for retry): %s", e
             )
-            self._pending.append((host, kind, job))
+            self._queue_retry(host, kind, job)
             return None
         logger.info(
             "cluster incident: host=%s kind=%s job=%s pod=%s",
             host, kind, job, rec.name,
         )
         return host, kind
+
+    def _queue_retry(self, host: str, kind: str, job: str) -> None:
+        """Bounded, deduplicated retry queue. The blacklist's incident
+        unit is distinct (job, kind) per host — re-queueing an already
+        queued tuple adds no evidence, and an unbounded queue during a
+        crash storm + Brain outage would pin memory."""
+        item = (host, kind, job)
+        if item in self._pending:
+            return
+        self._pending.append(item)
+        if len(self._pending) > MAX_PENDING_INCIDENTS:
+            dropped = self._pending.pop(0)
+            counter(
+                "dlrover_cluster_monitor_incidents_dropped_total",
+                "Pending Brain incident writes dropped to the queue cap",
+            ).inc()
+            logger.warning(
+                "pending incident queue over cap (%d); dropped oldest "
+                "%s", MAX_PENDING_INCIDENTS, dropped,
+            )
 
     def _flush_pending(self) -> None:
         """Retry queued incident writes, rate-limited to one attempt

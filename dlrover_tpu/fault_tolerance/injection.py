@@ -19,6 +19,16 @@ Two triggers:
   - ``preempt@5``                   SIGTERM own process group (spot-VM
                                     reclaim shape: agent sees a signal
                                     death, not a Python traceback)
+  - ``master_crash@5`` / ``master_crash@5:2``  kill the JOB MASTER
+                                    (rc 28) once the reported global
+                                    step reaches 5, after an optional
+                                    2 s delay. Master-side only: the
+                                    master's run loop arms its own
+                                    injector (role="master"), and
+                                    worker-side injectors drop the kind
+                                    — one shared env spec can name both
+                                    master and worker faults without a
+                                    worker dying on a master fault.
 
   Env injections fire only on the *first* incarnation (restart count 0
   from ``NodeEnv.RESTART_COUNT``), so a drill hits once and the relaunch
@@ -44,7 +54,15 @@ from dlrover_tpu.telemetry import record
 ENV_SPEC = "DLROVER_FAULT_INJECT"
 KV_PREFIX = "fault_inject"
 
-KINDS = ("crash", "hang", "oom", "error", "preempt")
+KINDS = ("crash", "hang", "oom", "error", "preempt", "master_crash")
+
+#: kinds executed by the MASTER's run loop, not a worker training loop
+MASTER_KINDS = frozenset({"master_crash"})
+
+#: distinct from a worker crash (17) and a deliberate job failure
+#: (main.JOB_FAILED_EXIT_CODE=3): the operator should see a master
+#: CRASH and relaunch it against the same state dir
+MASTER_CRASH_EXIT_CODE = 28
 
 
 @dataclass
@@ -91,8 +109,10 @@ class FaultInjector:
         node_rank: int = 0,
         restart_count: int = 0,
         poll_every: int = 10,
+        role: str = "worker",
     ):
-        self._faults = parse_spec(spec) if spec else []
+        self._role = role
+        self._faults = self._role_filter(parse_spec(spec) if spec else [])
         # first-incarnation gating for env faults
         if restart_count > 0:
             self._faults = [
@@ -103,8 +123,18 @@ class FaultInjector:
         self._poll_every = max(1, poll_every)
         self._step_seen = 0
 
+    def _role_filter(self, faults: List[Fault]) -> List[Fault]:
+        """One spec may target both sides: each injector keeps only the
+        kinds its role executes (a worker must not die on a
+        master_crash, nor the master on a worker crash)."""
+        return [
+            f for f in faults
+            if (f.kind in MASTER_KINDS) == (self._role == "master")
+        ]
+
     @classmethod
-    def from_env(cls, master_client=None) -> Optional["FaultInjector"]:
+    def from_env(cls, master_client=None,
+                 role: str = "worker") -> Optional["FaultInjector"]:
         """Build from the process env; None when nothing is configured
         and there is no master to poll."""
         spec = os.environ.get(ENV_SPEC, "")
@@ -117,6 +147,7 @@ class FaultInjector:
             restart_count=int(
                 os.environ.get(NodeEnv.RESTART_COUNT, "0")
             ),
+            role=role,
         )
 
     # -- trigger -----------------------------------------------------------
@@ -142,7 +173,7 @@ class FaultInjector:
             self._client.kv_store_set(
                 f"{KV_PREFIX}/{self._node_rank}", b""
             )
-            self._faults.extend(parse_spec(raw.decode()))
+            self._faults.extend(self._role_filter(parse_spec(raw.decode())))
         except Exception as e:
             logger.warning("fault-inject poll failed: %s", e)
 
@@ -163,6 +194,20 @@ class FaultInjector:
             rc = int(fault.arg) if fault.arg else 17
             print(f"INJECTED CRASH rc={rc} at step {step}", flush=True)
             os._exit(rc)
+        elif fault.kind == "master_crash":
+            # arg = optional delay in seconds: lets a drill kill the
+            # master mid-flight rather than exactly on a step boundary
+            delay = float(fault.arg) if fault.arg else 0.0
+            if delay > 0:
+                time.sleep(delay)
+            print(
+                f"INJECTED MASTER CRASH rc={MASTER_CRASH_EXIT_CODE} "
+                f"at step {step}", flush=True,
+            )
+            # os._exit, not sys.exit: a real eviction gives no chance
+            # to run atexit hooks or flush managers — the journal must
+            # already be durable from its write-through path
+            os._exit(MASTER_CRASH_EXIT_CODE)
         elif fault.kind == "hang":
             duration = float(fault.arg) if fault.arg else float("inf")
             print(f"INJECTED HANG at step {step}", flush=True)

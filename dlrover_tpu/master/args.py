@@ -44,6 +44,17 @@ def parse_master_args(argv=None):
                         help="directory for the durable cross-run "
                              "stats archive (brain/client.py); enables "
                              "warm-started resource plans")
+    parser.add_argument("--state_dir", type=str, default="",
+                        help="directory for the durable master "
+                             "job-state journal (master failover); "
+                             "overrides DLROVER_TPU_MASTER_STATE_DIR. "
+                             "Off when neither is set")
+    parser.add_argument("--fresh", action="store_true",
+                        help="discard any prior journaled state for "
+                             "this job instead of restoring it")
+    parser.add_argument("--check_interval", type=float, default=3.0,
+                        help="seconds between master run-loop checks "
+                             "(job completion, hang, fault injection)")
     parser.add_argument("--brain_addr", type=str, default="",
                         help="host:port of the standalone Brain service "
                              "(brain/service.py) — the cluster-scoped "

@@ -13,8 +13,12 @@ from dlrover_tpu.common.constants import (
     JobExitReason,
     NodeType,
     RendezvousName,
+    TaskType,
 )
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.elastic_training.kv_store_service import (
+    KVStoreService,
+)
 from dlrover_tpu.master.elastic_training.rdzv_manager import (
     ElasticTrainingRendezvousManager,
     NetworkCheckRendezvousManager,
@@ -26,12 +30,15 @@ from dlrover_tpu.master.node.dist_job_manager import create_job_manager
 from dlrover_tpu.master.node.job_auto_scaler import new_job_auto_scaler
 from dlrover_tpu.master.resource.local_optimizer import TPULocalOptimizer
 from dlrover_tpu.master.servicer import create_master_service
+from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
 from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.state_journal import build_master_state_journal
 from dlrover_tpu.master.stats import (
     JobMetricCollector,
     JobMeta,
     LocalStatsReporter,
 )
+from dlrover_tpu.telemetry import record
 from dlrover_tpu.telemetry.http import start_metrics_server
 
 
@@ -44,10 +51,16 @@ class DistributedJobMaster:
 
     def __init__(self, port: int = 0, job_args=None, scaler=None,
                  watcher=None, autoscale_interval: float = 60.0,
-                 brain_client=None):
+                 brain_client=None, state_dir: Optional[str] = None,
+                 fresh: bool = False):
         self.speed_monitor = SpeedMonitor()
         self.error_monitor = ErrorMonitor()
         job_name = getattr(job_args, "job_name", "") or "job"
+        # durable job-state journal (master/state_journal.py): None
+        # unless a state dir is configured (env or --state_dir)
+        self.state_journal = build_master_state_journal(
+            job_name, state_dir=state_dir, fresh=fresh
+        )
         job_meta = JobMeta(
             # unique per run: the brain archive groups runs by name and
             # distinguishes them by uuid (brain/client.py _key)
@@ -97,6 +110,25 @@ class DistributedJobMaster:
             RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
             RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
         }
+        self.kv_store = KVStoreService(
+            listener=(
+                self.state_journal.save_kv if self.state_journal else None
+            )
+        )
+        if self.state_journal is not None:
+            self.task_manager.attach_state_journal(self.state_journal)
+            for name, mgr in self.rdzv_managers.items():
+                mgr.set_round_listener(
+                    lambda r, _n=name:
+                        self.state_journal.save_rdzv_round(_n, r)
+                )
+                mgr.set_params_listener(
+                    lambda p, _n=name:
+                        self.state_journal.save_rdzv_params(_n, p)
+                )
+            self.speed_monitor.set_step_listener(
+                self.state_journal.save_global_step
+            )
         self.sync_service = SyncService(self.job_manager)
         self.auto_scaler = new_job_auto_scaler(
             self.job_manager, self.job_optimizer, scaler,
@@ -123,12 +155,16 @@ class DistributedJobMaster:
             error_monitor=self.error_monitor,
             job_metric_collector=self.job_metric_collector,
             auto_scaler=self.auto_scaler,
+            kv_store=self.kv_store,
         )
         self.port = self._server.port
         self._exit_code = 0
         self._exit_reason = ""
         self._metrics_server = None
         self._wire_callbacks()
+        # restore BEFORE prepare() opens the server: agents must never
+        # observe the pre-restore (empty) state
+        self._restore_state()
 
     @property
     def addr(self) -> str:
@@ -161,6 +197,87 @@ class DistributedJobMaster:
         self.job_manager.add_callback("on_node_failed", on_failed)
         self.job_manager.add_callback("on_node_deleted", on_deleted)
 
+    def _restore_state(self):
+        """Resume a prior incarnation's job state from the journal.
+
+        Datasets are rebuilt from their journaled params and their
+        ledger restored with keep_doing=True: in-flight shards stay
+        assigned under their original task ids, so a surviving worker's
+        completion report is accepted instead of the shard being
+        re-dispatched (exactly-once across the master restart). The
+        rendezvous round counters resume so coordinator-election KV keys
+        (keyed by round) never regress; the KV store itself comes back
+        verbatim."""
+        journal = self.state_journal
+        if journal is None:
+            return
+        if not journal.has_state():
+            journal.mark_started()
+            return
+        restored_datasets = []
+        for name in journal.saved_datasets():
+            params, ckpt = journal.load_dataset(name)
+            try:
+                splitter = new_dataset_splitter(
+                    shuffle=params.get("shuffle", False),
+                    shard_size=params["batch_size"]
+                    * params.get("num_minibatches_per_shard", 1),
+                    dataset_size=params["dataset_size"],
+                    num_epochs=params.get("num_epochs", 1),
+                    dataset_name=name,
+                    storage_type=params.get("storage_type", "table"),
+                )
+                self.task_manager.new_dataset(
+                    batch_size=params["batch_size"],
+                    dataset_size=params["dataset_size"],
+                    dataset_name=name,
+                    dataset_splitter=splitter,
+                    task_type=params.get("task_type")
+                    or TaskType.TRAINING,
+                    params=params,
+                )
+                if ckpt:
+                    self.task_manager.restore_dataset_from_checkpoint(
+                        ckpt, keep_doing=True
+                    )
+                restored_datasets.append(name)
+            except Exception as e:
+                logger.error(
+                    "Failed to restore dataset %s from the state "
+                    "journal: %s", name, e,
+                )
+        kv_data = journal.load_kv()
+        if kv_data:
+            self.kv_store.load(kv_data)
+        rounds = journal.load_rdzv_rounds()
+        rdzv_params = journal.load_rdzv_params()
+        for name, mgr in self.rdzv_managers.items():
+            if name in rounds:
+                mgr.restore_round(rounds[name])
+            if name in rdzv_params:
+                # round completion is gated on reported params: restore
+                # them so re-joining agents can form a world before any
+                # agent re-reports
+                mgr.update_rdzv_params(**rdzv_params[name])
+        step, batch_feed = journal.load_global_step()
+        if step:
+            self.speed_monitor.restore_global_step(
+                step, batch_feed=batch_feed
+            )
+        journal.mark_started()
+        record(
+            "master.restored",
+            datasets=restored_datasets,
+            kv_keys=len(kv_data),
+            rdzv_rounds=rounds,
+            global_step=step,
+        )
+        logger.info(
+            "Restored master state: datasets=%s kv_keys=%d "
+            "rdzv_rounds=%s global_step=%d",
+            restored_datasets, len(kv_data), rounds, step,
+        )
+
     def prepare(self):
         init_plan = self.job_optimizer.init_job_resource(None)
         if not init_plan.empty():
@@ -178,8 +295,18 @@ class DistributedJobMaster:
 
     def run(self, check_interval: float = 3.0) -> int:
         """parity: dist_master.py:165 — run until workers finish/fail."""
+        # chaos drills: DLROVER_FAULT_INJECT master_crash@step[:delay]
+        # kills THIS process when the reported global step arrives
+        # (fault_tolerance/injection.py; worker kinds are filtered out)
+        from dlrover_tpu.fault_tolerance.injection import FaultInjector
+
+        injector = FaultInjector.from_env(role="master")
         try:
             while True:
+                if injector is not None:
+                    injector.maybe_inject(
+                        self.speed_monitor.completed_global_step
+                    )
                 if self.job_manager.all_workers_exited():
                     if self.job_manager.all_workers_succeeded():
                         self._exit_reason = JobExitReason.SUCCEEDED

@@ -7,17 +7,42 @@ TCPStore replacement.
 """
 
 import threading
-from typing import Dict
+from typing import Callable, Dict, Optional
 
 
 class KVStoreService:
-    def __init__(self):
+    def __init__(self,
+                 listener: Optional[Callable[[Dict[str, bytes]], None]]
+                 = None):
         self._lock = threading.Lock()
         self._store: Dict[str, bytes] = {}
+        #: invoked with a snapshot after every mutation — the master's
+        #: state journal persists it so coordinator-election keys and
+        #: barrier counters survive a master restart
+        self._listener = listener
+
+    def _notify(self, snap: Dict[str, bytes]):
+        if self._listener is None:
+            return
+        try:
+            self._listener(snap)
+        except Exception:
+            pass  # persistence is best-effort; never fail the RPC
+
+    def snapshot(self) -> Dict[str, bytes]:
+        with self._lock:
+            return dict(self._store)
+
+    def load(self, data: Dict[str, bytes]):
+        """Replace contents wholesale (master-restart restore)."""
+        with self._lock:
+            self._store = dict(data)
 
     def set(self, key: str, value: bytes):
         with self._lock:
             self._store[key] = value
+            snap = dict(self._store)
+        self._notify(snap)
 
     def get(self, key: str) -> bytes:
         with self._lock:
@@ -29,12 +54,17 @@ class KVStoreService:
             cur = int(self._store.get(key, b"0") or b"0")
             cur += amount
             self._store[key] = str(cur).encode()
-            return cur
+            snap = dict(self._store)
+        self._notify(snap)
+        return cur
 
     def delete(self, key: str):
         with self._lock:
             self._store.pop(key, None)
+            snap = dict(self._store)
+        self._notify(snap)
 
     def clear(self):
         with self._lock:
             self._store.clear()
+        self._notify({})

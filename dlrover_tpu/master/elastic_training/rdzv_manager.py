@@ -94,6 +94,36 @@ class RendezvousManager(ABC):
         self._start_rdzv_ts = 0.0
         self._latest_rdzv_nodes: List[int] = []
         self._start_waiting_ts = 0.0
+        self._round_listener = None
+        self._params_listener = None
+
+    def set_round_listener(self, listener):
+        """``listener(round)`` fires after every completed round — the
+        master's state journal persists it so rounds stay monotonic
+        across a master restart (the round number keys the coordinator
+        election in the KV store; a reset would reuse stale entries)."""
+        self._round_listener = listener
+
+    def restore_round(self, rdzv_round: int):
+        """Master-restart restore: resume the round counter; membership
+        is rebuilt live as agents re-join."""
+        with self._lock:
+            self._rdzv_round = max(self._rdzv_round, int(rdzv_round))
+
+    def _notify_round(self):
+        if self._round_listener is None:
+            return
+        try:
+            self._round_listener(self._rdzv_round)
+        except Exception:
+            pass  # best-effort persistence; never fail the rendezvous
+
+    def set_params_listener(self, listener):
+        """``listener(params_dict)`` fires on every params report — the
+        master's state journal persists it; round completion is gated
+        on params, so a restarted master that lost them could never
+        form a world again."""
+        self._params_listener = listener
 
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
                            waiting_timeout: float, node_unit: int,
@@ -109,6 +139,15 @@ class RendezvousManager(ABC):
                 "Rendezvous params: min=%d max=%d timeout=%s node_unit=%d",
                 min_nodes, max_nodes, waiting_timeout, node_unit,
             )
+        if self._params_listener is not None:
+            try:
+                self._params_listener({
+                    "min_nodes": min_nodes, "max_nodes": max_nodes,
+                    "waiting_timeout": waiting_timeout,
+                    "node_unit": node_unit, "join_timeout": join_timeout,
+                })
+            except Exception:
+                pass  # best-effort persistence; never fail the report
 
     def get_rdzv_round(self) -> int:
         return self._rdzv_round
@@ -248,6 +287,7 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
                     "training", self._rdzv_round, self._rdzv_nodes,
                     self._start_rdzv_ts,
                 )
+                self._notify_round()
             # a node that has re-joined is waiting for the NEXT round —
             # never hand it the stale world it used to belong to
             if (
@@ -305,6 +345,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                     "network_check", self._rdzv_round,
                     self._rdzv_nodes, self._start_rdzv_ts,
                 )
+                self._notify_round()
                 # bounded history, NOT a cycle clear: a new cohort's
                 # check (replacement/restored nodes probing each
                 # other) must not wipe other nodes' verdicts — a

@@ -101,6 +101,8 @@ def run(args) -> int:
                         args, "autoscale_interval", 60.0
                     ),
                     brain_client=brain_client,
+                    state_dir=getattr(args, "state_dir", "") or None,
+                    fresh=getattr(args, "fresh", False),
                 )
                 break
             except Exception as e:
@@ -113,7 +115,9 @@ def run(args) -> int:
     master.prepare()
     # print the bound port so a parent launcher can discover it
     print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)
-    return master.run()
+    return master.run(
+        check_interval=getattr(args, "check_interval", 3.0) or 3.0
+    )
 
 
 #: deliberate job failure (workers failed / critical node lost / hang

@@ -80,6 +80,26 @@ class SpeedMonitor:
         self._host_durations: Dict[int, Deque[float]] = {}
         self._straggler_strikes: Dict[int, int] = {}
         self._stragglers: Set[int] = set()
+        # master state journal hook: listener(step, batch_feed) fires
+        # when the max step advances, throttled to one write per second
+        self._step_listener = None
+        self._last_step_persist = 0.0
+
+    def set_step_listener(self, listener):
+        self._step_listener = listener
+
+    def restore_global_step(self, global_step: int,
+                            batch_feed: bool = False):
+        """Master-restart restore. ``batch_feed`` records which unit the
+        old master was counting in — restoring a batch-fed count as a
+        real step would silence the batch feed forever."""
+        self._global_step = max(self._global_step, int(global_step))
+        if batch_feed:
+            self._batches_done = max(self._batches_done, int(global_step))
+        else:
+            self._has_step_reports = self._has_step_reports or (
+                global_step > 0
+            )
 
     def set_target_worker_num(self, worker_num: int):
         self._target_worker_num = worker_num
@@ -139,7 +159,20 @@ class SpeedMonitor:
                 # wildly inflated speed sample into the scaler's window
                 self._global_step_records.clear()
                 self._global_step = 0
+        advanced = global_step > self._global_step
         self._global_step = max(self._global_step, global_step)
+        if (
+            self._step_listener is not None
+            and advanced
+            and timestamp - self._last_step_persist >= 1.0
+        ):
+            self._last_step_persist = timestamp
+            try:
+                self._step_listener(
+                    self._global_step, _source == "batch"
+                )
+            except Exception:
+                pass  # journal IO must never fail a step report
         if not self._start_training_time:
             self._start_training_time = time.time()
         self._global_step_records.append(

@@ -44,6 +44,7 @@ class MasterServicer:
         error_monitor=None,
         job_metric_collector=None,
         auto_scaler=None,
+        kv_store=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -53,9 +54,19 @@ class MasterServicer:
         self._error_monitor = error_monitor
         self._job_metric_collector = job_metric_collector
         self._auto_scaler = auto_scaler
-        self._kv_store = KVStoreService()
+        # injectable so the master can wire a journal-backed store that
+        # survives a master restart (master/state_journal.py)
+        self._kv_store = kv_store or KVStoreService()
         self._start_training_time = 0.0
         self.run_configs = {}
+
+    def _running_nodes(self):
+        """Deferred node-list snapshot for the stats collector: only
+        materialized when its rate limiter actually takes a sample."""
+        return (
+            self._job_manager.get_running_nodes()
+            if self._job_manager else []
+        )
 
     # ------------------------------------------------------------- dispatch
 
@@ -107,6 +118,19 @@ class MasterServicer:
             dataset_name=req.dataset_name,
             dataset_splitter=splitter,
             task_type=req.task_type or TaskType.TRAINING,
+            # raw params, journaled so a RESTARTED master can rebuild
+            # the splitter before any worker re-registers
+            params={
+                "batch_size": req.batch_size,
+                "num_epochs": req.num_epochs,
+                "dataset_size": req.dataset_size,
+                "shuffle": req.shuffle,
+                "num_minibatches_per_shard":
+                    req.num_minibatches_per_shard,
+                "dataset_name": req.dataset_name,
+                "task_type": req.task_type or TaskType.TRAINING,
+                "storage_type": req.storage_type,
+            },
         )
         if self._job_metric_collector and req.task_type == TaskType.TRAINING:
             self._job_metric_collector.collect_dataset_metric(
@@ -153,9 +177,7 @@ class MasterServicer:
             # report_global_step — sample runtime stats on the same
             # trigger so the resource optimizer sees their throughput
             self._job_metric_collector.collect_runtime_stats(
-                self._speed_monitor,
-                self._job_manager.get_running_nodes()
-                if self._job_manager else [],
+                self._speed_monitor, self._running_nodes,
             )
         return comm.Response(success=True)
 
@@ -365,9 +387,7 @@ class MasterServicer:
             )
         if self._job_metric_collector:
             self._job_metric_collector.collect_runtime_stats(
-                self._speed_monitor,
-                self._job_manager.get_running_nodes()
-                if self._job_manager else [],
+                self._speed_monitor, self._running_nodes,
             )
         return comm.Response(success=True)
 
@@ -428,6 +448,7 @@ def create_master_service(
     error_monitor=None,
     job_metric_collector=None,
     auto_scaler=None,
+    kv_store=None,
 ):
     """Build the gRPC server around a MasterServicer
     (parity: servicer.py:478)."""
@@ -440,6 +461,7 @@ def create_master_service(
         error_monitor=error_monitor,
         job_metric_collector=job_metric_collector,
         auto_scaler=auto_scaler,
+        kv_store=kv_store,
     )
     server = GenericRpcServer(servicer.handle, port=port)
     return server, servicer

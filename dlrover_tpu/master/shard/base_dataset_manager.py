@@ -7,7 +7,7 @@ import json
 import time
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter, Shard
@@ -48,16 +48,37 @@ class DoingTask:
 
 class DatasetShardCheckpoint:
     """JSON-serializable shard progress of one dataset
-    (parity: base_dataset_manager.py:60)."""
+    (parity: base_dataset_manager.py:60).
+
+    The base fields (``todo``/``doing`` as bare ``[start, end]`` ranges)
+    are the worker-facing checkpoint contract and stay unchanged. The
+    optional detail fields carry what a RESTARTED MASTER needs to resume
+    without double-dispatching in-flight shards: the original task ids,
+    owners and incarnations of the doing set (see
+    ``BatchDatasetManager.restore_checkpoint(keep_doing=True)``). Old
+    checkpoints without them still load — ``from_json`` defaults apply.
+    """
 
     def __init__(self, dataset_name: str, todo: List[List[int]],
                  doing: List[List[int]], epoch: int,
-                 splitter_epoch: int = 0):
+                 splitter_epoch: int = 0,
+                 todo_ids: Optional[List[int]] = None,
+                 doing_detail: Optional[List[List[int]]] = None,
+                 next_task_id: int = 0,
+                 completed_step: int = 0):
         self.dataset_name = dataset_name
         self.todo = todo  # [[start, end], ...]
         self.doing = doing
         self.epoch = epoch
         self.splitter_epoch = splitter_epoch
+        #: task ids parallel to ``todo`` (master-restart detail)
+        self.todo_ids = todo_ids
+        #: [[task_id, node_id, start, end, incarnation], ...]
+        self.doing_detail = doing_detail
+        #: next unissued task id — restoring it keeps ids unique across
+        #: a master restart (a reused id would collide with in-flight ones)
+        self.next_task_id = next_task_id
+        self.completed_step = completed_step
 
     def to_json(self) -> str:
         return json.dumps(self.__dict__)
@@ -71,6 +92,10 @@ class DatasetShardCheckpoint:
             doing=d.get("doing", []),
             epoch=d.get("epoch", 0),
             splitter_epoch=d.get("splitter_epoch", 0),
+            todo_ids=d.get("todo_ids"),
+            doing_detail=d.get("doing_detail"),
+            next_task_id=d.get("next_task_id", 0),
+            completed_step=d.get("completed_step", 0),
         )
 
 

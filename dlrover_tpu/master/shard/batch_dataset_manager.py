@@ -124,29 +124,80 @@ class BatchDatasetManager(DatasetManger):
 
     def checkpoint(self) -> DatasetShardCheckpoint:
         """Snapshot todo+doing shard ranges (parity:
-        batch_dataset_manager.py:157)."""
+        batch_dataset_manager.py:157), plus the task-id/owner detail a
+        restarted master needs for an exactly-once resume."""
         todo = []
+        todo_ids = []
         for task in self.todo:
             todo.append([task.shard.start, task.shard.end])
+            todo_ids.append(task.task_id)
         doing = []
+        doing_detail = []
         for doing_task in self.doing.values():
             doing.append(
                 [doing_task.task.shard.start, doing_task.task.shard.end]
             )
+            doing_detail.append([
+                doing_task.task.task_id,
+                doing_task.node_id,
+                doing_task.task.shard.start,
+                doing_task.task.shard.end,
+                doing_task.incarnation,
+            ])
         return DatasetShardCheckpoint(
             dataset_name=self._dataset_splitter.dataset_name,
             todo=todo,
             doing=doing,
             epoch=self._dataset_splitter.get_epoch(),
             splitter_epoch=self._dataset_splitter.get_epoch(),
+            todo_ids=todo_ids,
+            doing_detail=doing_detail,
+            next_task_id=self._task_id,
+            completed_step=self._completed_step,
         )
 
-    def restore_checkpoint(self, checkpoint: DatasetShardCheckpoint):
-        """Rebuild todo from a checkpoint: doing shards go back to todo."""
+    def restore_checkpoint(self, checkpoint: DatasetShardCheckpoint,
+                           keep_doing: bool = False):
+        """Rebuild the task queues from a checkpoint.
+
+        Default (worker-driven restore, the historical RPC path): doing
+        shards are REQUEUED into todo with fresh ids — correct when the
+        workers restart along with their progress.
+
+        ``keep_doing=True`` (master restart behind live workers): the
+        doing set is restored in place with its ORIGINAL task ids and
+        owners, so a surviving worker's completion report for a shard it
+        fetched before the crash is accepted instead of the shard being
+        re-dispatched to someone else (duplicate consumption). Requires
+        the detail fields; checkpoints without them fall back to the
+        requeue path. start_time restarts at now — the task-timeout
+        watchdog still reclaims shards whose owner died with the master.
+        """
         self._dataset_splitter.set_epoch(checkpoint.epoch)
         self.todo = []
         self.doing = {}
         name = self._dataset_splitter.dataset_name
+        if keep_doing and checkpoint.doing_detail is not None:
+            self._task_id = max(self._task_id, checkpoint.next_task_id)
+            self._completed_step = checkpoint.completed_step
+            now = time.time()
+            for task_id, node_id, start, end, incarnation in (
+                    checkpoint.doing_detail):
+                self.doing[task_id] = DoingTask(
+                    Task(task_id, self._task_type, Shard(name, start, end)),
+                    node_id, now, incarnation,
+                )
+            todo_ids = checkpoint.todo_ids or []
+            for i, (start, end) in enumerate(checkpoint.todo):
+                if i < len(todo_ids):
+                    task_id = todo_ids[i]
+                else:
+                    task_id = self._task_id
+                    self._task_id += 1
+                self.todo.append(
+                    Task(task_id, self._task_type, Shard(name, start, end))
+                )
+            return
         for start, end in checkpoint.doing + checkpoint.todo:
             self.todo.append(
                 Task(self._task_id, self._task_type, Shard(name, start, end))

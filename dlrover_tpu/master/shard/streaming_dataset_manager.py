@@ -87,7 +87,10 @@ class StreamingDatasetManager(DatasetManger):
             epoch=self._dataset_splitter.get_epoch(),
         )
 
-    def restore_checkpoint(self, checkpoint: DatasetShardCheckpoint):
+    def restore_checkpoint(self, checkpoint: DatasetShardCheckpoint,
+                           keep_doing: bool = False):
+        # streaming checkpoints carry no task-id detail: keep_doing has
+        # nothing to keep, so a master restart requeues in-flight offsets
         from dlrover_tpu.master.shard.dataset_splitter import Shard
 
         self.todo = []

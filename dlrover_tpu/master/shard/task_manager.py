@@ -43,6 +43,31 @@ class TaskManager:
         self._speed_monitor = speed_monitor
         self._task_timeout = _context.task_process_timeout
         self._thread: Optional[threading.Thread] = None
+        self._state_journal = None
+
+    def attach_state_journal(self, journal):
+        """Write-through persistence: every shard-ledger mutation lands
+        in the journal before the RPC reply leaves, so a restarted
+        master resumes with the doing set the workers actually hold."""
+        self._state_journal = journal
+
+    def _persist(self, dataset_name: str):
+        """Persist one dataset's ledger; caller holds self._lock."""
+        if self._state_journal is None:
+            return
+        ds = self._datasets.get(dataset_name)
+        ckpt = getattr(ds, "checkpoint", None) if ds else None
+        if ckpt is None:
+            return
+        try:
+            self._state_journal.save_dataset_checkpoint(
+                dataset_name, ckpt().to_json()
+            )
+        except Exception as e:  # never fail the dispatch on journal IO
+            logger.warning(
+                "state journal write failed for dataset %s: %s",
+                dataset_name, e,
+            )
 
     # ------------------------------------------------------------- datasets
 
@@ -53,7 +78,11 @@ class TaskManager:
         dataset_name: str,
         dataset_splitter: DatasetSplitter,
         task_type: str = TaskType.TRAINING,
+        params: Optional[dict] = None,
     ):
+        """Register a dataset. ``params`` are the raw shard params the
+        worker reported — journaled so a restarted master can rebuild
+        the splitter before any worker re-registers."""
         with self._lock:
             if dataset_name in self._datasets:
                 logger.info("Dataset %s already registered", dataset_name)
@@ -67,6 +96,17 @@ class TaskManager:
                     task_type, batch_size, dataset_splitter
                 )
             self._datasets[dataset_name] = dataset
+            if self._state_journal is not None and params is not None:
+                try:
+                    self._state_journal.save_dataset_params(
+                        dataset_name, params
+                    )
+                except Exception as e:
+                    logger.warning(
+                        "state journal write failed for dataset %s "
+                        "params: %s", dataset_name, e,
+                    )
+            self._persist(dataset_name)
             logger.info(
                 "New dataset %s: size=%d batch=%d type=%s",
                 dataset_name, dataset_size, batch_size, task_type,
@@ -90,7 +130,13 @@ class TaskManager:
             ds = self._datasets.get(dataset_name)
             if ds is None:
                 return Task.create_invalid_task()
-            return ds.get_task(node_type, node_id, incarnation)
+            task = ds.get_task(node_type, node_id, incarnation)
+            if task.task_id >= 0:
+                # persist BEFORE the task leaves: if the reply is lost
+                # with the master, the restored doing entry times out and
+                # requeues; if it arrives, the completion report matches
+                self._persist(dataset_name)
+            return task
 
     def report_dataset_task(self, dataset_name: str, task_id: int,
                             success: bool, err: str = ""):
@@ -99,6 +145,8 @@ class TaskManager:
             if ds is None:
                 raise ValueError(f"unknown dataset {dataset_name}")
             success, doing_task = ds.report_task_status(task_id, success)
+            if doing_task is not None:
+                self._persist(dataset_name)
             if success and self._speed_monitor and doing_task:
                 self._speed_monitor.add_task_completed(
                     doing_task.node_id, time.time() - doing_task.start_time
@@ -117,6 +165,7 @@ class TaskManager:
                 if recover:
                     ids = recover(node_id)
                     if ids:
+                        self._persist(name)
                         logger.info(
                             "Recovered tasks %s of node %s in dataset %s",
                             ids, node_id, name,
@@ -150,9 +199,10 @@ class TaskManager:
         (parity: task_manager.py:205)."""
         while not self._should_stop:
             with self._lock:
-                for ds in list(self._datasets.values()):
+                for name, ds in list(self._datasets.items()):
                     doing = getattr(ds, "get_doing_tasks", lambda: {})()
                     now = time.time()
+                    requeued = False
                     for task_id, dt in list(doing.items()):
                         if now - dt.start_time > self._task_timeout:
                             logger.warning(
@@ -160,6 +210,9 @@ class TaskManager:
                                 task_id, dt.node_id,
                             )
                             ds.report_task_status(task_id, success=False)
+                            requeued = True
+                    if requeued:
+                        self._persist(name)
             time.sleep(1)
 
     # ----------------------------------------------------------- checkpoint
@@ -174,14 +227,22 @@ class TaskManager:
             ckpt = getattr(ds, "checkpoint", None)
             return ckpt() if ckpt else None
 
-    def restore_dataset_from_checkpoint(self, content: str) -> bool:
+    def restore_dataset_from_checkpoint(self, content: str,
+                                        keep_doing: bool = False) -> bool:
+        """Restore one dataset's ledger from checkpoint JSON.
+
+        ``keep_doing=True`` is the master-restart path: in-flight tasks
+        stay in flight under their original ids/owners (exactly-once
+        across the restart); the default requeues them (worker-driven
+        restore, where workers restart too)."""
         try:
             checkpoint = DatasetShardCheckpoint.from_json(content)
             with self._lock:
                 ds = self._datasets.get(checkpoint.dataset_name)
                 if ds is None:
                     return False
-                ds.restore_checkpoint(checkpoint)
+                ds.restore_checkpoint(checkpoint, keep_doing=keep_doing)
+                self._persist(checkpoint.dataset_name)
             return True
         except Exception as e:
             logger.error("Failed to restore shard checkpoint: %s", e)

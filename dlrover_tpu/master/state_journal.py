@@ -1,0 +1,180 @@
+"""Durable master job-state journal.
+
+The distributed master holds the whole job's coordination state in
+memory — the shard todo/doing ledger, the bootstrap KV store, rendezvous
+round counters, the speed monitor's global step. A master pod eviction
+therefore used to end the run even though every worker was healthy. This
+module write-through-journals that state into the pluggable
+``util/state_store.py`` FileStore (parity: the reference's
+``util/state/store_mananger.py`` kept exactly this door open), so a
+restarted master resumes the job behind the workers' reconnect
+supervision instead of restarting it.
+
+Layout under the state dir (one JSON file per key):
+
+    master/<job>/meta                 {"job_name": ..., "saved_at": ...}
+    master/<job>/dataset/<name>/params      raw shard params (rebuild splitter)
+    master/<job>/dataset/<name>/checkpoint  DatasetShardCheckpoint JSON
+    master/<job>/kv                   KV store contents (latin-1 strings)
+    master/<job>/rdzv/<name>          {"round": n}
+    master/<job>/rdzv_params/<name>   {"min_nodes": ..., "max_nodes": ...}
+    master/<job>/speed                {"step": n, "batch_feed": bool}
+
+Enabled by ``DLROVER_TPU_MASTER_STATE_DIR`` (or ``--state_dir``); off by
+default. ``--fresh`` wipes the job's prior state instead of restoring.
+"""
+
+import os
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.util.state_store import StateBackend, build_state_store
+
+ENV_STATE_DIR = "DLROVER_TPU_MASTER_STATE_DIR"
+
+
+def _safe_name(name: str) -> str:
+    """Job/dataset names become path components in the FileStore."""
+    return re.sub(r"[^A-Za-z0-9._-]", "_", name) or "job"
+
+
+class MasterStateJournal:
+    """Write-through persistence for one job's recoverable master state."""
+
+    def __init__(self, store: StateBackend, job_name: str):
+        self._store = store
+        self._prefix = f"master/{_safe_name(job_name)}"
+        self._job_name = job_name
+
+    def _key(self, *parts: str) -> str:
+        return "/".join((self._prefix,) + parts)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def has_state(self) -> bool:
+        return bool(self._store.keys(self._prefix + "/"))
+
+    def clear(self):
+        for key in self._store.keys(self._prefix + "/"):
+            self._store.delete(key)
+
+    def mark_started(self):
+        self._store.set(
+            self._key("meta"),
+            {"job_name": self._job_name, "saved_at": time.time()},
+        )
+
+    # ------------------------------------------------------- dataset ledger
+
+    def save_dataset_params(self, name: str, params: dict):
+        self._store.set(self._key("dataset", _safe_name(name), "params"),
+                        params)
+
+    def save_dataset_checkpoint(self, name: str, checkpoint_json: str):
+        self._store.set(
+            self._key("dataset", _safe_name(name), "checkpoint"),
+            checkpoint_json,
+        )
+
+    def saved_datasets(self) -> List[str]:
+        """Dataset names (as persisted in params) with saved state."""
+        names = []
+        prefix = self._key("dataset") + "/"
+        for key in self._store.keys(prefix):
+            if key.endswith("/params"):
+                params = self._store.get(key) or {}
+                name = params.get("dataset_name")
+                if name:
+                    names.append(name)
+        return sorted(set(names))
+
+    def load_dataset(self, name: str) -> Tuple[Optional[dict],
+                                               Optional[str]]:
+        safe = _safe_name(name)
+        params = self._store.get(self._key("dataset", safe, "params"))
+        ckpt = self._store.get(self._key("dataset", safe, "checkpoint"))
+        return params, ckpt
+
+    # ------------------------------------------------------------- KV store
+
+    def save_kv(self, data: Dict[str, bytes]):
+        # JSON can't carry bytes: latin-1 maps every byte 1:1 to a
+        # codepoint, round-tripping arbitrary values losslessly
+        self._store.set(
+            self._key("kv"),
+            {k: v.decode("latin-1") for k, v in data.items()},
+        )
+
+    def load_kv(self) -> Dict[str, bytes]:
+        data = self._store.get(self._key("kv")) or {}
+        return {k: v.encode("latin-1") for k, v in data.items()}
+
+    # ----------------------------------------------------------- rendezvous
+
+    def save_rdzv_round(self, rdzv_name: str, rdzv_round: int):
+        self._store.set(
+            self._key("rdzv", _safe_name(rdzv_name)),
+            {"round": int(rdzv_round)},
+        )
+
+    def load_rdzv_rounds(self) -> Dict[str, int]:
+        rounds = {}
+        prefix = self._key("rdzv") + "/"
+        for key in self._store.keys(prefix):
+            value = self._store.get(key) or {}
+            rounds[key[len(prefix):]] = int(value.get("round", 0))
+        return rounds
+
+    def save_rdzv_params(self, rdzv_name: str, params: dict):
+        """min/max nodes, waiting timeout, node unit — without them a
+        restarted master can never complete a round (completion is
+        gated on params having been reported)."""
+        self._store.set(
+            self._key("rdzv_params", _safe_name(rdzv_name)), params
+        )
+
+    def load_rdzv_params(self) -> Dict[str, dict]:
+        out = {}
+        prefix = self._key("rdzv_params") + "/"
+        for key in self._store.keys(prefix):
+            value = self._store.get(key)
+            if value:
+                out[key[len(prefix):]] = value
+        return out
+
+    # ---------------------------------------------------------- global step
+
+    def save_global_step(self, step: int, batch_feed: bool = False):
+        self._store.set(
+            self._key("speed"),
+            {"step": int(step), "batch_feed": bool(batch_feed)},
+        )
+
+    def load_global_step(self) -> Tuple[int, bool]:
+        value = self._store.get(self._key("speed")) or {}
+        return int(value.get("step", 0)), bool(value.get("batch_feed"))
+
+
+def build_master_state_journal(
+    job_name: str,
+    state_dir: Optional[str] = None,
+    fresh: bool = False,
+) -> Optional[MasterStateJournal]:
+    """Build the journal when a state dir is configured; None otherwise.
+
+    ``fresh=True`` wipes the job's prior state (deliberate restart from
+    scratch against a dirty state dir)."""
+    state_dir = state_dir or os.getenv(ENV_STATE_DIR, "")
+    if not state_dir:
+        return None
+    store = build_state_store("file", state_dir)
+    journal = MasterStateJournal(store, job_name)
+    if fresh and journal.has_state():
+        logger.info(
+            "--fresh: discarding prior master state for job %r under %s",
+            job_name, state_dir,
+        )
+        journal.clear()
+    return journal

@@ -93,10 +93,15 @@ class JobMetricCollector:
         self._reporter.report_model_metrics(metric)
 
     @_catch
-    def collect_runtime_stats(self, speed_monitor, running_nodes: List):
+    def collect_runtime_stats(self, speed_monitor, running_nodes):
         """Sample once per global-step advance (parity:
         collect_runtime_stats + report_runtime_stats_periodically — the
-        step gate replaces the reference's 15s thread)."""
+        step gate replaces the reference's 15s thread).
+
+        ``running_nodes`` may be a list OR a zero-arg callable returning
+        one: callers on hot RPC paths (every accepted task report) pass
+        the callable so the node-list snapshot is only materialized when
+        the rate limiter actually takes a sample."""
         if speed_monitor is None:
             return
         now = time.time()
@@ -114,6 +119,8 @@ class JobMetricCollector:
             return
         self._last_sampled_step = step
         self._last_sample_time = now
+        if callable(running_nodes):
+            running_nodes = running_nodes() or []
         def node_dict(n):
             d = n.to_dict() if hasattr(n, "to_dict") else dict(n)
             used = getattr(n, "used_resource", None)

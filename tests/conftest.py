@@ -50,6 +50,7 @@ except AttributeError:
 # dominate runtime. CI runs the three groups on separate shards.
 
 DRILL_MODULES = {
+    "test_master_failover",
     "test_two_node_failover",
     "test_e2e_elastic_run",
     "test_operator",

@@ -235,6 +235,31 @@ def test_brain_outage_queues_write_even_for_vanished_pods(stub):
     assert flaky.events == [("host-1", "failure", "j")]
 
 
+def test_pending_queue_dedupes_and_caps(stub, monkeypatch):
+    """A crash storm during a Brain outage must neither re-queue the
+    same (host, kind, job) incident nor grow the queue without bound:
+    duplicates are dropped on entry, and past the cap the OLDEST
+    incident is dropped with a warning."""
+    from dlrover_tpu.brain import monitor as monitor_mod
+
+    class DownBrain:
+        def report_node_event(self, host, kind, job_name=""):
+            raise OSError("brain down")
+
+    monitor = ClusterMonitor(_api(stub), DownBrain(), poll_interval=0.0)
+    monitor._queue_retry("host-1", "failure", "j")
+    monitor._queue_retry("host-1", "failure", "j")  # duplicate
+    assert monitor._pending == [("host-1", "failure", "j")]
+
+    monkeypatch.setattr(monitor_mod, "MAX_PENDING_INCIDENTS", 3)
+    for i in range(2, 6):
+        monitor._queue_retry(f"host-{i}", "oom", "j")
+    # capped at 3: the oldest entries were dropped first
+    assert len(monitor._pending) == 3
+    assert monitor._pending[-1] == ("host-5", "oom", "j")
+    assert ("host-1", "failure", "j") not in monitor._pending
+
+
 # ===================================================================
 # SpeedMonitor: the other half of cluster monitoring — the throughput
 # window the autoscaler and hang watchdog act on (ISSUE 2 satellite).

@@ -24,7 +24,12 @@ def master():
 
 
 def _client(master, **kw):
-    mc = MasterClient(master.addr, node_id=0, node_type="worker")
+    # short reconnect deadline: the failure test below kills the master
+    # for good, and the point is the POST-deadline semantics (failed,
+    # not exhausted) — not riding out a 10-minute production outage
+    mc = MasterClient(master.addr, node_id=0, node_type="worker",
+                      reconnect_timeout=2.0)
+    mc._supervisor._backoff_cap = 0.2
     kw.setdefault("batch_size", 4)
     kw.setdefault("dataset_size", 10_000)
     kw.setdefault("num_minibatches_per_shard", 1)

@@ -19,6 +19,18 @@ from dlrover_tpu.util.state_store import (
 )
 
 
+def _mutate_appender(root, key, proc_idx, count):
+    """Spawn-context child for test_mutate_cross_process_atomicity
+    (must be a top-level function to be picklable)."""
+    from dlrover_tpu.util.state_store import FileStore
+
+    store = FileStore(root)
+    for i in range(count):
+        store.mutate(
+            key, lambda v: (v or []) + [[proc_idx, i]], default=[]
+        )
+
+
 class TestStateStore:
     def test_memory_roundtrip(self):
         s = MemoryStore()
@@ -46,6 +58,36 @@ class TestStateStore:
         s = FileStore(str(tmp_path))
         with pytest.raises(ValueError):
             s.set("../escape", 1)
+
+    def test_mutate_cross_process_atomicity(self, tmp_path):
+        """N processes appending to ONE key must not lose a single
+        update: mutate() serializes read-modify-write through the
+        per-key fcntl sidecar lock, which is the property the shared
+        brain archive (and the master state dir) depend on when two
+        masters write the same store."""
+        import multiprocessing as mp
+
+        root = str(tmp_path)
+        procs_n, per_proc = 4, 25
+        ctx = mp.get_context("spawn")  # spawn: no inherited lock state
+        procs = [
+            ctx.Process(
+                target=_mutate_appender,
+                args=(root, "shared/log", i, per_proc),
+            )
+            for i in range(procs_n)
+        ]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=60)
+            assert p.exitcode == 0
+        entries = FileStore(root).get("shared/log")
+        assert len(entries) == procs_n * per_proc, (
+            f"lost updates: {len(entries)} != {procs_n * per_proc}"
+        )
+        # every (proc, seq) pair arrived exactly once
+        assert len({tuple(e) for e in entries}) == procs_n * per_proc
 
     def test_factory_singleton_and_env(self, tmp_path, monkeypatch):
         a = build_state_store("memory")
