@@ -301,6 +301,15 @@ def main():
     tracing.clear()
     tracing.enable()
 
+    # goodput over the timed window (telemetry/goodput.py): the bench
+    # is single-process and fault-free, so training is the whole
+    # window minus the measured checkpoint stalls — the same ledger
+    # arithmetic the elastic trainer runs, so BENCH_*.json tracks
+    # effective throughput with the fields the job-level account uses
+    from dlrover_tpu.telemetry.goodput import Phase, PhaseLedger
+
+    ledger = PhaseLedger(phase=Phase.TRAINING, journal_events=False)
+
     t0 = time.perf_counter()
     ckpt_pending = False
     for i in range(steps):
@@ -330,6 +339,13 @@ def main():
     # so this waits for all 20 steps without a per-step host round-trip
     loss_val = float(loss)
     dt = time.perf_counter() - t0
+    # re-label the measured checkpoint costs (stalls + staging waits)
+    # inside the window as ckpt_stall badput
+    ledger.credit(
+        Phase.CKPT_STALL,
+        (sum(ckpt_stalls) + sum(ckpt_waits)) / 1e3,
+    )
+    goodput_snap = ledger.close()
     phases = tracing.summarize(
         ("data", "dispatch", "ckpt.wait_staged", "ckpt.stage")
     )
@@ -426,6 +442,23 @@ def main():
         "dispatch_ms_max": round(
             phases.get("dispatch", {}).get("max_ms", 0.0), 3
         ),
+        # effective-throughput account (docs/TELEMETRY.md Goodput):
+        # fraction of the timed window spent training, and the badput
+        # breakdown in the job-level causes. rendezvous/restart are
+        # structurally 0 in this single-process bench; they exist so
+        # BENCH_*.json rows compare field-for-field with elastic runs
+        "goodput_percent": goodput_snap["goodput_percent"],
+        "badput_ms": {
+            "rendezvous": round(
+                goodput_snap["phases"][Phase.RENDEZVOUS] * 1e3, 3
+            ),
+            "ckpt_stall": round(
+                goodput_snap["phases"][Phase.CKPT_STALL] * 1e3, 3
+            ),
+            "restart": round(
+                goodput_snap["phases"][Phase.RESTART] * 1e3, 3
+            ),
+        },
     }
     if ckpt_stalls:
         # train-thread cost of the flash saves inside the timed loop

@@ -205,6 +205,13 @@ class ElasticTrainingAgent:
         # per-host scrape point (the master serves its own): ephemeral
         # port unless DLROVER_TPU_METRICS_PORT pins/disables it
         self._metrics_server = start_metrics_server()
+        # per-process goodput ledger: phases derive from the events
+        # this agent already journals (scale.restart,
+        # rendezvous.joined, agent.master_lost/_reconnected) via the
+        # journal tap — no extra calls needed here
+        from dlrover_tpu.telemetry import goodput
+
+        self._goodput = goodput.install()
 
     def _start_heartbeat(self, interval: float = 15.0):
         """Feed the master's liveness watchdog and act on the directive

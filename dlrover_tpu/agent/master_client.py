@@ -27,6 +27,7 @@ public RPC runs under a ConnectionSupervisor instead:
 import functools
 import os
 import random
+import socket
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -70,12 +71,16 @@ def is_connection_error(exc: BaseException) -> bool:
     INTERNAL on handler exceptions (common/grpc_utils.py) — those are
     the remote code talking and must surface immediately. A dead or
     rescheduling master manifests as UNAVAILABLE / DEADLINE_EXCEEDED or
-    a raw socket error."""
+    a raw socket error; a master that dies (os._exit on an injected
+    crash, OOM-kill) with our unary call in flight surfaces as
+    CANCELLED from the peer — nothing in this codebase cancels calls
+    client-side, so CANCELLED is also the master going away."""
     if isinstance(exc, grpc.RpcError):
         code = getattr(exc, "code", lambda: None)()
         return code in (
             grpc.StatusCode.UNAVAILABLE,
             grpc.StatusCode.DEADLINE_EXCEEDED,
+            grpc.StatusCode.CANCELLED,
         )
     return isinstance(exc, (ConnectionError, OSError))
 
@@ -478,10 +483,33 @@ class MasterClient:
     @supervised_rpc
     def report_global_step(self, step: int,
                            timestamp: Optional[float] = None):
+        # piggyback the goodput ledger when this process armed one
+        # (telemetry/goodput.py) — empty fields otherwise, so the wire
+        # message is unchanged for ledger-less processes
+        from dlrover_tpu.telemetry import goodput
+
         req = self._fill(comm.GlobalStep(
             timestamp=timestamp or time.time(), step=step,
+            pid=os.getpid(), **goodput.report_fields(),
         ))
         return self._call("report_global_step", req)
+
+    @supervised_rpc
+    def report_goodput(self, final: bool = False):
+        """Push the full ledger snapshot outside the step cadence
+        (periodic agent heartbeats, and once with ``final=True`` at
+        process exit so the master closes the incarnation). No-op
+        without an armed ledger."""
+        from dlrover_tpu.telemetry import goodput
+
+        fields = goodput.report_fields()
+        if not fields:
+            return None
+        req = self._fill(comm.GoodputReport(
+            pid=os.getpid(), host=socket.gethostname(),
+            final=final, **fields,
+        ))
+        return self._call("report_goodput", req)
 
     @supervised_rpc
     def report_custom_data(self, data: Dict):
@@ -604,6 +632,9 @@ class LocalMasterClient:
         return self._kv.get(key, b"")
 
     def report_global_step(self, step, timestamp=None):
+        pass
+
+    def report_goodput(self, final=False):
         pass
 
     def report_custom_data(self, data):

@@ -3,6 +3,14 @@
 Parity reference: dlrover/python/elastic_agent/monitor/resource.py:88 — psutil
 CPU/mem plus TPU memory stats (via jax device memory_stats when a process owns
 the chips) reported to the master every interval.
+
+Every sample is also exported as labeled gauges in the telemetry
+registry, so this host's ``/metrics`` shows live HBM watermarks
+(``dlrover_tpu_hbm_bytes_in_use{device=...}`` and the monotonic
+``dlrover_tpu_hbm_peak_bytes``) alongside CPU/RSS; a new per-device
+peak journals a ``resource.hbm_peak`` event, putting OOM-adjacent
+high-water marks on the same timeline as the saves/rescales that
+caused them.
 """
 
 import os
@@ -11,6 +19,7 @@ import time
 from typing import Dict, List
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import gauge, record
 
 try:
     import psutil
@@ -53,6 +62,10 @@ def get_tpu_stats() -> List[Dict]:
                 "device": str(d),
                 "bytes_in_use": m.get("bytes_in_use", 0),
                 "bytes_limit": m.get("bytes_limit", 0),
+                # some runtimes track the high-water mark themselves;
+                # 0 means "not provided" and the monitor falls back to
+                # max-of-observed bytes_in_use
+                "peak_bytes_in_use": m.get("peak_bytes_in_use", 0),
             })
     except Exception:
         pass
@@ -71,6 +84,9 @@ class ResourceMonitor:
         self._thread = None
         self.total_cpu_percent = 0.0
         self.total_memory_mb = 0
+        # per-device HBM high-water marks (bytes); a new peak is a
+        # journaled event, not just a gauge move
+        self._hbm_peaks: Dict[str, int] = {}
 
     def start(self):
         if self._thread is not None:
@@ -95,6 +111,54 @@ class ResourceMonitor:
         self.total_cpu_percent = get_process_cpu_percent()
         self.total_memory_mb = get_used_memory_mb()
         tpu = get_tpu_stats() if self._collect_tpu else []
+        self._export_metrics(tpu)
         self._master_client.report_used_resource(
             self.total_cpu_percent, self.total_memory_mb, tpu
         )
+
+    def _export_metrics(self, tpu_stats: List[Dict]):
+        """Mirror the sample into the telemetry registry (this host's
+        /metrics) and journal new per-device HBM peaks. Never raises —
+        monitoring must not take the report loop down."""
+        try:
+            gauge(
+                "dlrover_node_cpu_percent",
+                "Host CPU utilization sampled by the resource monitor",
+            ).set(float(self.total_cpu_percent))
+            gauge(
+                "dlrover_node_memory_used_mb",
+                "Host memory in use (MB)",
+            ).set(float(self.total_memory_mb))
+            for s in tpu_stats:
+                device = str(s.get("device", "?"))
+                in_use = int(s.get("bytes_in_use", 0) or 0)
+                limit = int(s.get("bytes_limit", 0) or 0)
+                gauge(
+                    "dlrover_tpu_hbm_bytes_in_use",
+                    "Accelerator HBM bytes currently in use",
+                    ["device"],
+                ).labels(device=device).set(in_use)
+                if limit:
+                    gauge(
+                        "dlrover_tpu_hbm_bytes_limit",
+                        "Accelerator HBM capacity in bytes",
+                        ["device"],
+                    ).labels(device=device).set(limit)
+                peak = max(
+                    in_use, int(s.get("peak_bytes_in_use", 0) or 0)
+                )
+                prev = self._hbm_peaks.get(device, 0)
+                if peak > prev:
+                    self._hbm_peaks[device] = peak
+                    gauge(
+                        "dlrover_tpu_hbm_peak_bytes",
+                        "High-water mark of HBM bytes in use",
+                        ["device"],
+                    ).labels(device=device).set(peak)
+                    record(
+                        "resource.hbm_peak", device=device,
+                        bytes=peak, bytes_limit=limit,
+                        prev_bytes=prev,
+                    )
+        except Exception as e:
+            logger.warning("resource metric export failed: %s", e)

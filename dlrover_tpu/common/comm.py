@@ -387,6 +387,31 @@ class ResourceStats(BaseRequest):
 class GlobalStep(BaseRequest):
     timestamp: float = 0.0
     step: int = 0
+    # goodput ledger piggyback (telemetry/goodput.py): cumulative
+    # per-phase seconds for this process incarnation. Empty when the
+    # reporting process has no ledger armed — an old agent's message
+    # parses unchanged, and an old master ignores the fields.
+    goodput_phases: Dict = field(default_factory=dict)
+    goodput_elapsed_s: float = 0.0
+    goodput_start_ts: float = 0.0
+    goodput_phase: str = ""
+    # incarnations are keyed (node_id, pid): a relaunched worker is a
+    # new ledger, and the gap between the two is restart badput
+    pid: int = 0
+
+
+@dataclass
+class GoodputReport(BaseRequest):
+    """A full ledger snapshot outside the step cadence (process exit
+    sends ``final=True`` so the master closes the incarnation)."""
+
+    pid: int = 0
+    host: str = ""
+    goodput_phases: Dict = field(default_factory=dict)
+    goodput_elapsed_s: float = 0.0
+    goodput_start_ts: float = 0.0
+    goodput_phase: str = ""
+    final: bool = False
 
 
 @dataclass

@@ -38,6 +38,7 @@ from dlrover_tpu.master.stats import (
     JobMeta,
     LocalStatsReporter,
 )
+from dlrover_tpu.telemetry import goodput as goodput_mod
 from dlrover_tpu.telemetry import record
 from dlrover_tpu.telemetry.http import start_metrics_server
 
@@ -129,6 +130,16 @@ class DistributedJobMaster:
             self.speed_monitor.set_step_listener(
                 self.state_journal.save_global_step
             )
+        # job-wide goodput/badput/MTTR accounting: worker ledgers ride
+        # in on report_global_step / report_goodput, the aggregator
+        # checkpoints itself through the state journal so the account
+        # survives a master kill (telemetry/goodput.py)
+        self.goodput_aggregator = goodput_mod.GoodputAggregator(
+            persist_fn=(
+                self.state_journal.save_goodput
+                if self.state_journal else None
+            ),
+        )
         self.sync_service = SyncService(self.job_manager)
         self.auto_scaler = new_job_auto_scaler(
             self.job_manager, self.job_optimizer, scaler,
@@ -156,11 +167,13 @@ class DistributedJobMaster:
             job_metric_collector=self.job_metric_collector,
             auto_scaler=self.auto_scaler,
             kv_store=self.kv_store,
+            goodput_aggregator=self.goodput_aggregator,
         )
         self.port = self._server.port
         self._exit_code = 0
         self._exit_reason = ""
         self._metrics_server = None
+        self._goodput_summary_recorded = False
         self._wire_callbacks()
         # restore BEFORE prepare() opens the server: agents must never
         # observe the pre-restore (empty) state
@@ -264,6 +277,12 @@ class DistributedJobMaster:
             self.speed_monitor.restore_global_step(
                 step, batch_feed=batch_feed
             )
+        goodput_state = journal.load_goodput()
+        if goodput_state:
+            # the window since the prior incarnation's last persist is
+            # the master's own downtime: restore folds it in as one
+            # more (recovered) fault toward MTTR/MTBF
+            self.goodput_aggregator.restore_state(goodput_state)
         journal.mark_started()
         record(
             "master.restored",
@@ -288,6 +307,9 @@ class DistributedJobMaster:
         self.task_manager.start()
         self.auto_scaler.start_auto_scaling()
         self._server.start()
+        # /goodput on this master serves the job-level aggregation
+        # (and refreshes the goodput gauges on every read)
+        goodput_mod.set_job_provider(self._goodput_summary)
         # Prometheus /metrics + /journal (telemetry/http.py);
         # DLROVER_TPU_METRICS_PORT pins the port, "off" disables
         self._metrics_server = start_metrics_server()
@@ -359,10 +381,27 @@ class DistributedJobMaster:
         except Exception as e:
             logger.warning("stop broadcast failed: %s", e)
 
+    def _goodput_summary(self):
+        summary = self.goodput_aggregator.summary()
+        goodput_mod.export_metrics(summary)
+        return summary
+
     def stop(self):
         self.auto_scaler.stop()
         self.task_manager.stop()
         self.job_manager.stop()
+        # journal the final job account before the server goes away:
+        # the drill (and any post-mortem) compares the offline
+        # `dump --goodput` replay against this live total
+        if not self._goodput_summary_recorded:
+            self._goodput_summary_recorded = True
+            try:
+                summary = self._goodput_summary()
+                if summary.get("job", {}).get("procs"):
+                    record("goodput.job_summary", **summary["job"])
+            except Exception as e:
+                logger.warning("goodput summary failed: %s", e)
+        goodput_mod.set_job_provider(None)
         self._server.stop(grace=1.0)
         if self._metrics_server is not None:
             self._metrics_server.stop()

@@ -45,6 +45,7 @@ class MasterServicer:
         job_metric_collector=None,
         auto_scaler=None,
         kv_store=None,
+        goodput_aggregator=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -54,6 +55,7 @@ class MasterServicer:
         self._error_monitor = error_monitor
         self._job_metric_collector = job_metric_collector
         self._auto_scaler = auto_scaler
+        self._goodput = goodput_aggregator
         # injectable so the master can wire a journal-backed store that
         # survives a master restart (master/state_journal.py)
         self._kv_store = kv_store or KVStoreService()
@@ -389,6 +391,28 @@ class MasterServicer:
             self._job_metric_collector.collect_runtime_stats(
                 self._speed_monitor, self._running_nodes,
             )
+        if self._goodput is not None and req.goodput_phases:
+            self._goodput.observe_report(
+                node_id=req.node_id, pid=req.pid,
+                start_ts=req.goodput_start_ts,
+                elapsed_s=req.goodput_elapsed_s,
+                phases=req.goodput_phases,
+                phase=req.goodput_phase,
+            )
+        return comm.Response(success=True)
+
+    def rpc_report_goodput(self, req: comm.GoodputReport) -> comm.Response:
+        """A full ledger snapshot off the step cadence (process exit
+        sends final=True, closing the incarnation in the aggregator)."""
+        if self._goodput is not None and req.goodput_phases:
+            self._goodput.observe_report(
+                node_id=req.node_id, pid=req.pid,
+                start_ts=req.goodput_start_ts,
+                elapsed_s=req.goodput_elapsed_s,
+                phases=req.goodput_phases,
+                phase=req.goodput_phase,
+                host=req.host, final=req.final,
+            )
         return comm.Response(success=True)
 
     def rpc_report_model_info(self, req: comm.ModelInfo) -> comm.Response:
@@ -449,6 +473,7 @@ def create_master_service(
     job_metric_collector=None,
     auto_scaler=None,
     kv_store=None,
+    goodput_aggregator=None,
 ):
     """Build the gRPC server around a MasterServicer
     (parity: servicer.py:478)."""
@@ -462,6 +487,7 @@ def create_master_service(
         job_metric_collector=job_metric_collector,
         auto_scaler=auto_scaler,
         kv_store=kv_store,
+        goodput_aggregator=goodput_aggregator,
     )
     server = GenericRpcServer(servicer.handle, port=port)
     return server, servicer

@@ -19,6 +19,7 @@ Layout under the state dir (one JSON file per key):
     master/<job>/rdzv/<name>          {"round": n}
     master/<job>/rdzv_params/<name>   {"min_nodes": ..., "max_nodes": ...}
     master/<job>/speed                {"step": n, "batch_feed": bool}
+    master/<job>/goodput              goodput aggregator ledger checkpoint
 
 Enabled by ``DLROVER_TPU_MASTER_STATE_DIR`` (or ``--state_dir``); off by
 default. ``--fresh`` wipes the job's prior state instead of restoring.
@@ -155,6 +156,19 @@ class MasterStateJournal:
     def load_global_step(self) -> Tuple[int, bool]:
         value = self._store.get(self._key("speed")) or {}
         return int(value.get("step", 0)), bool(value.get("batch_feed"))
+
+    # -------------------------------------------------------------- goodput
+
+    def save_goodput(self, state: dict):
+        """The goodput aggregator's ledger checkpoint
+        (telemetry/goodput.py to_state()): per-incarnation phase
+        totals + fault windows. Restoring it after a master kill keeps
+        MTTR/MTBF honest across the restart — the persist gap itself
+        becomes the master's own fault window."""
+        self._store.set(self._key("goodput"), state)
+
+    def load_goodput(self) -> Optional[dict]:
+        return self._store.get(self._key("goodput"))
 
 
 def build_master_state_journal(

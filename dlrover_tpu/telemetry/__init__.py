@@ -17,6 +17,10 @@ Three pieces, one substrate (ISSUE 2):
   * :mod:`~dlrover_tpu.telemetry.flight_recorder` — crash-dump capture
     (all-thread stacks, span tail, journal tail, metrics snapshot) on
     hangs and fatal signals (ISSUE 4);
+  * :mod:`~dlrover_tpu.telemetry.goodput` — the goodput ledger
+    (ISSUE 7): per-process phase state machine, job-level goodput %/
+    badput-by-cause/MTTR/MTBF aggregation, ``/goodput`` + ``dump
+    --goodput`` exposure;
   * ``python -m dlrover_tpu.telemetry.dump`` renders a journal into a
     human-readable timeline (``--trace`` merges per-process span files
     into one Chrome trace).

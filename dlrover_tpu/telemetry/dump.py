@@ -7,6 +7,15 @@ stalls stand out. ``--kind`` filters (prefix match on dotted kinds),
 ``--json`` re-emits the ordered events as JSONL (for piping into jq
 after the multi-process sort).
 
+``--goodput`` switches modes: instead of the raw timeline, the journal
+is replayed through the goodput reconstruction
+(telemetry/goodput.py) into the job-wide time-attribution report —
+goodput %, badput by cause, fault windows with MTTR/MTBF, and one
+phase breakdown per process. Works on any journal file: runs that
+carried the live ledger replay exactly from their ``goodput.*``
+breadcrumbs; older journals fall back to deriving phases from the
+generic events. ``--json`` emits the report as JSON.
+
 ``--trace`` switches modes: the path is a trace directory written by
 span tracing (``DLROVER_TPU_TRACE_DIR`` — one ``spans-<host>-<pid>.
 jsonl`` per process) and the output is ONE merged Chrome trace-event
@@ -20,6 +29,13 @@ Example::
     2026-08-04 10:00:43.910 +42.708s [host-0 p0] checkpoint.save     tier=ram step=100 ms=18.2
 
     $ python -m dlrover_tpu.telemetry.dump /tmp/job-trace --trace -o merged.json
+
+    $ python -m dlrover_tpu.telemetry.dump /tmp/job.journal --goodput
+    == goodput ==
+    wall 58.2s over 2 node(s), 3 process(es)
+    goodput 87.3%  (training 50.8s)  attributed 99.6%
+    badput  rendezvous=2.1s ckpt_stall=0.9s restart=4.2s
+    faults 2  MTTR 2.6s  MTBF 29.1s
 """
 
 import argparse
@@ -114,6 +130,11 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit ordered JSONL instead of the timeline")
     ap.add_argument(
+        "--goodput", action="store_true", dest="as_goodput",
+        help="replay the journal into the goodput/badput/MTTR report "
+        "instead of the raw timeline (honors --json)",
+    )
+    ap.add_argument(
         "--trace", action="store_true", dest="as_trace",
         help="merge per-process span files into one Chrome "
         "trace-event JSON (chrome://tracing / Perfetto)",
@@ -131,6 +152,12 @@ def main(argv=None) -> int:
     except OSError as e:
         print(f"cannot read {args.journal}: {e}", file=sys.stderr)
         return 2
+    if args.as_goodput:
+        from dlrover_tpu.telemetry.goodput import dump_goodput
+
+        print(dump_goodput(events, as_json=args.as_json))
+        print(f"-- {len(events)} events replayed", file=sys.stderr)
+        return 0
     out = render(events, kind=args.kind, as_json=args.as_json)
     if out:
         print(out)

@@ -160,6 +160,14 @@ def dump_flight_record(reason: str,
             record["metrics"] = registry_mod.default_registry().to_dict()
         except Exception as e:
             record["metrics"] = {"error": str(e)}
+        try:
+            # what phase the job died in (telemetry/goodput.py);
+            # None when no ledger was armed in this process
+            from dlrover_tpu.telemetry import goodput
+
+            record["goodput"] = goodput.local_snapshot()
+        except Exception as e:
+            record["goodput"] = {"error": str(e)}
         with open(os.path.join(out, "record.json"), "w") as f:
             json.dump(record, f, default=str, indent=1)
         with open(os.path.join(out, "stacks.txt"), "w") as f:

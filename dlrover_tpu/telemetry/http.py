@@ -5,8 +5,16 @@ hitting every host). Routes:
 
   * ``GET /metrics``  — Prometheus text exposition of the registry;
   * ``GET /metrics.json`` — the same snapshot as JSON (tests/bench);
-  * ``GET /journal``  — the in-memory tail of the event journal
-    (``?n=50`` bounds it; ``?kind=checkpoint`` filters by kind prefix);
+  * ``GET /journal``  — a bounded tail of the event journal. Default
+    view is the in-memory ring (``?n=50`` bounds it, clamped to the
+    ring capacity; ``?kind=checkpoint`` filters by kind prefix);
+    ``?source=file`` tails the backing JSONL file instead — last ``n``
+    lines, reading at most 256 KiB from the end — so long runs never
+    stream an unbounded journal through the endpoint;
+  * ``GET /goodput`` — the goodput ledger (telemetry/goodput.py): the
+    local process's phase snapshot, plus the job-level aggregation
+    (goodput %, badput by cause, MTTR/MTBF) when this process is the
+    master;
   * ``GET /healthz``  — liveness probe. With a hang detector attached
     (:func:`attach_hang_detector`) a stalled training loop turns the
     probe into 503 + ``{"status": "degraded", "stalled_for": ...}`` so
@@ -98,6 +106,46 @@ def _current_health():
         return None
 
 
+# /journal response bounds: never more than this many events, and the
+# file-tail mode reads at most this many bytes from the end of the
+# JSONL file (a long run's journal grows without limit; the endpoint
+# must not)
+_JOURNAL_TAIL_MAX = 4096
+_FILE_TAIL_BYTES = 256 * 1024
+
+
+def _tail_journal_file(path, n, kind=None):
+    """Last ``n`` parsed events from the end of a JSONL journal file,
+    reading at most ``_FILE_TAIL_BYTES``. Never raises."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - _FILE_TAIL_BYTES))
+            chunk = f.read(_FILE_TAIL_BYTES)
+    except OSError:
+        return []
+    lines = chunk.split(b"\n")
+    if size > _FILE_TAIL_BYTES and lines:
+        lines = lines[1:]  # first line is almost surely torn mid-record
+    events = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            continue
+    if kind:
+        events = [
+            e for e in events
+            if e.get("kind") == kind
+            or str(e.get("kind", "")).startswith(kind + ".")
+        ]
+    return events[-n:]
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dlrover-tpu-telemetry/1"
 
@@ -131,9 +179,27 @@ class _Handler(BaseHTTPRequestHandler):
                 n = int((q.get("n") or ["100"])[0])
             except ValueError:
                 n = 100
-            events = jr.events(kind)[-max(0, n):] if jr else []
+            # hard tail bound: the response can never exceed the ring
+            # capacity (or _FILE_TAIL_BYTES in file mode), however
+            # large ?n= is or however long the run has journaled
+            n = max(0, min(n, _JOURNAL_TAIL_MAX))
+            source = (q.get("source") or ["ring"])[0]
+            if source == "file" and jr is not None and jr.path:
+                events = _tail_journal_file(jr.path, n, kind)
+            else:
+                events = jr.events(kind)[-n:] if jr else []
             self._send(
                 200, json.dumps(events, default=str).encode(),
+                "application/json",
+            )
+        elif url.path == "/goodput":
+            from dlrover_tpu.telemetry import goodput
+
+            self._send(
+                200,
+                json.dumps(
+                    goodput.http_payload(), default=str
+                ).encode(),
                 "application/json",
             )
         elif url.path == "/healthz":

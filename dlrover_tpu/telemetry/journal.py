@@ -50,7 +50,46 @@ __all__ = [
     "configure",
     "record",
     "read_journal",
+    "add_tap",
+    "remove_tap",
 ]
+
+# ------------------------------------------------------------------- taps
+#
+# Module-level observers invoked for every event recorded in this
+# process (any journal instance — taps must survive the test-time
+# set_default_journal swaps). The goodput ledger derives its phase
+# transitions from events that already fire by tapping here instead of
+# adding instrumentation points. Taps run OUTSIDE the journal lock, so
+# a tap may itself record() (e.g. a phase-transition breadcrumb)
+# without deadlocking; tap exceptions are swallowed — observation must
+# never take the instrumented path down.
+
+_taps_lock = threading.Lock()
+_taps: List[Any] = []
+
+
+def add_tap(fn) -> None:
+    """Register ``fn(event_dict)`` to observe every recorded event."""
+    with _taps_lock:
+        if fn not in _taps:
+            _taps.append(fn)
+
+
+def remove_tap(fn) -> None:
+    with _taps_lock:
+        if fn in _taps:
+            _taps.remove(fn)
+
+
+def _notify_taps(event: Dict[str, Any]) -> None:
+    with _taps_lock:
+        taps = list(_taps)
+    for fn in taps:
+        try:
+            fn(event)
+        except Exception as e:
+            logger.warning("journal tap failed: %s", e)
 
 
 class EventJournal:
@@ -107,6 +146,7 @@ class EventJournal:
                     except OSError:
                         pass
                     self._fd = None
+        _notify_taps(event)
         return event
 
     def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:

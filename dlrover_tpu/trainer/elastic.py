@@ -112,6 +112,12 @@ class ElasticTrainer:
         self._fault_injector = None
         self._created_ts = time.monotonic()
         self._first_step_seen = False
+        # per-process goodput ledger (telemetry/goodput.py): phase
+        # transitions ride on events that already fire; the trainer
+        # only marks steps (-> training) and checkpoint stalls
+        from dlrover_tpu.telemetry import goodput
+
+        self._goodput = goodput.install()
         self._init_fault_tolerance(hang_detection)
         self.set_world(cur_nodes)
 
@@ -286,6 +292,9 @@ class ElasticTrainer:
                 )
             except Exception as e:  # telemetry never stops training
                 logger.warning("compile-cache telemetry failed: %s", e)
+        # a completed step is the proof of useful work: it opens the
+        # training phase and closes any hang/restart window
+        self._goodput.on_step()
         if self._hang_detector is not None:
             self._hang_detector.record_step(self._global_step)
         if self._trace_capture is not None:
@@ -340,9 +349,16 @@ class ElasticTrainer:
         if not due:
             return None
         try:
-            return self._checkpointer.save(
+            stall_ms = self._checkpointer.save(
                 step, state, force_persist=force
             )
+            if stall_ms:
+                # the measured train-thread stall re-labels the tail
+                # of the current training interval as ckpt_stall
+                from dlrover_tpu.telemetry.goodput import Phase
+
+                self._goodput.credit(Phase.CKPT_STALL, stall_ms / 1000.0)
+            return stall_ms
         except Exception as e:  # checkpointing never stops training
             logger.warning("flash save at step %d failed: %s", step, e)
             return None

@@ -1,0 +1,148 @@
+"""Drill worker for the goodput chaos test (not a test module).
+
+Speaks the real agent protocol against a live master with a live
+goodput ledger armed: joins the training rendezvous (journaling the
+``rendezvous.joined`` the tap turns into a phase credit), consumes
+data shards while marking ``training`` per step and crediting a
+simulated ``ckpt_stall``, and reports the global step — each report
+piggybacks the ledger snapshot, which is what the master's
+GoodputAggregator folds into the job account.
+
+Fault surface: the real FaultInjector (``DLROVER_FAULT_INJECT`` in the
+env, e.g. ``crash@6`` for worker 0's first incarnation — the relaunch
+sets RESTART_COUNT=1 so it doesn't refire) journals ``fault.injected``
+and dies rc 17 without closing the ledger, exercising the
+died-without-goodbye accounting; the master kill mid-run is observed
+through ``agent.master_lost`` / ``agent.master_reconnected``, which
+the tap turns into a ``restart`` phase window.
+
+On a clean finish the worker closes its ledger (``goodput.snapshot``
+ground truth in the journal) and pushes one ``report_goodput
+(final=True)`` so the master closes the incarnation, then emits DONE.
+"""
+
+import argparse
+import sys
+import threading
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master_addr", required=True)
+    p.add_argument("--node_id", type=int, required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--dataset_size", type=int, default=96)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--shard_secs", type=float, default=0.08,
+                   help="simulated train time per shard")
+    args = p.parse_args()
+
+    # envelope `proc` = node id BEFORE any journal write, so the offline
+    # reconstruction groups this process under the same node identity
+    # the master aggregates it as
+    from dlrover_tpu.common.log import set_process_index
+
+    set_process_index(args.node_id)
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.sharding.client import ShardingClient
+    from dlrover_tpu.common.constants import RendezvousName
+    from dlrover_tpu.fault_tolerance.injection import FaultInjector
+    from dlrover_tpu.telemetry import goodput
+    from dlrover_tpu.telemetry import record
+    from dlrover_tpu.telemetry.goodput import Phase
+
+    led = goodput.install()
+
+    out = open(args.out, "a", buffering=1)
+
+    def emit(line: str):
+        out.write(line + "\n")
+        print(f"[worker {args.node_id}] {line}", flush=True)
+
+    client = MasterClient(
+        args.master_addr, node_id=args.node_id, node_type="worker",
+    )
+    reconnected = threading.Event()
+    client.add_reconnect_hook("drill-flag", reconnected.set)
+    injector = FaultInjector.from_env(role="worker")
+
+    def rendezvous(tag: str) -> int:
+        reconnected.clear()
+        client.join_rendezvous(args.node_id, 1)
+        deadline = time.monotonic() + 60
+        while True:
+            if reconnected.is_set():
+                # our waiting-set entry may have died with the old
+                # master (join landed just before the kill): re-join so
+                # the restarted master can complete the round
+                reconnected.clear()
+                client.join_rendezvous(args.node_id, 1)
+            rdzv_round, _, world = client.get_comm_world(
+                RendezvousName.TRAINING, args.node_id
+            )
+            if world and args.node_id in world:
+                # the event the agent records at this point in a real
+                # run — the goodput tap credits the wait as rendezvous
+                record("rendezvous.joined", round=rdzv_round,
+                       node=args.node_id)
+                emit(f"{tag} {rdzv_round}")
+                return rdzv_round
+            if time.monotonic() > deadline:
+                emit(f"ERROR {tag} timeout")
+                raise TimeoutError(tag)
+            time.sleep(0.2)
+
+    # min_nodes=1: the relaunched incarnation re-joins alone mid-epoch
+    # (its peer is busy consuming), and the round must still complete
+    client.report_rdzv_params(
+        min_nodes=1, max_nodes=2, waiting_timeout=0.5, node_unit=1,
+    )
+    rendezvous("ROUND")
+
+    sharding = ShardingClient(
+        dataset_name="goodput-drill",
+        batch_size=args.batch_size,
+        num_epochs=1,
+        dataset_size=args.dataset_size,
+        shuffle=False,
+        num_minibatches_per_shard=1,
+        master_client=client,
+    )
+    step = 0
+    while True:
+        shard = sharding.fetch_shard(poll_interval=0.2, max_wait=120.0)
+        if shard is None:
+            break
+        emit(f"SHARD {shard.start} {shard.end}")
+        time.sleep(args.shard_secs)
+        step += 1
+        led.on_step()
+        if step % 4 == 0:
+            # a simulated checkpoint stall: re-label the trailing 20ms
+            led.credit(Phase.CKPT_STALL, 0.02)
+        # the report carries the ledger snapshot; the master-side fault
+        # injector also counts these (master_crash@N)
+        client.report_global_step(step)
+        assert sharding._current_task is not None
+        sharding.report_task_done(sharding._current_task.task_id)
+        if injector is not None:
+            # worker-side faults (crash@N) fire here: fault.injected is
+            # journaled, the tap marks `restart`, then os._exit(17) —
+            # the ledger never closes, which is the point
+            injector.maybe_inject(step)
+
+    emit(f"STEPS {step}")
+    # close first (freezes totals + journals goodput.snapshot), THEN
+    # report: the master's final observation equals the journal's
+    snap = led.close()
+    client.report_goodput(final=True)
+    emit(f"ELAPSED {snap['elapsed_s']:.3f}")
+    emit("DONE")
+    client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

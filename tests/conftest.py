@@ -55,6 +55,7 @@ DRILL_MODULES = {
     "test_e2e_elastic_run",
     "test_operator",
     "test_four_node_drill",
+    "test_goodput_drill",
     "test_slice_soak_drill",
     "test_scale_up_drill",
     "test_streaming_e2e",

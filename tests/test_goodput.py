@@ -1,0 +1,584 @@
+"""Unit tests for the goodput ledger (telemetry/goodput.py).
+
+Covers the per-process ``PhaseLedger`` invariants (phases sum to
+elapsed by construction, credit clamping, freeze-on-close), the
+journal-tap rules that derive phases from events that already fire,
+the master-side ``GoodputAggregator`` (incarnation gaps -> restart
+badput, MTTR/MTBF, state-journal round-trip across a master kill),
+the offline reconstruction (exact breadcrumb replay and the
+pre-ledger heuristic), the ``/goodput`` and bounded ``/journal`` HTTP
+surfaces, the wire messages, and the resource monitor's HBM gauges +
+peak events. The end-to-end chaos path lives in test_goodput_drill.py.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from dlrover_tpu import telemetry as T
+from dlrover_tpu.common import comm
+from dlrover_tpu.telemetry import goodput
+from dlrover_tpu.telemetry.goodput import (
+    BADPUT_CAUSES,
+    PHASES,
+    GoodputAggregator,
+    Phase,
+    PhaseLedger,
+)
+from dlrover_tpu.telemetry.http import MetricsServer
+from dlrover_tpu.telemetry.journal import EventJournal
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def fresh_defaults():
+    # the agent/trainer arm the process-wide ledger via install();
+    # drop it (and its journal tap) around every test, plus a fresh
+    # registry + in-memory journal so nothing leaks across tests
+    goodput.reset_default_ledger()
+    goodput.set_job_provider(None)
+    reg = T.set_default_registry(None)
+    jr = T.set_default_journal(EventJournal(None))
+    yield reg, jr
+    goodput.reset_default_ledger()
+    goodput.set_job_provider(None)
+    T.set_default_registry(None)
+    T.set_default_journal(EventJournal(None))
+
+
+def _phases(**kw):
+    out = {p: 0.0 for p in PHASES}
+    out.update(kw)
+    return out
+
+
+def _ev(kind, ts, pid, host="hostA", proc=None, **data):
+    return {"seq": 0, "ts": ts, "host": host, "pid": pid,
+            "proc": proc, "kind": kind, "data": data}
+
+
+def _get(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.read().decode()
+
+
+# ------------------------------------------------------------ PhaseLedger
+
+
+def test_ledger_phases_sum_to_elapsed():
+    led = PhaseLedger(start_ts=T0, journal_events=False)
+    led.transition(Phase.RENDEZVOUS, ts=T0 + 2)    # 2s init
+    led.transition(Phase.TRAINING, ts=T0 + 5)      # 3s rendezvous
+    led.credit(Phase.CKPT_STALL, 1.0, ts=T0 + 9)   # 4s: 3 train + 1 stall
+    snap = led.snapshot(now=T0 + 10)               # 1s more training
+    assert snap["elapsed_s"] == pytest.approx(10.0)
+    assert snap["phases"][Phase.INIT] == pytest.approx(2.0)
+    assert snap["phases"][Phase.RENDEZVOUS] == pytest.approx(3.0)
+    assert snap["phases"][Phase.TRAINING] == pytest.approx(4.0)
+    assert snap["phases"][Phase.CKPT_STALL] == pytest.approx(1.0)
+    assert sum(snap["phases"].values()) == pytest.approx(
+        snap["elapsed_s"]
+    )
+    assert snap["goodput_percent"] == pytest.approx(40.0)
+    assert snap["attributed_percent"] == pytest.approx(100.0)
+
+
+def test_ledger_rejects_unknown_phase():
+    with pytest.raises(ValueError):
+        PhaseLedger(phase="warmup")
+    led = PhaseLedger(start_ts=T0, journal_events=False)
+    with pytest.raises(ValueError):
+        led.transition("warmup")
+    with pytest.raises(ValueError):
+        led.credit("warmup", 1.0)
+
+
+def test_credit_clamps_to_open_interval():
+    # time can only be re-labeled, never invented
+    led = PhaseLedger(start_ts=T0, phase=Phase.TRAINING,
+                      journal_events=False)
+    credited = led.credit(Phase.CKPT_STALL, 100.0, ts=T0 + 2)
+    assert credited == pytest.approx(2.0)
+    totals = led.totals(now=T0 + 2)
+    assert totals[Phase.CKPT_STALL] == pytest.approx(2.0)
+    assert totals[Phase.TRAINING] == pytest.approx(0.0)
+
+
+def test_resume_returns_to_interrupted_phase():
+    led = PhaseLedger(start_ts=T0, phase=Phase.TRAINING,
+                      journal_events=False)
+    led.transition(Phase.RESTART, ts=T0 + 4)
+    # fault-to-fault keeps the original resume target
+    led.transition(Phase.HANG, ts=T0 + 5)
+    led.resume(ts=T0 + 7)
+    assert led.phase == Phase.TRAINING
+    totals = led.totals(now=T0 + 8)
+    assert totals[Phase.TRAINING] == pytest.approx(5.0)
+    assert totals[Phase.RESTART] == pytest.approx(1.0)
+    assert totals[Phase.HANG] == pytest.approx(2.0)
+
+
+def test_close_freezes_ledger():
+    led = PhaseLedger(start_ts=T0, phase=Phase.TRAINING,
+                      journal_events=False)
+    snap = led.close(ts=T0 + 5)
+    assert snap["elapsed_s"] == pytest.approx(5.0)
+    # mutations after close are no-ops, and elapsed stops growing:
+    # the journaled snapshot stays the truth forever
+    led.transition(Phase.IDLE, ts=T0 + 50)
+    assert led.credit(Phase.HANG, 1.0, ts=T0 + 60) == 0.0
+    later = led.snapshot(now=T0 + 100)
+    assert later["elapsed_s"] == pytest.approx(5.0)
+    assert later["phases"] == snap["phases"]
+
+
+def test_on_step_enters_training():
+    led = PhaseLedger(start_ts=T0, journal_events=False)
+    led.on_step()
+    assert led.phase == Phase.TRAINING
+
+
+# ----------------------------------------------------------- event rules
+
+
+def test_hang_rule_relabels_stall_window():
+    led = PhaseLedger(start_ts=T0, phase=Phase.TRAINING,
+                      journal_events=False)
+    goodput.EVENT_RULES["hang.detected"](
+        led, T0 + 10.0, {"stalled_for": 4.0}
+    )
+    totals = led.totals(now=T0 + 10)
+    assert totals[Phase.TRAINING] == pytest.approx(6.0)
+    assert totals[Phase.HANG] == pytest.approx(4.0)
+    assert led.phase == Phase.HANG
+
+
+def test_rendezvous_join_credits_wait():
+    led = PhaseLedger(start_ts=T0, journal_events=False)
+    goodput.EVENT_RULES["rendezvous.joined"](led, T0 + 3.0, {})
+    totals = led.totals(now=T0 + 3)
+    assert totals[Phase.RENDEZVOUS] == pytest.approx(3.0)
+    # what follows (worker spawn, compile) is init again
+    assert led.phase == Phase.INIT
+
+
+def test_install_taps_existing_journal_events():
+    led = goodput.install()
+    assert goodput.install() is led  # idempotent
+    T.record("hang.detected", stalled_for=0.0)
+    assert led.phase == Phase.HANG
+    T.record("agent.master_lost")
+    assert led.phase == Phase.RESTART
+    T.record("agent.master_reconnected")
+    # resume returns to what the fault interrupted, not to the fault
+    assert led.phase == Phase.INIT
+    # the tap journals breadcrumbs (birth + transitions) and must not
+    # recurse on its own goodput.* events
+    kinds = [e["kind"] for e in T.default_journal().events("goodput")]
+    assert kinds.count("goodput.phase") >= 3
+
+
+def test_report_fields_empty_without_ledger():
+    assert goodput.report_fields() == {}
+    assert goodput.local_snapshot() is None
+
+
+def test_report_fields_carries_snapshot():
+    goodput.install()
+    fields = goodput.report_fields()
+    assert set(fields) == {
+        "goodput_phases", "goodput_elapsed_s",
+        "goodput_start_ts", "goodput_phase",
+    }
+    assert fields["goodput_phase"] == Phase.INIT
+    assert set(fields["goodput_phases"]) == set(PHASES)
+
+
+# ------------------------------------------------------------- aggregator
+
+
+def test_aggregator_incarnation_gap_is_restart_badput():
+    agg = GoodputAggregator()
+    agg.observe_report(
+        node_id=0, pid=100, start_ts=T0, elapsed_s=10.0,
+        phases=_phases(training=8.0, init=2.0), ts=T0 + 10,
+    )
+    # a successor incarnation appears 3s after the first stopped
+    # ledgering and the first never said goodbye: it died
+    agg.observe_report(
+        node_id=0, pid=200, start_ts=T0 + 13.0, elapsed_s=7.0,
+        phases=_phases(training=6.0, init=1.0), ts=T0 + 20,
+    )
+    s = agg.summary()
+    job = s["job"]
+    assert job["procs"] == 2 and job["nodes"] == 1
+    assert s["nodes"]["0"]["restart_gap_s"] == pytest.approx(3.0)
+    assert job["badput_s"][Phase.RESTART] == pytest.approx(3.0)
+    assert job["wall_s"] == pytest.approx(20.0)
+    assert job["training_s"] == pytest.approx(14.0)
+    restarts = [f for f in s["faults"] if f["cause"] == "worker_restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["ts"] == pytest.approx(T0 + 10.0)
+    assert restarts[0]["recovered_ts"] == pytest.approx(T0 + 13.0)
+    assert job["mttr_s"] == pytest.approx(3.0)
+    assert job["mtbf_s"] == pytest.approx(20.0)
+
+
+def test_aggregator_final_report_closes_incarnation():
+    agg = GoodputAggregator()
+    agg.observe_report(
+        node_id=1, pid=100, start_ts=T0, elapsed_s=5.0,
+        phases=_phases(training=5.0), final=True, ts=T0 + 5,
+    )
+    # a clean goodbye means the successor is a planned relaunch, not
+    # a detected death: no fault window
+    agg.observe_report(
+        node_id=1, pid=200, start_ts=T0 + 6.0, elapsed_s=4.0,
+        phases=_phases(training=4.0), ts=T0 + 10,
+    )
+    assert agg.summary()["job"]["faults"] == 0
+
+
+def test_aggregator_state_roundtrip_counts_master_downtime(tmp_path):
+    from dlrover_tpu.master.state_journal import (
+        build_master_state_journal,
+    )
+
+    agg = GoodputAggregator()
+    agg.observe_report(
+        node_id=0, pid=1, start_ts=T0, elapsed_s=5.0,
+        phases=_phases(training=5.0), ts=T0 + 5,
+    )
+    journal = build_master_state_journal(
+        "gp-test", state_dir=str(tmp_path)
+    )
+    journal.save_goodput(agg.to_state())
+    loaded = build_master_state_journal(
+        "gp-test", state_dir=str(tmp_path)
+    ).load_goodput()
+    assert set(loaded["procs"]) == {"0:1"}
+    agg2 = GoodputAggregator()
+    agg2.restore_state(loaded, now=loaded["saved_at"] + 4.0)
+    s = agg2.summary()
+    # the persist gap is the master's own downtime: an already
+    # recovered fault window feeding MTTR/MTBF
+    master = [f for f in s["faults"] if f["cause"] == "master_restart"]
+    assert len(master) == 1
+    assert (master[0]["recovered_ts"] - master[0]["ts"]
+            == pytest.approx(4.0))
+    assert s["job"]["procs"] == 1
+    assert s["job"]["training_s"] == pytest.approx(5.0)
+
+
+def test_aggregator_persist_rate_limited():
+    saved = []
+    agg = GoodputAggregator(persist_fn=saved.append,
+                            persist_interval=10.0)
+    for i in range(5):
+        agg.observe_report(
+            node_id=0, pid=1, start_ts=T0, elapsed_s=float(i + 1),
+            phases=_phases(training=float(i + 1)), ts=T0 + 100 + i,
+        )
+    assert len(saved) == 1
+    assert set(saved[0]) == {"saved_at", "job_start", "procs", "faults"}
+
+
+def test_aggregator_never_raises_on_garbage():
+    agg = GoodputAggregator()
+    agg.observe_report(node_id=0, pid=1, start_ts=0.0, elapsed_s=1.0,
+                       phases={})  # no phases: dropped
+    agg.observe_report(node_id="x", pid="y", start_ts="z",
+                       elapsed_s=None, phases={"training": "?"})
+    assert agg.summary()["job"]["procs"] == 0
+
+
+# ------------------------------------------------------- reconstruction
+
+
+def test_reconstruct_exact_replays_breadcrumbs():
+    events = [
+        _ev("goodput.phase", T0, 10, proc=0,
+            phase=Phase.INIT, prev="", at=T0),
+        _ev("goodput.phase", T0 + 2, 10, proc=0,
+            phase=Phase.TRAINING, prev=Phase.INIT, at=T0 + 2),
+        _ev("goodput.credit", T0 + 6, 10, proc=0,
+            phase=Phase.CKPT_STALL, credit_s=1.0, at=T0 + 6),
+        _ev("goodput.snapshot", T0 + 8, 10, proc=0,
+            phase=Phase.TRAINING, start_ts=T0, elapsed_s=8.0,
+            phases={Phase.INIT: 2.0, Phase.TRAINING: 5.0,
+                    Phase.CKPT_STALL: 1.0}),
+    ]
+    report = goodput.reconstruct(events)
+    proc = report["procs"]["hostA:10"]
+    assert proc["exact"] and proc["final_seen"]
+    assert proc["node_id"] == 0
+    assert proc["elapsed_s"] == pytest.approx(8.0)
+    assert proc["phases"][Phase.TRAINING] == pytest.approx(5.0)
+    assert proc["phases"][Phase.CKPT_STALL] == pytest.approx(1.0)
+    assert report["job"]["goodput_percent"] == pytest.approx(62.5)
+    assert report["job"]["attributed_percent"] == pytest.approx(100.0)
+
+
+def test_reconstruct_heuristic_pre_ledger_journal():
+    # no goodput.* breadcrumbs anywhere: the fallback derives phases
+    # from the generic events via the same rules the live tap applies
+    events = [
+        _ev("distributed.init", T0, 20, proc=1),
+        _ev("rendezvous.joined", T0 + 3, 20, proc=1, round=0),
+        _ev("checkpoint.save", T0 + 9, 20, proc=1,
+            step=10, stall_ms=500.0),
+        _ev("hang.detected", T0 + 15, 20, proc=1, stalled_for=2.0),
+    ]
+    report = goodput.reconstruct(events)
+    proc = report["procs"]["hostA:20"]
+    assert not proc["exact"]
+    phases = proc["phases"]
+    assert phases[Phase.RENDEZVOUS] == pytest.approx(3.0)
+    assert phases[Phase.CKPT_STALL] == pytest.approx(0.5)
+    assert phases[Phase.TRAINING] == pytest.approx(4.0)
+    assert phases[Phase.HANG] == pytest.approx(2.0)
+    assert proc["elapsed_s"] == pytest.approx(15.0)
+    assert sum(phases.values()) == pytest.approx(proc["elapsed_s"])
+
+
+def test_reconstruct_fault_windows_and_master_exclusion():
+    events = [
+        _ev("rendezvous.joined", T0 + 1, 30, proc=2, round=0),
+        _ev("fault.injected", T0 + 5, 30, proc=2,
+            fault="crash", step=4),
+        # the successor incarnation's first event proves recovery
+        _ev("rendezvous.joined", T0 + 9, 31, proc=2, round=1),
+        _ev("fault.injected", T0 + 12, 40, host="master",
+            fault="master_crash", step=8),
+        _ev("master.restored", T0 + 14, 41, host="master"),
+    ]
+    report = goodput.reconstruct(events)
+    # the master's own process must never look like a training node
+    assert set(report["procs"]) == {"hostA:30", "hostA:31"}
+    by_cause = {f["cause"]: f for f in report["faults"]}
+    assert by_cause["crash"]["ts"] == pytest.approx(T0 + 5)
+    assert by_cause["crash"]["recovered_ts"] == pytest.approx(T0 + 9)
+    assert by_cause["master_crash"]["recovered_ts"] == pytest.approx(
+        T0 + 14
+    )
+    assert report["job"]["mttr_s"] == pytest.approx(3.0)
+    assert report["job"]["mtbf_s"] is not None
+
+
+def test_reconstruct_empty_and_irrelevant_events():
+    assert goodput.reconstruct([])["job"]["procs"] == 0
+    # a process with nothing phase-relevant contributes no ledger
+    report = goodput.reconstruct(
+        [_ev("scale.plan", T0, 50, nodes=4)]
+    )
+    assert report["job"]["procs"] == 0
+
+
+# ------------------------------------------------------------------ wire
+
+
+def test_goodput_wire_messages_roundtrip():
+    step = comm.GlobalStep(
+        node_id=0, step=5, timestamp=T0 + 5, pid=111,
+        goodput_phases=_phases(training=4.0, init=1.0),
+        goodput_elapsed_s=5.0, goodput_start_ts=T0,
+        goodput_phase=Phase.TRAINING,
+    )
+    assert comm.deserialize(step.serialize()) == step
+    rep = comm.GoodputReport(
+        node_id=1, pid=222, host="h", final=True,
+        goodput_phases=_phases(training=6.0),
+        goodput_elapsed_s=6.0, goodput_start_ts=T0,
+        goodput_phase=Phase.IDLE,
+    )
+    assert comm.deserialize(rep.serialize()) == rep
+
+
+def test_servicer_feeds_goodput_aggregator():
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    agg = GoodputAggregator()
+    svc = MasterServicer(goodput_aggregator=agg)
+    step = comm.GlobalStep(
+        node_id=0, step=5, timestamp=T0 + 5, pid=111,
+        goodput_phases=_phases(training=4.0, init=1.0),
+        goodput_elapsed_s=5.0, goodput_start_ts=T0,
+        goodput_phase=Phase.TRAINING,
+    )
+    assert svc.handle(
+        "report_global_step", comm.deserialize(step.serialize())
+    ).success
+    final = comm.GoodputReport(
+        node_id=0, pid=111, host="h", final=True,
+        goodput_phases=_phases(training=6.0, init=1.0),
+        goodput_elapsed_s=7.0, goodput_start_ts=T0,
+        goodput_phase=Phase.IDLE,
+    )
+    assert svc.handle(
+        "report_goodput", comm.deserialize(final.serialize())
+    ).success
+    s = agg.summary()
+    assert s["job"]["procs"] == 1
+    # the final report superseded the step piggyback
+    assert s["job"]["training_s"] == pytest.approx(6.0)
+    # a stepless report (no ledger armed) must not create a proc
+    svc.handle("report_global_step",
+               comm.GlobalStep(node_id=2, step=1, timestamp=T0))
+    assert agg.summary()["job"]["procs"] == 1
+
+
+# ------------------------------------------------------------------ HTTP
+
+
+def test_http_goodput_endpoint(fresh_defaults):
+    reg, jr = fresh_defaults
+    goodput.install()
+    goodput.set_job_provider(
+        lambda: {"job": {"goodput_percent": 42.0}}
+    )
+    srv = MetricsServer(registry=reg, journal=jr, host="127.0.0.1")
+    srv.start()
+    try:
+        payload = json.loads(
+            _get(f"http://127.0.0.1:{srv.port}/goodput")
+        )
+    finally:
+        srv.stop()
+    assert payload["local"]["phase"] == Phase.INIT
+    assert set(payload["local"]["phases"]) == set(PHASES)
+    assert payload["job"]["goodput_percent"] == 42.0
+
+
+def test_http_journal_tail_is_bounded(tmp_path, fresh_defaults):
+    reg, _ = fresh_defaults
+    jr = T.set_default_journal(
+        EventJournal(str(tmp_path / "journal.jsonl"))
+    )
+    for i in range(50):
+        jr.record("drill.tick", i=i)
+    srv = MetricsServer(registry=reg, journal=jr, host="127.0.0.1")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        ring = json.loads(_get(base + "/journal?n=5"))
+        assert len(ring) == 5
+        assert ring[-1]["data"]["i"] == 49
+        tail = json.loads(_get(base + "/journal?n=7&source=file"))
+        assert len(tail) == 7
+        assert tail[-1]["data"]["i"] == 49
+        # an absurd ?n= clamps server-side instead of streaming the
+        # whole journal back
+        clamped = json.loads(_get(base + "/journal?n=99999999"))
+        assert len(clamped) == 50
+        kinds = json.loads(
+            _get(base + "/journal?source=file&kind=drill")
+        )
+        assert kinds and all(
+            e["kind"].startswith("drill") for e in kinds
+        )
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------ resource monitor
+
+
+def test_resource_monitor_gauges_and_hbm_peak(monkeypatch,
+                                              fresh_defaults):
+    from dlrover_tpu.agent.monitor import resource as res
+
+    reg, jr = fresh_defaults
+
+    class FakeClient:
+        def __init__(self):
+            self.reports = []
+
+        def report_used_resource(self, cpu, mem, tpu):
+            self.reports.append((cpu, mem, tpu))
+
+    samples = iter([
+        [{"device": "tpu:0", "bytes_in_use": 100,
+          "bytes_limit": 1000, "peak_bytes_in_use": 0}],
+        [{"device": "tpu:0", "bytes_in_use": 50,
+          "bytes_limit": 1000, "peak_bytes_in_use": 400}],
+        [{"device": "tpu:0", "bytes_in_use": 30,
+          "bytes_limit": 1000, "peak_bytes_in_use": 0}],
+    ])
+    monkeypatch.setattr(res, "get_tpu_stats", lambda: next(samples))
+    monkeypatch.setattr(res, "get_process_cpu_percent", lambda: 12.5)
+    monkeypatch.setattr(res, "get_used_memory_mb", lambda: 2048)
+
+    client = FakeClient()
+    mon = res.ResourceMonitor(client, collect_tpu=True)
+    for _ in range(3):
+        mon.report_resource()
+
+    assert len(client.reports) == 3
+    assert reg.get("dlrover_node_cpu_percent").value == 12.5
+    assert reg.get("dlrover_node_memory_used_mb").value == 2048.0
+    in_use = reg.get("dlrover_tpu_hbm_bytes_in_use")
+    assert in_use.labels(device="tpu:0").value == 30.0
+    limit = reg.get("dlrover_tpu_hbm_bytes_limit")
+    assert limit.labels(device="tpu:0").value == 1000.0
+    peak = reg.get("dlrover_tpu_hbm_peak_bytes")
+    assert peak.labels(device="tpu:0").value == 400.0
+    # only NEW high-water marks journal an event: 100, then the
+    # runtime-reported 400; the final lower sample journals nothing
+    peaks = jr.events("resource.hbm_peak")
+    assert [e["data"]["bytes"] for e in peaks] == [100, 400]
+    assert peaks[-1]["data"]["prev_bytes"] == 100
+    assert peaks[-1]["data"]["bytes_limit"] == 1000
+
+
+# --------------------------------------------------------- dump --goodput
+
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "goodput_journal.jsonl"
+)
+
+
+def test_dump_goodput_cli_renders_fixture(capsys):
+    """``dump --goodput`` over a committed pre-recorded journal: one
+    process with exact breadcrumbs, one pre-ledger process covered by
+    the heuristic replay."""
+    from dlrover_tpu.telemetry import dump
+
+    assert dump.main([FIXTURE, "--goodput"]) == 0
+    out = capsys.readouterr().out
+    assert "== goodput ==" in out
+    assert "badput" in out
+
+    assert dump.main([FIXTURE, "--goodput", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    job = payload["job"]
+    assert job["procs"] == 2 and job["nodes"] == 2
+    assert job["training_s"] == pytest.approx(13.0)
+    assert job["goodput_percent"] == pytest.approx(52.0)
+    assert job["attributed_percent"] == pytest.approx(100.0)
+    exact = {k: p["exact"] for k, p in payload["procs"].items()}
+    assert exact == {"node-a:101": True, "node-b:202": False}
+
+
+def test_export_metrics_publishes_job_gauges(fresh_defaults):
+    reg, _ = fresh_defaults
+    agg = GoodputAggregator()
+    agg.observe_report(
+        node_id=0, pid=1, start_ts=T0, elapsed_s=10.0,
+        phases=_phases(training=8.0, rendezvous=2.0), ts=T0 + 10,
+    )
+    goodput.export_metrics(agg.summary())
+    assert reg.get("dlrover_goodput_percent").value == pytest.approx(
+        80.0
+    )
+    badput = reg.get("dlrover_badput_seconds")
+    assert badput.labels(cause=Phase.RENDEZVOUS).value == (
+        pytest.approx(2.0)
+    )
+    for cause in BADPUT_CAUSES:
+        # every cause is published, zero or not: dashboards need the
+        # series to exist before the badput does
+        assert badput.labels(cause=cause).value is not None

@@ -504,6 +504,47 @@ def test_straggler_state_cleared_on_worker_removal():
     ).value == 0
 
 
+def test_scale_down_prunes_all_per_host_straggler_state():
+    """Scale-down pruning (PR 7): evicting several hosts at once drops
+    their duration windows, strike counters AND last-report anchors —
+    a later re-add of the same node id must start a fresh window, not
+    inherit the dead incarnation's cadence."""
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+    sm = SpeedMonitor(straggler_ratio=1.5, straggler_window=1)
+    for i in range(4):
+        sm.add_running_worker("worker", i)
+    t = 1000.0
+    # hosts 0/1 healthy; hosts 2/3 at 4x the median: both flagged
+    for k in range(1, 5):
+        _feed(sm, 0, 10 * k, t + k * 1.0)
+        _feed(sm, 1, 10 * k, t + k * 1.0)
+        _feed(sm, 2, 10 * k, t + k * 4.0)
+        _feed(sm, 3, 10 * k, t + k * 4.0)
+    assert sorted(sm.straggler_ranks()) == [2, 3]
+    # the scaler shrinks the job by evicting both stragglers
+    sm.remove_running_worker("worker", 2)
+    sm.remove_running_worker("worker", 3)
+    assert sm.straggler_ranks() == []
+    assert set(sm.host_step_durations()) <= {0, 1}
+    assert sm.running_workers == {("worker", 0), ("worker", 1)}
+    reg = T.default_registry()
+    assert reg.get("dlrover_straggler_hosts").value == 0
+    assert reg.get("dlrover_training_workers").value == 2
+    # node id 2 comes back (a replacement host reusing the rank): its
+    # first report must carry NO duration signal — pairing it with the
+    # dead incarnation's last report would fabricate a huge step time
+    # and instantly re-flag the fresh host
+    sm.add_running_worker("worker", 2)
+    _feed(sm, 2, 100, t + 100.0)
+    assert sm.host_step_durations().get(2) is None
+    assert sm.straggler_ranks() == []
+    # and from its SECOND report on it scores like everyone else
+    _feed(sm, 2, 110, t + 101.0)
+    assert sm.host_step_durations().get(2) == pytest.approx(0.1)
+    assert sm.straggler_ranks() == []
+
+
 def test_autoscaler_unions_speed_hint():
     """The cadence scorer's verdicts reach the shrink path alongside
     the network-check list (the `straggler.hint` journal event marks
@@ -621,4 +662,62 @@ def test_journal_event_names_are_snake_case_dotted():
     assert not bad, (
         "journal event names must be snake-case dotted "
         "(e.g. 'checkpoint.save'):\n" + "\n".join(bad)
+    )
+
+
+def _phase_usages():
+    """Every literal goodput phase label in dlrover_tpu/ and bench.py:
+    first-arg strings of ``.transition(...)``/``.credit(...)`` calls,
+    plus every ``Phase.X`` attribute reference."""
+    files = sorted((REPO_ROOT / "dlrover_tpu").rglob("*.py"))
+    files.append(REPO_ROOT / "bench.py")
+    strings, members = [], []
+    for path in files:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("transition", "credit")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                strings.append(
+                    (path, node.lineno, node.args[0].value)
+                )
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "Phase"
+            ):
+                members.append((path, node.lineno, node.attr))
+    return strings, members
+
+
+def test_goodput_phase_labels_are_canonical():
+    """Companion lint (PR 7): a phase label the ledger would reject at
+    runtime (ValueError in transition/credit) or a typo'd ``Phase.X``
+    member fails here, at collection speed, not mid-drill."""
+    from dlrover_tpu.telemetry.goodput import PHASES, Phase
+
+    strings, members = _phase_usages()
+    assert members, (
+        "the lint found no Phase.X references — did goodput move?"
+    )
+    valid_members = {
+        m for m in vars(Phase) if not m.startswith("_")
+    }
+    bad = [
+        f"{path}:{lineno}: {value!r} is not in PHASES"
+        for path, lineno, value in strings
+        if value not in PHASES
+    ] + [
+        f"{path}:{lineno}: Phase.{attr} is not a Phase member"
+        for path, lineno, attr in members
+        if attr not in valid_members
+    ]
+    assert not bad, (
+        "goodput phase labels must be canonical Phase members:\n"
+        + "\n".join(bad)
     )
