@@ -253,9 +253,13 @@ def main():
             num_workers=2,
             slot_bytes=max(1 << 20, 4 * batch * seq * 2 + 4096),
         )
+        # microbatch reshape runs on the fill thread (transform=), so
+        # the train loop only dequeues device-ready microbatches and
+        # the data.fetch/data.stage spans split source wait from
+        # reshape+H2D staging
         prefetch = DevicePrefetch(
-            (trainer.microbatch(b) for b in loader),
-            depth=2, sharding=trainer.microbatch_sharding,
+            loader, depth=2, sharding=trainer.microbatch_sharding,
+            transform=trainer.microbatch,
         )
         batches = iter(prefetch)
 
@@ -347,7 +351,8 @@ def main():
     )
     goodput_snap = ledger.close()
     phases = tracing.summarize(
-        ("data", "dispatch", "ckpt.wait_staged", "ckpt.stage")
+        ("data", "dispatch", "ckpt.wait_staged", "ckpt.stage",
+         "data.fetch", "data.stage")
     )
     tracing.disable()
 
@@ -441,6 +446,21 @@ def main():
         ),
         "dispatch_ms_max": round(
             phases.get("dispatch", {}).get("max_ms", 0.0), 3
+        ),
+        # feed-side costs (docs/DATA_PIPELINE.md BENCH conventions):
+        # data_stall_ms = the train thread blocked on the feed (same
+        # series as data_ms; named for cross-bench comparison),
+        # shard_dispatch_ms = prefetch-THREAD wait on the upstream
+        # source per batch (data.fetch span; 0.0 on the inmem path
+        # where no prefetch thread runs)
+        "data_stall_ms": round(
+            phases.get("data", {}).get("mean_ms", 0.0), 3
+        ),
+        "shard_dispatch_ms": round(
+            phases.get("data.fetch", {}).get("mean_ms", 0.0), 3
+        ),
+        "data_stage_ms": round(
+            phases.get("data.stage", {}).get("mean_ms", 0.0), 3
         ),
         # effective-throughput account (docs/TELEMETRY.md Goodput):
         # fraction of the timed window spent training, and the badput

@@ -305,6 +305,19 @@ class MasterClient:
         return self._call("get_task", req)
 
     @supervised_rpc
+    def get_tasks(self, dataset_name: str, max_tasks: int = 1,
+                  incarnation: int = -1) -> List[comm.Task]:
+        """Batched dispatch: up to ``max_tasks`` shards in one
+        round-trip. A master that predates this RPC rejects the unknown
+        message type/method with an application error (not a connection
+        error) — callers catch that and fall back to :meth:`get_task`."""
+        req = self._fill(comm.TaskBatchRequest(
+            dataset_name=dataset_name, incarnation=incarnation,
+            max_tasks=max_tasks,
+        ))
+        return self._call("get_tasks", req).tasks
+
+    @supervised_rpc
     def report_task_result(self, dataset_name: str, task_id: int,
                            err_message: str = ""):
         req = self._fill(comm.TaskResult(
@@ -608,6 +621,24 @@ class LocalMasterClient:
                 end=task.shard.end, record_indices=task.shard.record_indices,
             ),
         )
+
+    def get_tasks(self, dataset_name: str, max_tasks: int = 1,
+                  incarnation: int = -1) -> List[comm.Task]:
+        tasks = self._task_manager.get_dataset_tasks(
+            self._node_type, self._node_id, dataset_name,
+            max_tasks=max_tasks, incarnation=incarnation,
+        )
+        return [
+            comm.Task(
+                task_id=t.task_id, task_type=t.task_type,
+                shard=comm.Shard(
+                    name=t.shard.name, start=t.shard.start,
+                    end=t.shard.end,
+                    record_indices=t.shard.record_indices,
+                ),
+            )
+            for t in tasks
+        ]
 
     def report_task_result(self, dataset_name, task_id, err_message=""):
         accepted = self._task_manager.report_dataset_task(

@@ -2,24 +2,40 @@
 
 Parity reference: dlrover/python/elastic_agent/sharding/client.py:31,249
 (ShardingClient, IndexShardingClient with prefetch thread).
+
+Beyond parity, the dispatch path is batched and buffered: one
+``get_tasks(n)`` round-trip can pull several shards (the master
+group-commits its ledger once for the whole batch), and an optional
+background lookahead thread keeps a bounded window of fetched-but-
+unconsumed shards so WAIT polls and RPC latency are absorbed off the
+training thread. Exactly-once semantics are unchanged: every buffered
+shard is journaled in the master's doing set before the reply leaves,
+so shards buffered by a worker that dies are requeued by the task
+watchdog (or reclaimed immediately on the successor's first fetch via
+the incarnation handshake).
 """
 
 import threading
 import time
 from collections import deque
 from queue import Empty, Full, Queue
-from typing import Callable, Optional
+from typing import List, Optional
+
+import numpy as np
 
 from dlrover_tpu.agent.master_client import get_master_client
 from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.telemetry import counter
+from dlrover_tpu.telemetry import counter, gauge, record
 
 #: default ceiling on one fetch_shard WAIT poll. The master's task
 #: watchdog requeues a dead peer's shard within its task timeout
 #: (minutes); an hour of WAIT means the watchdog itself is gone — stop
 #: depending on it instead of spinning forever.
 DEFAULT_WAIT_DEADLINE_SECS = 3600.0
+
+#: sentinel for "the master answered WAIT" inside _request_tasks
+_WAIT = object()
 
 
 class ShardingClient:
@@ -36,6 +52,8 @@ class ShardingClient:
         num_minibatches_per_shard: int = 2,
         storage_type: str = "table",
         master_client=None,
+        fetch_batch: Optional[int] = None,
+        lookahead: Optional[int] = None,
     ):
         import os
 
@@ -56,6 +74,37 @@ class ShardingClient:
         self._incarnation = int(
             os.getenv(NodeEnv.RESTART_COUNT, "-1") or -1
         )
+        # ---- batched dispatch + lookahead window ---------------------
+        if fetch_batch is None:
+            fetch_batch = int(
+                os.getenv("DLROVER_TPU_SHARD_FETCH_BATCH", "1") or 1
+            )
+        if lookahead is None:
+            lookahead = int(
+                os.getenv("DLROVER_TPU_SHARD_LOOKAHEAD", "0") or 0
+            )
+        self._fetch_batch = max(1, fetch_batch)
+        self._lookahead = max(0, lookahead)
+        #: shards fetched from the master but not yet handed to the
+        #: training thread; guarded by _buf_cond (NOT self._lock — the
+        #: buffer must stay reachable while a completion RPC is slow)
+        self._ready: deque = deque()
+        self._buf_cond = threading.Condition()
+        self._drained = False  # master said: dataset done
+        self._fetch_error: Optional[BaseException] = None
+        self._batch_supported = True
+        # hot-path instruments resolved once, not per poll tick
+        self._wait_counter = counter(
+            "dlrover_shard_wait_polls_total",
+            "WAIT answers received while polling for a shard",
+            ["dataset"],
+        ).labels(dataset=dataset_name)
+        self._prefetch_gauge = gauge(
+            "dlrover_shard_prefetch_depth",
+            "Shards fetched from the master but not yet consumed by "
+            "the training thread", ["dataset"],
+        ).labels(dataset=dataset_name)
+        self._lookahead_thread: Optional[threading.Thread] = None
         self._dataset_params = dict(
             batch_size=batch_size,
             num_epochs=num_epochs,
@@ -81,10 +130,127 @@ class ShardingClient:
                     **self._dataset_params
                 ),
             )
+        if self._lookahead > 0:
+            self._lookahead_thread = threading.Thread(
+                target=self._lookahead_loop, daemon=True,
+                name="shard-lookahead",
+            )
+            self._lookahead_thread.start()
 
     @property
     def dataset_name(self):
         return self._dataset_name
+
+    # ------------------------------------------------------------ dispatch
+
+    def _request_tasks(self, n: int):
+        """One master round-trip for up to ``n`` shards.
+
+        Returns a list of real tasks (empty = dataset exhausted), or
+        the ``_WAIT`` sentinel when the master answered WAIT. Uses the
+        batched RPC when available; a master that predates it rejects
+        the unknown message with an APPLICATION error — that flips the
+        client into single-fetch fallback for good. Connection-class
+        errors (including MasterLostError after a reconnect deadline)
+        are NOT protocol rejections and propagate to the caller.
+        """
+        mc = self._master_client
+        if n > 1 and self._batch_supported and hasattr(mc, "get_tasks"):
+            try:
+                tasks = mc.get_tasks(
+                    self._dataset_name, max_tasks=n,
+                    incarnation=self._incarnation,
+                )
+            except (ConnectionError, OSError):
+                raise  # outage, not an old master
+            except Exception as e:
+                self._batch_supported = False
+                logger.warning(
+                    "master rejected batched get_tasks for dataset %s "
+                    "(%s); falling back to single-task fetch",
+                    self._dataset_name, e,
+                )
+                record(
+                    "shard.batch_rpc_fallback",
+                    dataset=self._dataset_name, error=str(e)[:120],
+                )
+                tasks = None
+            if tasks is not None:
+                real = [
+                    t for t in tasks if t is not None and t.task_id >= 0
+                ]
+                if real:
+                    return real
+                if any(
+                    t is not None and t.task_type == TaskType.WAIT
+                    for t in tasks
+                ):
+                    return _WAIT
+                return []
+        task = mc.get_task(
+            self._dataset_name, incarnation=self._incarnation
+        )
+        if task is not None and task.task_type == TaskType.WAIT:
+            return _WAIT
+        if task is None or task.task_id < 0:
+            return []
+        return [task]
+
+    def _push_ready(self, tasks: List) -> None:
+        with self._buf_cond:
+            self._ready.extend(tasks)
+            self._prefetch_gauge.set(len(self._ready))
+            self._buf_cond.notify_all()
+
+    def _pop_ready(self):
+        """Pop one buffered task, or None; caller holds _buf_cond."""
+        if not self._ready:
+            return None
+        task = self._ready.popleft()
+        self._prefetch_gauge.set(len(self._ready))
+        self._buf_cond.notify_all()  # wake the lookahead refill
+        return task
+
+    def _deliver(self, task):
+        with self._lock:
+            self._pending_tasks.append(task)
+            self._current_task = task
+        return task.shard
+
+    def _lookahead_loop(self):
+        """Keep the ready buffer at the lookahead depth, absorbing RPC
+        latency and WAIT polls off the training thread."""
+        try:
+            while True:
+                with self._buf_cond:
+                    while (
+                        len(self._ready) >= self._lookahead
+                        and not self._stopped
+                    ):
+                        self._buf_cond.wait()
+                    if self._stopped or self._drained:
+                        return
+                    want = min(
+                        self._fetch_batch,
+                        self._lookahead - len(self._ready),
+                    )
+                got = self._request_tasks(max(1, want))
+                if got is _WAIT:
+                    self._wait_counter.inc()
+                    if self._stopped:
+                        return
+                    time.sleep(0.5)
+                    continue
+                if not got:
+                    with self._buf_cond:
+                        self._drained = True
+                        self._buf_cond.notify_all()
+                    return
+                self._push_ready(got)
+        except BaseException as e:  # surfaced to the training thread
+            with self._buf_cond:
+                self._fetch_error = e
+                self._buf_cond.notify_all()
 
     def fetch_shard(self, poll_interval: float = 0.5,
                     max_wait: Optional[float] =
@@ -104,22 +270,30 @@ class ShardingClient:
         watchdog requeueing the peer's shard — if WAIT persists past
         ``max_wait`` seconds (None = unbounded), log and return None
         rather than blocking the training thread forever. stop()
-        interrupts the poll at the next tick."""
+        interrupts the poll at the next tick.
+
+        With ``fetch_batch > 1`` shards arrive several-per-round-trip
+        and queue in a local buffer; with ``lookahead > 0`` a
+        background thread keeps that buffer full and this call only
+        dequeues (errors from the thread re-raise here)."""
         deadline = (
             time.monotonic() + max_wait if max_wait is not None else None
         )
+        if self._lookahead_thread is not None:
+            return self._fetch_from_lookahead(poll_interval, deadline,
+                                              max_wait)
         while True:
-            task = self._master_client.get_task(
-                self._dataset_name, incarnation=self._incarnation
-            )
-            if task is not None and task.task_type == TaskType.WAIT:
+            with self._buf_cond:
+                task = self._pop_ready()
+            if task is not None:
+                return self._deliver(task)
+            if self._drained:
+                return None
+            got = self._request_tasks(self._fetch_batch)
+            if got is _WAIT:
                 # a sustained climb here = workers starving on a peer's
                 # in-flight shard (dead peer / stuck watchdog)
-                counter(
-                    "dlrover_shard_wait_polls_total",
-                    "WAIT answers received while polling for a shard",
-                    ["dataset"],
-                ).labels(dataset=self._dataset_name).inc()
+                self._wait_counter.inc()
                 if self._stopped:
                     return None
                 if deadline is not None and time.monotonic() > deadline:
@@ -133,16 +307,36 @@ class ShardingClient:
                     return None
                 time.sleep(poll_interval)
                 continue
-            if task is None or task.task_id < 0:
+            if not got:
+                self._drained = True
                 return None
-            with self._lock:
-                self._pending_tasks.append(task)
-                self._current_task = task
-            return task.shard
+            self._push_ready(got)
+
+    def _fetch_from_lookahead(self, poll_interval, deadline, max_wait):
+        with self._buf_cond:
+            while True:
+                task = self._pop_ready()
+                if task is not None:
+                    break
+                if self._fetch_error is not None:
+                    raise self._fetch_error
+                if self._drained or self._stopped:
+                    return None
+                if deadline is not None and time.monotonic() > deadline:
+                    logger.error(
+                        "fetch_shard waited >%.0fs on dataset %s with "
+                        "no shard surfacing from the lookahead window",
+                        max_wait, self._dataset_name,
+                    )
+                    return None
+                self._buf_cond.wait(timeout=poll_interval)
+        return self._deliver(task)
 
     def stop(self):
         """Interrupt any in-progress WAIT poll; subclasses extend."""
         self._stopped = True
+        with self._buf_cond:
+            self._buf_cond.notify_all()
         remove = getattr(
             self._master_client, "remove_reconnect_hook", None
         )
@@ -152,27 +346,34 @@ class ShardingClient:
     def report_batch_done(self, batch_size: Optional[int] = None) -> bool:
         """Accumulate minibatch completions; report the oldest pending task
         done once its shard's records are consumed
-        (parity: sharding/client.py:146)."""
+        (parity: sharding/client.py:146).
+
+        The completion RPC runs OUTSIDE the lock: a slow or
+        reconnecting master must not stall stop()/report_task_done()
+        behind this call."""
+        task = None
         with self._lock:
             if not self._pending_tasks:
                 return False
             self._batch_count += 1
-            task = self._pending_tasks[0]
-            records = task.shard.end - task.shard.start
+            head = self._pending_tasks[0]
+            records = head.shard.end - head.shard.start
             minibatches = max(
                 1, (records + self._batch_size - 1) // self._batch_size
             )
             if self._batch_count >= minibatches:
                 self._pending_tasks.popleft()
                 self._batch_count = 0
-                resp = self._master_client.report_task_result(
-                    self._dataset_name, task.task_id
-                )
-                # the master may REJECT the completion (the watchdog
-                # already requeued this task to someone else): the
-                # caller must not account the range as its own
-                return bool(getattr(resp, "success", True))
-        return False
+                task = head
+        if task is None:
+            return False
+        resp = self._master_client.report_task_result(
+            self._dataset_name, task.task_id
+        )
+        # the master may REJECT the completion (the watchdog already
+        # requeued this task to someone else): the caller must not
+        # account the range as its own
+        return bool(getattr(resp, "success", True))
 
     def report_task_done(self, task_id: int, err: str = ""):
         self._master_client.report_task_result(
@@ -195,7 +396,17 @@ class ShardingClient:
 
 class IndexShardingClient(ShardingClient):
     """Per-sample index stream over shards with a prefetch thread
-    (parity: sharding/client.py:249)."""
+    (parity: sharding/client.py:249).
+
+    Indices travel from the prefetch thread to consumers as
+    batch-sized numpy chunks (one queue op per ~batch_size samples),
+    not per-sample puts — ``fetch_batch_indices`` hands out whole
+    slices and ``fetch_sample_index`` cursors through the current
+    chunk without touching the queue."""
+
+    #: chunks buffered between prefetch and consumer (in units of
+    #: ~batch_size samples; 8 matches the old per-sample queue bound)
+    QUEUE_CHUNKS = 8
 
     def __init__(self, dataset_name: str, batch_size: int,
                  num_epochs: int = 1, dataset_size: int = 0,
@@ -204,26 +415,33 @@ class IndexShardingClient(ShardingClient):
                  num_minibatches_per_shard: int = 2,
                  storage_type: str = "table",
                  num_workers: int = 1,
-                 master_client=None):
+                 master_client=None,
+                 fetch_batch: Optional[int] = None,
+                 lookahead: Optional[int] = None):
         super().__init__(
             dataset_name, batch_size, num_epochs, dataset_size, shuffle,
             task_type, num_minibatches_per_shard, storage_type,
-            master_client=master_client,
+            master_client=master_client, fetch_batch=fetch_batch,
+            lookahead=lookahead,
         )
-        self._sample_queue: "Queue[int]" = Queue(maxsize=batch_size * 8)
+        self._sample_queue: Queue = Queue(maxsize=self.QUEUE_CHUNKS)
         self._exhausted = False
         self._failed = False
+        # consumer-side cursor over the chunk most recently dequeued
+        self._consume_lock = threading.Lock()
+        self._chunk: Optional[np.ndarray] = None
+        self._chunk_pos = 0
         self._prefetch_thread = threading.Thread(
             target=self._prefetch_loop, daemon=True,
             name="shard-index-prefetch",
         )
         self._prefetch_thread.start()
 
-    def _put_index(self, idx: int) -> bool:
+    def _put_chunk(self, chunk: np.ndarray) -> bool:
         """Bounded put that aborts on stop() instead of blocking forever."""
         while not self._stopped:
             try:
-                self._sample_queue.put(idx, timeout=0.1)
+                self._sample_queue.put(chunk, timeout=0.1)
                 return True
             except Full:
                 continue
@@ -237,12 +455,23 @@ class IndexShardingClient(ShardingClient):
                 if shard is None:
                     clean = True  # master says: dataset done
                     break
-                indices = shard.record_indices or range(
-                    shard.start, shard.end
-                )
-                for idx in indices:
-                    if not self._put_index(idx):
+                if shard.record_indices is not None:
+                    arr = np.asarray(
+                        shard.record_indices, dtype=np.int64
+                    )
+                else:
+                    arr = np.arange(
+                        shard.start, shard.end, dtype=np.int64
+                    )
+                stopped_mid_shard = False
+                for off in range(0, arr.size, self._batch_size):
+                    if not self._put_chunk(
+                        arr[off:off + self._batch_size]
+                    ):
+                        stopped_mid_shard = True
                         break
+                if stopped_mid_shard:
+                    break
             else:
                 clean = True  # stop() requested; not a failure
         except Exception as e:
@@ -257,7 +486,7 @@ class IndexShardingClient(ShardingClient):
                 else:
                     self._failed = True
             try:
-                self._sample_queue.put_nowait(-1)
+                self._sample_queue.put_nowait(None)
             except Full:
                 pass  # consumers drain and then hit the timeout path
 
@@ -273,43 +502,82 @@ class IndexShardingClient(ShardingClient):
         samples may remain undispatched on the master."""
         return self._failed
 
-    def fetch_sample_index(self) -> Optional[int]:
-        """Next sample index, or None when iteration ended — check
-        ``exhausted`` / ``failed`` to distinguish dataset end from a
-        deliberate stop or an error."""
+    def _next_chunk(self) -> Optional[np.ndarray]:
+        """Dequeue the next chunk, or None when iteration ended;
+        caller holds _consume_lock."""
         while True:
             try:
-                idx = self._sample_queue.get(timeout=0.1)
+                chunk = self._sample_queue.get(timeout=0.1)
             except Empty:
                 # no sentinel needed: a dead/stopped producer + empty
                 # queue means iteration is over
                 if self._stopped or not self._prefetch_thread.is_alive():
                     return None
                 continue
-            if idx < 0:
+            if chunk is None:
                 try:
-                    self._sample_queue.put_nowait(-1)  # re-signal others
+                    self._sample_queue.put_nowait(None)  # re-signal
                 except Full:
                     pass
                 return None
-            return idx
+            return chunk
 
-    def fetch_batch_indices(self, batch_size: Optional[int] = None):
-        """A batch of indices (possibly short on epoch end), or None."""
+    def fetch_sample_index(self) -> Optional[int]:
+        """Next sample index, or None when iteration ended — check
+        ``exhausted`` / ``failed`` to distinguish dataset end from a
+        deliberate stop or an error."""
+        with self._consume_lock:
+            if (
+                self._chunk is not None
+                and self._chunk_pos < self._chunk.size
+            ):
+                idx = int(self._chunk[self._chunk_pos])
+                self._chunk_pos += 1
+                return idx
+            chunk = self._next_chunk()
+            if chunk is None:
+                return None
+            self._chunk = chunk
+            self._chunk_pos = 1
+            return int(chunk[0])
+
+    def fetch_batch_indices(
+        self, batch_size: Optional[int] = None
+    ) -> Optional[np.ndarray]:
+        """A batch of indices as one numpy array (possibly short on
+        epoch end), or None when iteration ended. The common case is a
+        zero-copy handoff of a whole prefetched chunk."""
         n = batch_size or self._batch_size
-        indices = []
-        for _ in range(n):
-            idx = self.fetch_sample_index()
-            if idx is None:
-                break
-            indices.append(idx)
-        return indices or None
+        with self._consume_lock:
+            parts = []
+            got = 0
+            while got < n:
+                if (
+                    self._chunk is None
+                    or self._chunk_pos >= self._chunk.size
+                ):
+                    chunk = self._next_chunk()
+                    if chunk is None:
+                        break
+                    self._chunk = chunk
+                    self._chunk_pos = 0
+                take = min(n - got, self._chunk.size - self._chunk_pos)
+                parts.append(
+                    self._chunk[self._chunk_pos:self._chunk_pos + take]
+                )
+                self._chunk_pos += take
+                got += take
+            if not parts:
+                return None
+            if len(parts) == 1:
+                return parts[0]
+            return np.concatenate(parts)
 
     def stop(self):
         super().stop()
         try:
             # best-effort wakeup; consumers also poll _stopped on timeout,
             # so a full queue cannot deadlock the stopping thread
-            self._sample_queue.put_nowait(-1)
+            self._sample_queue.put_nowait(None)
         except Full:
             pass

@@ -210,6 +210,20 @@ class TaskRequest(BaseRequest):
 
 
 @dataclass
+class TaskBatchRequest(BaseRequest):
+    dataset_name: str = ""
+    incarnation: int = -1
+    #: upper bound on shards per round-trip; the master may return fewer
+    #: (queue short) or a single WAIT/invalid task when nothing is ready
+    max_tasks: int = 1
+
+
+@dataclass
+class TaskBatch(BaseMessage):
+    tasks: List[Task] = field(default_factory=list)
+
+
+@dataclass
 class TaskResult(BaseRequest):
     dataset_name: str = ""
     task_id: int = -1

@@ -35,6 +35,8 @@ class _ShardedProducer:
     shuffle: bool
     num_minibatches_per_shard: int
     master_addr: Optional[str]
+    fetch_batch: Optional[int] = None
+    lookahead: Optional[int] = None
 
     def __call__(self) -> Iterable[Any]:
         # built here (not in the trainer) so every producer has its own
@@ -51,6 +53,8 @@ class _ShardedProducer:
             shuffle=self.shuffle,
             num_minibatches_per_shard=self.num_minibatches_per_shard,
             master_client=client,
+            fetch_batch=self.fetch_batch,
+            lookahead=self.lookahead,
         )
         while True:
             shard = sharding.fetch_shard()
@@ -71,6 +75,12 @@ class ElasticShmDataLoader:
         the master's dataset manager (shards of ``batch_size`` samples).
       num_workers: coworker producer processes.
       sharding (optional): jax sharding for DevicePrefetch placement.
+      transform (optional): per-batch reshape (e.g. the trainer's
+        microbatch split) run on the prefetch thread, off the train
+        loop.
+      fetch_batch/lookahead (optional): per-producer shard dispatch
+        batching and lookahead window (see ShardingClient; None reads
+        DLROVER_TPU_SHARD_FETCH_BATCH / DLROVER_TPU_SHARD_LOOKAHEAD).
     """
 
     def __init__(
@@ -88,6 +98,9 @@ class ElasticShmDataLoader:
         num_slots: int = 8,
         prefetch_depth: int = 2,
         sharding=None,
+        transform: Optional[Callable[[Any], Any]] = None,
+        fetch_batch: Optional[int] = None,
+        lookahead: Optional[int] = None,
     ):
         from dlrover_tpu.common.constants import NodeEnv
 
@@ -101,6 +114,8 @@ class ElasticShmDataLoader:
             shuffle=shuffle,
             num_minibatches_per_shard=num_minibatches_per_shard,
             master_addr=master_addr,
+            fetch_batch=fetch_batch,
+            lookahead=lookahead,
         )
         self._loader = ShmDataLoader(
             producer,
@@ -111,6 +126,7 @@ class ElasticShmDataLoader:
         )
         self._prefetch = DevicePrefetch(
             self._loader, depth=prefetch_depth, sharding=sharding,
+            transform=transform,
         )
         logger.info(
             "ElasticShmDataLoader: %d coworkers, dataset=%s size=%d "

@@ -123,14 +123,24 @@ class ShmDataLoader:
 class DevicePrefetch:
     """Wrap a batch iterator, keeping ``depth`` batches in flight on
     device (parity: GpuPreLoader preloader.py:8 — the CUDA-stream H2D
-    overlap maps to JAX's async device_put dispatch)."""
+    overlap maps to JAX's async device_put dispatch).
 
-    def __init__(self, it: Iterable, depth: int = 2, sharding=None):
+    ``transform`` (e.g. the trainer's microbatch reshape) runs on the
+    fill thread, between fetching a batch from the source and staging
+    it to device — the train loop only ever dequeues device-ready
+    batches. A producer exception (failed transform/device_put, or the
+    source iterator raising) is re-raised in the CONSUMING iterator
+    instead of truncating the epoch into a silent EOF."""
+
+    def __init__(self, it: Iterable, depth: int = 2, sharding=None,
+                 transform: Optional[Callable[[Any], Any]] = None):
         self._it = iter(it)
         self._depth = depth
         self._sharding = sharding
+        self._transform = transform
         self._queue: "Queue" = Queue(maxsize=depth)
         self._done = object()
+        self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._fill, daemon=True)
         self._thread.start()
 
@@ -142,11 +152,29 @@ class DevicePrefetch:
         return jax.tree.map(jax.device_put, batch)
 
     def _fill(self):
+        from dlrover_tpu.telemetry import tracing
+
         try:
-            for batch in self._it:
-                self._queue.put(self._put_device(batch))
+            while True:
+                with tracing.span("data.fetch"):
+                    try:
+                        batch = next(self._it)
+                    except StopIteration:
+                        break
+                with tracing.span("data.stage"):
+                    if self._transform is not None:
+                        batch = self._transform(batch)
+                    staged = self._put_device(batch)
+                self._queue.put(staged)
+        except BaseException as e:
+            self._error = e
         finally:
             self._queue.put(self._done)
+
+    def _check_error(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
     def __iter__(self):
         from queue import Empty
@@ -158,9 +186,11 @@ class DevicePrefetch:
                 # resilient to a swallowed _done sentinel (join()'s
                 # drain) — a dead fill thread means the stream is over
                 if not self._thread.is_alive():
+                    self._check_error()
                     return
                 continue
             if item is self._done:
+                self._check_error()
                 return
             yield item
 

@@ -140,15 +140,14 @@ class MasterServicer:
             )
         return comm.Response(success=True)
 
-    def rpc_get_task(self, req: comm.TaskRequest) -> comm.Task:
+    def _note_training_started(self):
         if not self._start_training_time:
             self._start_training_time = time.time()
             if self._speed_monitor:
                 self._speed_monitor.set_start_timestamp()
-        task = self._task_manager.get_dataset_task(
-            req.node_type, req.node_id, req.dataset_name,
-            incarnation=req.incarnation,
-        )
+
+    @staticmethod
+    def _wire_task(task) -> comm.Task:
         shard = comm.Shard(
             name=task.shard.name,
             start=task.shard.start,
@@ -158,6 +157,24 @@ class MasterServicer:
         return comm.Task(
             task_id=task.task_id, task_type=task.task_type, shard=shard
         )
+
+    def rpc_get_task(self, req: comm.TaskRequest) -> comm.Task:
+        self._note_training_started()
+        task = self._task_manager.get_dataset_task(
+            req.node_type, req.node_id, req.dataset_name,
+            incarnation=req.incarnation,
+        )
+        return self._wire_task(task)
+
+    def rpc_get_tasks(self, req: comm.TaskBatchRequest) -> comm.TaskBatch:
+        """Batched dispatch: up to ``max_tasks`` shards per round-trip,
+        ledger group-committed before the reply leaves."""
+        self._note_training_started()
+        tasks = self._task_manager.get_dataset_tasks(
+            req.node_type, req.node_id, req.dataset_name,
+            max_tasks=req.max_tasks, incarnation=req.incarnation,
+        )
+        return comm.TaskBatch(tasks=[self._wire_task(t) for t in tasks])
 
     def rpc_report_task_result(self, req: comm.TaskResult) -> comm.Response:
         success = not req.err_message

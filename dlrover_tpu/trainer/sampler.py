@@ -12,6 +12,8 @@ import math
 import random
 from typing import Dict, Iterator, List, Optional
 
+import numpy as np
+
 
 class ElasticDistributedSampler:
     def __init__(
@@ -59,6 +61,13 @@ class ElasticDistributedSampler:
         return indices
 
     def __iter__(self) -> Iterator[int]:
+        for idx in self._rank_indices():
+            # count global progress: each yielded index advances the global
+            # consumed count by num_replicas (all replicas move in lockstep)
+            self.completed_num += self.num_replicas
+            yield idx
+
+    def _rank_indices(self) -> List[int]:
         indices = self._epoch_indices()[self.completed_num:]
         if not self.drop_last:
             # pad to a replica multiple
@@ -67,11 +76,21 @@ class ElasticDistributedSampler:
                 indices += indices[:pad]
         else:
             indices = indices[: self.total_size]
-        for i, idx in enumerate(indices[self.rank::self.num_replicas]):
-            # count global progress: each yielded index advances the global
-            # consumed count by num_replicas (all replicas move in lockstep)
-            self.completed_num += self.num_replicas
-            yield idx
+        return indices[self.rank::self.num_replicas]
+
+    def iter_batches(self, batch_size: int) -> Iterator[np.ndarray]:
+        """Vectorized iteration: numpy index arrays of ``batch_size``
+        (the last may be short), one bookkeeping update per batch
+        instead of per sample. Progress accounting matches __iter__:
+        each yielded INDEX advances the global consumed count by
+        num_replicas, committed when the batch is handed out."""
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive: {batch_size}")
+        indices = np.asarray(self._rank_indices(), dtype=np.int64)
+        for off in range(0, indices.size, batch_size):
+            batch = indices[off:off + batch_size]
+            self.completed_num += batch.size * self.num_replicas
+            yield batch
 
     def __len__(self) -> int:
         return self.num_samples

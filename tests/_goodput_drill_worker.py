@@ -101,6 +101,10 @@ def main() -> int:
     )
     rendezvous("ROUND")
 
+    # batched dispatch + background lookahead on the drill path: the
+    # crashing worker dies holding buffered-but-unconsumed shards,
+    # which the successor incarnation reclaims on its first fetch —
+    # the exactly-once assert covers the buffered window
     sharding = ShardingClient(
         dataset_name="goodput-drill",
         batch_size=args.batch_size,
@@ -109,6 +113,8 @@ def main() -> int:
         shuffle=False,
         num_minibatches_per_shard=1,
         master_client=client,
+        fetch_batch=2,
+        lookahead=2,
     )
     step = 0
     while True:

@@ -69,6 +69,10 @@ def main() -> int:
     round1 = rendezvous("ROUND1")
 
     # ---- consume the dataset ---------------------------------------
+    # fetch_batch puts the batched get_tasks RPC (and its group-commit
+    # journal write) on the drill's hot path: shards buffered when the
+    # master dies are restored in its doing set under THIS worker, and
+    # the exactly-once partition assert covers them
     sharding = ShardingClient(
         dataset_name="failover-drill",
         batch_size=args.batch_size,
@@ -77,6 +81,7 @@ def main() -> int:
         shuffle=False,
         num_minibatches_per_shard=1,
         master_client=client,
+        fetch_batch=3,
     )
     step = 0
     round2_done = False

@@ -1,0 +1,523 @@
+"""Batched shard dispatch + group-commit journaling (ISSUE 8).
+
+Covers the full vertical: TaskBatch wire messages, TaskManager's
+get_dataset_tasks with ONE journal write per batch, crash consistency
+of the group commit (a master killed between handing out a batch and
+the next commit restores a ledger that still exactly partitions the
+dataset), the real-gRPC batch RPC, the client's single-fetch fallback
+against a master that predates the RPC, the lookahead window, the
+report_batch_done lock fix, DevicePrefetch error propagation and
+fill-thread transform, chunked index delivery, the vectorized
+sampler, and the shard_throughput --smoke benchmark.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.master_client import LocalMasterClient, MasterClient
+from dlrover_tpu.agent.sharding.client import (
+    IndexShardingClient,
+    ShardingClient,
+)
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeType, TaskType
+from dlrover_tpu.master.local_master import LocalJobMaster
+from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.state_journal import build_master_state_journal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PARAMS = dict(
+    batch_size=4, num_epochs=1, dataset_size=48, shuffle=False,
+    num_minibatches_per_shard=1, dataset_name="batch-ds",
+    task_type=TaskType.TRAINING, storage_type="table",
+)
+
+
+def _new_task_manager(params, state_dir=None):
+    journal = None
+    if state_dir is not None:
+        journal = build_master_state_journal(
+            "dispatch-job", state_dir=state_dir
+        )
+    tm = TaskManager()
+    if journal is not None:
+        tm.attach_state_journal(journal)
+    splitter = new_dataset_splitter(
+        shuffle=params["shuffle"],
+        shard_size=params["batch_size"]
+        * params["num_minibatches_per_shard"],
+        dataset_size=params["dataset_size"],
+        num_epochs=params["num_epochs"],
+        dataset_name=params["dataset_name"],
+    )
+    tm.new_dataset(
+        batch_size=params["batch_size"],
+        dataset_size=params["dataset_size"],
+        dataset_name=params["dataset_name"],
+        dataset_splitter=splitter,
+        task_type=TaskType.TRAINING,
+        params=params,
+    )
+    return journal, tm
+
+
+# ------------------------------------------------------------------ wire
+
+
+def test_task_batch_wire_roundtrip():
+    batch = comm.TaskBatch(tasks=[
+        comm.Task(task_id=7, task_type=TaskType.TRAINING,
+                  shard=comm.Shard(name="ds", start=8, end=12)),
+        comm.Task(task_id=8, task_type=TaskType.TRAINING,
+                  shard=comm.Shard(name="ds", start=12, end=16,
+                                   record_indices=[12, 15, 13, 14])),
+    ])
+    decoded = comm.deserialize(batch.serialize())
+    assert isinstance(decoded, comm.TaskBatch)
+    assert [t.task_id for t in decoded.tasks] == [7, 8]
+    assert decoded.tasks[1].shard.record_indices == [12, 15, 13, 14]
+
+    req = comm.deserialize(comm.TaskBatchRequest(
+        node_id=3, node_type="worker", dataset_name="ds",
+        incarnation=2, max_tasks=16,
+    ).serialize())
+    assert (req.max_tasks, req.incarnation, req.node_id) == (16, 2, 3)
+
+
+# ---------------------------------------------------------- task manager
+
+
+def test_get_dataset_tasks_pops_up_to_n():
+    _, tm = _new_task_manager(PARAMS)
+    got = tm.get_dataset_tasks(NodeType.WORKER, 0, "batch-ds",
+                               max_tasks=5)
+    assert len(got) == 5
+    assert all(t.task_id >= 0 for t in got)
+    # the single-task wrapper goes through the same path
+    single = tm.get_dataset_task(NodeType.WORKER, 0, "batch-ds")
+    assert single.task_id >= 0
+    # unknown dataset: one invalid task, never an empty list
+    bad = tm.get_dataset_tasks(NodeType.WORKER, 0, "nope", max_tasks=5)
+    assert len(bad) == 1 and bad[0].task_id < 0
+
+
+def test_wait_and_exhausted_returned_alone():
+    _, tm = _new_task_manager(PARAMS)
+    # node 0 grabs everything (12 shards) in one batch
+    got = tm.get_dataset_tasks(NodeType.WORKER, 0, "batch-ds",
+                               max_tasks=100)
+    assert len(got) == 12
+    # node 1 sees a single WAIT (peer's work in flight), not a batch
+    waiting = tm.get_dataset_tasks(NodeType.WORKER, 1, "batch-ds",
+                                   max_tasks=8)
+    assert len(waiting) == 1
+    assert waiting[0].task_type == TaskType.WAIT
+    for t in got:
+        assert tm.report_dataset_task("batch-ds", t.task_id, True)
+    # all reported: exhausted is a single invalid task
+    done = tm.get_dataset_tasks(NodeType.WORKER, 1, "batch-ds",
+                                max_tasks=8)
+    assert len(done) == 1
+    assert done[0].task_id < 0
+    assert done[0].task_type != TaskType.WAIT
+
+
+def test_group_commit_writes_journal_once_per_batch(tmp_path):
+    journal, tm = _new_task_manager(PARAMS, state_dir=str(tmp_path))
+    saves = []
+    orig = journal.save_dataset_checkpoint
+    journal.save_dataset_checkpoint = (
+        lambda *a, **kw: (saves.append(1), orig(*a, **kw))[1]
+    )
+    tm.get_dataset_tasks(NodeType.WORKER, 0, "batch-ds", max_tasks=8)
+    assert len(saves) == 1  # 8 shards, ONE ledger mutate
+    for _ in range(4):
+        tm.get_dataset_task(NodeType.WORKER, 0, "batch-ds")
+    assert len(saves) == 5  # per-task still commits per call
+
+
+def test_group_commit_crash_restore_exact_partition(tmp_path):
+    """Kill the master between handing out a batch and the next
+    commit: the journaled ledger must still exactly partition the
+    dataset — in-flight batch members stay deliverable under their
+    original ids, nothing is lost or handed out twice."""
+    state_dir = str(tmp_path)
+    _, tm = _new_task_manager(PARAMS, state_dir=state_dir)
+
+    batch1 = tm.get_dataset_tasks(NodeType.WORKER, 0, "batch-ds",
+                                  max_tasks=4)
+    batch2 = tm.get_dataset_tasks(NodeType.WORKER, 1, "batch-ds",
+                                  max_tasks=3)
+    # consume part of batch1 pre-crash; the completion is committed
+    assert tm.report_dataset_task("batch-ds", batch1[0].task_id, True)
+    consumed = [(batch1[0].shard.start, batch1[0].shard.end)]
+
+    # "master crash": rebuild from the journal alone (no next commit
+    # ever happened for the outstanding batch members)
+    journal2 = build_master_state_journal(
+        "dispatch-job", state_dir=state_dir
+    )
+    assert journal2.saved_datasets() == ["batch-ds"]
+    params, ckpt = journal2.load_dataset("batch-ds")
+    _, tm2 = _new_task_manager(params, state_dir=state_dir)
+    assert tm2.restore_dataset_from_checkpoint(ckpt, keep_doing=True)
+
+    # surviving workers report the rest of their batches under the
+    # ORIGINAL ids — all accepted exactly once
+    for t in batch1[1:] + batch2:
+        assert tm2.report_dataset_task("batch-ds", t.task_id, True)
+        consumed.append((t.shard.start, t.shard.end))
+    # a double report is rejected
+    assert not tm2.report_dataset_task(
+        "batch-ds", batch2[0].task_id, True
+    )
+
+    # drain the remainder in batches; union must partition exactly
+    while True:
+        got = tm2.get_dataset_tasks(NodeType.WORKER, 0, "batch-ds",
+                                    max_tasks=4)
+        if got[0].task_id < 0:
+            break
+        for t in got:
+            consumed.append((t.shard.start, t.shard.end))
+            assert tm2.report_dataset_task("batch-ds", t.task_id, True)
+    ranges = sorted(consumed)
+    assert ranges[0][0] == 0
+    assert ranges[-1][1] == PARAMS["dataset_size"]
+    for (_, end), (start, _) in zip(ranges, ranges[1:]):
+        assert end == start, f"gap/overlap in {ranges}"
+    assert tm2.finished()
+
+
+# ------------------------------------------------------------- real gRPC
+
+
+@pytest.fixture
+def master():
+    m = LocalJobMaster(port=0)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def _grpc_client(master, node_id=0):
+    return MasterClient(master.addr, node_id=node_id,
+                        node_type="worker", reconnect_timeout=5.0)
+
+
+def test_get_tasks_rpc_over_grpc(master):
+    mc = _grpc_client(master)
+    mc.report_dataset_shard_params(
+        batch_size=4, num_epochs=1, dataset_size=32, shuffle=False,
+        num_minibatches_per_shard=1, dataset_name="grpc-ds",
+    )
+    got = mc.get_tasks("grpc-ds", max_tasks=3)
+    assert len(got) == 3
+    assert all(isinstance(t, comm.Task) and t.task_id >= 0 for t in got)
+    starts = sorted(t.shard.start for t in got)
+    assert starts == [0, 4, 8]
+    mc.close()
+
+
+def test_sharding_client_batched_over_grpc(master):
+    mc = _grpc_client(master)
+    sc = ShardingClient(
+        dataset_name="grpc-batch-ds", batch_size=4, dataset_size=40,
+        num_minibatches_per_shard=1, master_client=mc, fetch_batch=4,
+    )
+    seen = []
+    while True:
+        shard = sc.fetch_shard(max_wait=30.0)
+        if shard is None:
+            break
+        seen.append((shard.start, shard.end))
+        assert sc.report_batch_done()
+    assert sorted(seen) == [(i, i + 4) for i in range(0, 40, 4)]
+    assert sc._batch_supported  # the new master accepted the RPC
+    mc.close()
+
+
+def test_old_master_triggers_single_fetch_fallback(master):
+    # a master that predates get_tasks: the servicer has no handler,
+    # so the generic server answers with an APPLICATION error
+    master.servicer.rpc_get_tasks = None
+    mc = _grpc_client(master)
+    sc = ShardingClient(
+        dataset_name="old-master-ds", batch_size=4, dataset_size=24,
+        num_minibatches_per_shard=1, master_client=mc, fetch_batch=4,
+    )
+    seen = []
+    while True:
+        shard = sc.fetch_shard(max_wait=30.0)
+        if shard is None:
+            break
+        seen.append((shard.start, shard.end))
+        sc.report_batch_done()
+    assert sorted(seen) == [(i, i + 4) for i in range(0, 24, 4)]
+    assert not sc._batch_supported  # flipped to single-fetch for good
+    mc.close()
+
+
+# ------------------------------------------------------- sharding client
+
+
+def test_lookahead_window_drains_exactly_once():
+    mc = LocalMasterClient()
+    sc = ShardingClient(
+        dataset_name="look-ds", batch_size=4, dataset_size=48,
+        num_minibatches_per_shard=1, master_client=mc,
+        fetch_batch=3, lookahead=6,
+    )
+    seen = []
+    while True:
+        shard = sc.fetch_shard(max_wait=30.0)
+        if shard is None:
+            break
+        seen.append((shard.start, shard.end))
+        assert sc.report_batch_done()
+    assert sorted(seen) == [(i, i + 4) for i in range(0, 48, 4)]
+    sc.stop()
+
+
+def test_lookahead_surfaces_fetch_errors():
+    class _Exploding(LocalMasterClient):
+        def get_tasks(self, *a, **kw):
+            raise ConnectionError("master gone")
+
+        def get_task(self, *a, **kw):
+            raise ConnectionError("master gone")
+
+    sc = ShardingClient(
+        dataset_name="boom-ds", batch_size=4, dataset_size=16,
+        num_minibatches_per_shard=1, master_client=_Exploding(),
+        fetch_batch=2, lookahead=2,
+    )
+    with pytest.raises(ConnectionError):
+        sc.fetch_shard(poll_interval=0.05, max_wait=10.0)
+    sc.stop()
+
+
+class _SlowReportClient(LocalMasterClient):
+    """report_task_result blocks until released; records whether the
+    ShardingClient lock was free during the RPC."""
+
+    def __init__(self):
+        super().__init__()
+        self.release = threading.Event()
+        self.in_rpc = threading.Event()
+        self.lock_free_during_rpc = None
+        self.sharding_client = None
+
+    def report_task_result(self, *a, **kw):
+        self.in_rpc.set()
+        # the satellite-1 contract: the client must NOT hold its lock
+        # across this blocking call
+        self.lock_free_during_rpc = (
+            self.sharding_client._lock.acquire(timeout=1.0)
+        )
+        if self.lock_free_during_rpc:
+            self.sharding_client._lock.release()
+        assert self.release.wait(timeout=10.0)
+        return super().report_task_result(*a, **kw)
+
+
+def test_report_batch_done_rpc_runs_outside_lock():
+    mc = _SlowReportClient()
+    sc = ShardingClient(
+        dataset_name="lock-ds", batch_size=4, dataset_size=16,
+        num_minibatches_per_shard=1, master_client=mc,
+    )
+    mc.sharding_client = sc
+    assert sc.fetch_shard() is not None
+
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(sc.report_batch_done()),
+        daemon=True,
+    )
+    t.start()
+    assert mc.in_rpc.wait(timeout=5.0)
+    # while the report RPC is blocked, stop() must not stall
+    t0 = time.monotonic()
+    sc.stop()
+    assert time.monotonic() - t0 < 0.5
+    mc.release.set()
+    t.join(timeout=5.0)
+    assert results == [True]
+    assert mc.lock_free_during_rpc is True
+
+
+def test_report_batch_done_keeps_reject_semantics():
+    class _Rejecting(LocalMasterClient):
+        def report_task_result(self, *a, **kw):
+            super().report_task_result(*a, **kw)
+            return comm.Response(success=False, reason="requeued")
+
+    sc = ShardingClient(
+        dataset_name="rej-ds", batch_size=4, dataset_size=8,
+        num_minibatches_per_shard=1, master_client=_Rejecting(),
+    )
+    assert sc.fetch_shard() is not None
+    assert sc.report_batch_done() is False
+
+
+def test_index_client_chunked_delivery():
+    mc = LocalMasterClient()
+    ic = IndexShardingClient(
+        "chunk-ds", batch_size=4, dataset_size=22,
+        num_minibatches_per_shard=1, master_client=mc,
+    )
+    first = ic.fetch_batch_indices()
+    assert isinstance(first, np.ndarray)
+    assert first.dtype == np.int64
+    got = list(first)
+    while True:
+        arr = ic.fetch_batch_indices()
+        if arr is None:
+            break
+        assert isinstance(arr, np.ndarray)
+        got.extend(arr.tolist())
+    assert sorted(got) == list(range(22))
+    assert ic.exhausted and not ic.failed
+
+
+def test_index_client_mixed_sample_and_batch_reads():
+    mc = LocalMasterClient()
+    ic = IndexShardingClient(
+        "mix-ds", batch_size=4, dataset_size=20,
+        num_minibatches_per_shard=1, master_client=mc,
+    )
+    got = [ic.fetch_sample_index(), ic.fetch_sample_index()]
+    assert all(isinstance(i, int) for i in got)
+    while True:
+        arr = ic.fetch_batch_indices(6)
+        if arr is None:
+            break
+        assert arr.size <= 6
+        got.extend(int(i) for i in arr)
+    assert sorted(got) == list(range(20))
+
+
+# --------------------------------------------------------- device prefetch
+
+
+def test_device_prefetch_propagates_producer_error():
+    from dlrover_tpu.data.shm_dataloader import DevicePrefetch
+
+    def gen():
+        yield np.ones((2, 2), np.float32)
+        raise RuntimeError("producer blew up")
+
+    pf = DevicePrefetch(gen(), depth=2)
+    it = iter(pf)
+    next(it)  # the good batch arrives
+    with pytest.raises(RuntimeError, match="producer blew up"):
+        for _ in it:
+            pass
+
+
+def test_device_prefetch_transform_runs_on_fill_thread():
+    from dlrover_tpu.data.shm_dataloader import DevicePrefetch
+
+    main_thread = threading.get_ident()
+    transform_threads = []
+
+    def reshape(batch):
+        transform_threads.append(threading.get_ident())
+        return batch.reshape(2, 2)
+
+    pf = DevicePrefetch(
+        (np.arange(4, dtype=np.float32) for _ in range(3)),
+        depth=2, transform=reshape,
+    )
+    batches = list(pf)
+    assert len(batches) == 3
+    assert all(b.shape == (2, 2) for b in batches)
+    assert transform_threads and all(
+        t != main_thread for t in transform_threads
+    )
+
+
+def test_device_prefetch_transform_error_propagates():
+    from dlrover_tpu.data.shm_dataloader import DevicePrefetch
+
+    pf = DevicePrefetch(
+        (np.arange(4) for _ in range(3)), depth=2,
+        transform=lambda b: (_ for _ in ()).throw(ValueError("bad")),
+    )
+    with pytest.raises(ValueError, match="bad"):
+        list(pf)
+
+
+# ----------------------------------------------------------------- sampler
+
+
+def test_sampler_iter_batches_matches_iter():
+    from dlrover_tpu.trainer.sampler import ElasticDistributedSampler
+
+    for kwargs in (
+        dict(dataset_size=21, num_replicas=2, rank=1, shuffle=False),
+        dict(dataset_size=32, num_replicas=4, rank=0, shuffle=True,
+             seed=3),
+        dict(dataset_size=17, num_replicas=3, rank=2, shuffle=False,
+             drop_last=True),
+    ):
+        a = ElasticDistributedSampler(**kwargs)
+        b = ElasticDistributedSampler(**kwargs)
+        per_sample = list(a)
+        chunks = list(b.iter_batches(4))
+        assert all(isinstance(c, np.ndarray) for c in chunks)
+        assert all(c.size <= 4 for c in chunks)
+        batched = (
+            np.concatenate(chunks).tolist() if chunks else []
+        )
+        assert batched == per_sample
+        assert a.completed_num == b.completed_num
+
+
+def test_sampler_iter_batches_resumes_from_state():
+    from dlrover_tpu.trainer.sampler import ElasticDistributedSampler
+
+    s = ElasticDistributedSampler(dataset_size=24, num_replicas=2,
+                                  rank=0, shuffle=False)
+    it = s.iter_batches(4)
+    first = next(it)
+    assert first.tolist() == [0, 2, 4, 6]
+    # resume a fresh sampler from the committed offset
+    s2 = ElasticDistributedSampler(dataset_size=24, num_replicas=2,
+                                   rank=0, shuffle=False)
+    s2.load_state_dict(s.state_dict())
+    rest = np.concatenate(list(s2.iter_batches(4))).tolist()
+    assert rest == [8, 10, 12, 14, 16, 18, 20, 22]
+
+
+# --------------------------------------------------------------- benchmark
+
+
+def test_shard_throughput_smoke():
+    """The benchmark's tier-1 smoke tier: runs end to end against a
+    real gRPC master with the journal on the path, delivers every
+    shard exactly once, and the batched path is not slower."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DLROVER_TPU_METRICS_PORT="off")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "shard_throughput.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = out.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["exactly_once"] is True
+    assert result["journal"] is True
+    assert result["vs_baseline"] > 1.0, result

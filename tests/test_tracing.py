@@ -665,6 +665,73 @@ def test_journal_event_names_are_snake_case_dotted():
     )
 
 
+#: span names allow a single undotted segment ("data", "dispatch" —
+#: the bench's train-thread phases predate the dotted convention);
+#: anything dotted must be fully snake-case dotted like event names
+_SPAN_NAME = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+
+def _span_call_literals():
+    """Every first-arg literal of a ``span(...)`` /
+    ``tracing.span(...)`` call in dlrover_tpu/ and bench.py, with
+    f-string constant fragments included."""
+    files = sorted((REPO_ROOT / "dlrover_tpu").rglob("*.py"))
+    files.append(REPO_ROOT / "bench.py")
+    out = []
+    for path in files:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else None
+            )
+            if name != "span":
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, str
+            ):
+                out.append((path, node.lineno, arg.value, "literal"))
+            elif isinstance(arg, ast.JoinedStr):
+                for part in arg.values:
+                    if isinstance(part, ast.Constant) and isinstance(
+                        part.value, str
+                    ):
+                        out.append(
+                            (path, node.lineno, part.value,
+                             "fragment")
+                        )
+    return out
+
+
+def test_span_names_are_canonical():
+    """ISSUE 8 companion to the event-name lint: every tracing span
+    name is a lowercase snake-case (optionally dotted) constant —
+    summarize()/dashboards match spans by exact name, so a typo'd
+    span silently vanishes from every phase breakdown."""
+    found = _span_call_literals()
+    assert len(found) >= 8, (
+        "the lint found suspiciously few span() calls — did the "
+        "instrumentation move?"
+    )
+    bad = []
+    for path, lineno, value, kind in found:
+        ok = (
+            _SPAN_NAME.match(value) if kind == "literal"
+            else _FRAGMENT.match(value)
+        )
+        if not ok:
+            bad.append(f"{path}:{lineno}: {value!r} ({kind})")
+    assert not bad, (
+        "span names must be snake-case, optionally dotted "
+        "(e.g. 'data.fetch'):\n" + "\n".join(bad)
+    )
+
+
 def _phase_usages():
     """Every literal goodput phase label in dlrover_tpu/ and bench.py:
     first-arg strings of ``.transition(...)``/``.credit(...)`` calls,
