@@ -38,6 +38,7 @@ from dlrover_tpu.common.constants import (
     TrainingExceptionLevel,
 )
 from dlrover_tpu.common.grpc_utils import find_free_port
+from dlrover_tpu.fault_tolerance.drain import DRAIN_EXIT_CODE
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.telemetry import counter, record
 from dlrover_tpu.telemetry.http import start_metrics_server
@@ -232,6 +233,19 @@ class ElasticTrainingAgent:
                             "Master heartbeat action: restart workers"
                         )
                         self._restart_requested.set()
+                    elif action == NodeAction.DRAIN:
+                        logger.warning(
+                            "Master heartbeat action: drain (platform "
+                            "reclaim ahead) — SIGTERM worker group"
+                        )
+                        record(
+                            "preempt.drain_action",
+                            node_rank=self._config.node_rank,
+                        )
+                        # SIGTERM only: the worker's DrainCoordinator
+                        # runs its notice-window sequence and exits
+                        # rc 21; this agent stays up to classify it
+                        self._signal_worker_group(signal.SIGTERM)
                     elif action == NodeAction.STOP:
                         logger.info("Master heartbeat action: stop")
                         # full stop: end the monitor loop AND kill the
@@ -312,6 +326,29 @@ class ElasticTrainingAgent:
                 logger.info("Training process succeeded")
                 return result
             if result.state == WorkerState.FAILED:
+                if result.return_code == DRAIN_EXIT_CODE:
+                    # graceful drain (fault_tolerance/drain.py): the
+                    # worker already checkpointed, relinquished its
+                    # shards and reported PREEMPTED. A local relaunch
+                    # is pointless — the host is being reclaimed.
+                    # Report PREEMPTED (idempotent with the worker's
+                    # own report_preemption; covers the race where
+                    # that RPC was lost) and exit so the master
+                    # relaunches the NODE without charging its budget.
+                    logger.warning(
+                        "Worker drained gracefully (rc=%d); node is "
+                        "being preempted", DRAIN_EXIT_CODE,
+                    )
+                    record(
+                        "preempt.worker_exit",
+                        node_rank=self._config.node_rank,
+                        restart_count=self._restart_count,
+                    )
+                    self._client.update_node_status(
+                        NodeStatus.FAILED, NodeExitReason.PREEMPTED,
+                        self._restart_count,
+                    )
+                    return result
                 self._report_failure(result)
                 if result.return_code in (137, -9):
                     # OOM-class death: a LOCAL relaunch cannot help —

@@ -471,6 +471,50 @@ class MasterClient:
             logger.warning("report_failure failed: %s", e)
 
     @supervised_rpc
+    def report_preemption(self, reason: str = "",
+                          notice_budget_s: float = 0.0,
+                          deadline_ts: float = 0.0,
+                          restart_count: int = 0):
+        """Drain step 1 (fault_tolerance/drain.py): announce the
+        reclaim notice so the master marks this node PREEMPTED, evicts
+        it from rendezvous, and relaunches budget-free. A master that
+        predates this RPC rejects the unknown message with an
+        application error — the drain proceeds without it (the
+        heartbeat watchdog still notices the death)."""
+        req = self._fill(comm.PreemptionNotice(
+            reason=reason, notice_budget_s=notice_budget_s,
+            deadline_ts=deadline_ts, restart_count=restart_count,
+        ))
+        try:
+            return self._call("report_preemption", req)
+        except Exception as e:
+            if is_connection_error(e):
+                raise
+            logger.warning("report_preemption unsupported: %s", e)
+            record("preempt.rpc_fallback", rpc="report_preemption",
+                   error=str(e)[:200])
+            return None
+
+    @supervised_rpc
+    def relinquish_shards(self, dataset_name: str = "") -> int:
+        """Drain step 3: return this node's in-flight shards to the
+        todo queue immediately (empty name = every dataset). Returns
+        the number requeued, or -1 when the master predates the RPC —
+        the task-timeout watchdog covers that case, just slower."""
+        req = self._fill(
+            comm.RelinquishShardsRequest(dataset_name=dataset_name)
+        )
+        try:
+            return int(self._call("relinquish_shards", req).requeued)
+        except Exception as e:
+            if is_connection_error(e):
+                raise
+            logger.warning("relinquish_shards unsupported: %s", e)
+            record("preempt.rpc_fallback", rpc="relinquish_shards",
+                   error=str(e)[:200])
+            return -1
+
+    @supervised_rpc
     def report_used_resource(self, cpu_percent: float, memory_mb: int,
                              tpu_stats: Optional[List[Dict]] = None):
         req = self._fill(comm.ResourceStats(
@@ -667,6 +711,14 @@ class LocalMasterClient:
 
     def report_goodput(self, final=False):
         pass
+
+    def report_preemption(self, reason="", notice_budget_s=0.0,
+                          deadline_ts=0.0, restart_count=0):
+        pass
+
+    def relinquish_shards(self, dataset_name=""):
+        self._task_manager.recover_tasks(self._node_type, self._node_id)
+        return 0
 
     def report_custom_data(self, data):
         pass

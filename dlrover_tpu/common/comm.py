@@ -378,6 +378,33 @@ class NodeAddressRequest(BaseRequest):
 
 
 @dataclass
+class PreemptionNotice(BaseRequest):
+    """Drain step 1 (fault_tolerance/drain.py): the node received a
+    reclaim notice and will die within ``notice_budget_s`` — the master
+    marks it PREEMPTED, evicts it from rendezvous immediately, and
+    relaunches without charging the relaunch budget."""
+
+    reason: str = ""  # "sigterm" | "maintenance" | ...
+    notice_budget_s: float = 0.0
+    deadline_ts: float = 0.0
+    restart_count: int = 0
+
+
+@dataclass
+class RelinquishShardsRequest(BaseRequest):
+    """Drain step 3: hand every in-flight shard of this node back to
+    the todo queue NOW instead of waiting out the task-timeout
+    watchdog. Empty ``dataset_name`` = all datasets."""
+
+    dataset_name: str = ""
+
+
+@dataclass
+class RelinquishShardsResponse(BaseMessage):
+    requeued: int = 0
+
+
+@dataclass
 class HeartBeat(BaseRequest):
     timestamp: float = 0.0
 

@@ -115,6 +115,11 @@ class NodeAction:
 
     RESTART_WORKER = "restart"
     STOP = "stop"
+    # graceful drain ahead of a platform reclaim (maintenance event):
+    # the agent SIGTERMs the worker group so its DrainCoordinator runs
+    # the notice-window sequence; the agent itself keeps running to
+    # observe and classify the rc-21 death
+    DRAIN = "drain"
 
 
 class NodeEnv:
@@ -150,6 +155,10 @@ class NodeEnv:
     # host-local persistent kernel tuning cache, co-located with the
     # compile cache (ops/tuning.py); "off" disables persistence
     TUNING_CACHE_DIR = "DLROVER_TPU_TUNING_CACHE_DIR"
+    # seconds of reclaim notice a preempted node can count on; the
+    # drain sequence (fault_tolerance/drain.py) budgets its emergency
+    # checkpoint + shard relinquish inside this window
+    PREEMPT_NOTICE_BUDGET = "DLROVER_TPU_PREEMPT_NOTICE_BUDGET"
 
 
 class TaskType:

@@ -117,6 +117,9 @@ class Node:
         self.host_ip: Optional[str] = None
         self.hang = False
         self.heartbeat_time: float = 0.0
+        # the node announced its own preemption (drain step 1) before
+        # dying: its relaunch must not charge the relaunch budget
+        self.preempt_announced = False
         # the agent's self-reported WORKER-process restart count
         # (observability only — healthy membership-change restarts
         # increment it, so it must never feed the relaunch budget)
@@ -157,15 +160,20 @@ class Node:
     def update_service_address(self, addr: str):
         self.service_addr = addr
 
-    def get_relaunch_node_info(self, new_id: int) -> "Node":
-        """Clone this node for a relaunch with a fresh id."""
+    def get_relaunch_node_info(self, new_id: int,
+                               charge_budget: bool = True) -> "Node":
+        """Clone this node for a relaunch with a fresh id. An announced
+        preemption passes ``charge_budget=False``: the reclaim is the
+        platform's doing, not the node's, so the relaunch budget stays
+        intact."""
         new_node = Node(
             node_type=self.type,
             node_id=new_id,
             config_resource=self.config_resource,
             status=NodeStatus.INITIAL,
             rank_index=self.rank_index,
-            relaunch_count=self.relaunch_count + 1,
+            relaunch_count=self.relaunch_count + (1 if charge_budget
+                                                 else 0),
             critical=self.critical,
             max_relaunch_count=self.max_relaunch_count,
         )

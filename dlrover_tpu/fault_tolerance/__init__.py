@@ -1,4 +1,13 @@
+from dlrover_tpu.fault_tolerance.drain import (
+    DRAIN_EXIT_CODE,
+    DrainCoordinator,
+)
 from dlrover_tpu.fault_tolerance.hanging_detector import HangingDetector
 from dlrover_tpu.fault_tolerance.injection import FaultInjector
 
-__all__ = ["HangingDetector", "FaultInjector"]
+__all__ = [
+    "DRAIN_EXIT_CODE",
+    "DrainCoordinator",
+    "HangingDetector",
+    "FaultInjector",
+]

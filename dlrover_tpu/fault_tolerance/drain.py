@@ -1,0 +1,393 @@
+"""Preemption-aware graceful drain.
+
+Spot/preemptible TPU-VMs die with a termination notice, not a
+negotiation: the platform delivers SIGTERM (or a maintenance-event
+flag) and reclaims the host seconds later no matter what the process
+is doing. The reference stack treats that death like any other crash —
+the master notices heartbeat loss, the task-timeout watchdog requeues
+the dead worker's shards minutes later, the rendezvous waits out its
+join timeout, and the relaunch budget is charged for a failure the
+node did not cause.
+
+:class:`DrainCoordinator` spends the notice window instead. Armed by
+the elastic trainer (or any worker loop), it turns SIGTERM into a
+deadline-budgeted drain sequence bounded by
+``DLROVER_TPU_PREEMPT_NOTICE_BUDGET`` (default 30 s):
+
+1. journal ``preempt.notice`` and report PREEMPTED to the master
+   (``report_preemption`` RPC) — the master marks the node, evicts it
+   from the rendezvous waiting/alive sets so the next round never
+   blocks on a departed peer, and flags the relaunch as budget-free;
+2. fire a deadline-bounded emergency flash checkpoint
+   (``FlashCheckpointer.save(durable=True)``); when the remaining
+   budget cannot cover the durable persist, fall back to the staged
+   RAM tier — never block past the deadline;
+3. relinquish in-flight shards (``relinquish_shards`` RPC) so the
+   ``TaskManager`` requeues them immediately instead of waiting out
+   the task-timeout watchdog;
+4. push a final goodput snapshot, chain the previously installed
+   signal disposition (the flight recorder's dump hook composes in
+   either arming order), and exit with :data:`DRAIN_EXIT_CODE` so the
+   agent classifies the death as PREEMPTED, not a crash.
+
+Every step runs in a bounded daemon thread joined against the
+remaining budget: a dead master must cost one step's slice of the
+window, never the RPC supervisor's multi-minute reconnect timeout.
+"""
+
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, record
+
+__all__ = [
+    "DRAIN_EXIT_CODE",
+    "DEFAULT_NOTICE_BUDGET_S",
+    "DURABLE_FLOOR_S",
+    "DrainCoordinator",
+    "notice_budget_from_env",
+]
+
+#: distinct from a worker crash (17), a master crash (28), a failed
+#: job (3) and an OOM kill (137): the agent maps this rc to
+#: NodeExitReason.PREEMPTED so the master's budget-free relaunch path
+#: engages even when the report_preemption RPC was lost
+DRAIN_EXIT_CODE = 21
+
+#: default termination-notice window (GCE preemptible TPU-VMs give 30s)
+DEFAULT_NOTICE_BUDGET_S = 30.0
+
+#: minimum remaining budget to attempt the DURABLE persist; below it
+#: the emergency save stays on the staged RAM tier (tmpfs archive
+#: survives the process, not the host — but a truncated durable write
+#: that the deadline guillotines helps nobody)
+DURABLE_FLOOR_S = 3.0
+
+
+def notice_budget_from_env() -> float:
+    raw = os.getenv(NodeEnv.PREEMPT_NOTICE_BUDGET, "").strip()
+    if not raw:
+        return DEFAULT_NOTICE_BUDGET_S
+    try:
+        budget = float(raw)
+    except ValueError:
+        logger.warning(
+            "bad %s=%r; using %.0fs",
+            NodeEnv.PREEMPT_NOTICE_BUDGET, raw, DEFAULT_NOTICE_BUDGET_S,
+        )
+        return DEFAULT_NOTICE_BUDGET_S
+    return budget if budget > 0 else DEFAULT_NOTICE_BUDGET_S
+
+
+class DrainCoordinator:
+    """Turns a termination notice into a bounded drain sequence.
+
+    ``state_provider`` returns ``(step, state)`` for the emergency
+    checkpoint, or ``None`` when no state is available yet; it is read
+    AT SIGNAL TIME, so arming can happen before the first step.
+    ``checkpointer_fn``/``master_client_fn`` are also lazy for the same
+    reason. ``exit_fn`` exists for tests (the real one never returns).
+    """
+
+    def __init__(
+        self,
+        master_client_fn: Callable[[], Any] = lambda: None,
+        checkpointer_fn: Callable[[], Any] = lambda: None,
+        state_provider: Optional[
+            Callable[[], Optional[Tuple[int, Any]]]
+        ] = None,
+        notice_budget_s: Optional[float] = None,
+        restart_count: int = 0,
+        exit_fn: Callable[[int], None] = os._exit,
+    ):
+        self._master_client_fn = master_client_fn
+        self._checkpointer_fn = checkpointer_fn
+        self._state_provider = state_provider
+        self._budget = (
+            notice_budget_s if notice_budget_s and notice_budget_s > 0
+            else notice_budget_from_env()
+        )
+        self._restart_count = restart_count
+        self._exit_fn = exit_fn
+        self._prev = {}  # signum -> pre-arm disposition
+        self._lock = threading.Lock()
+        self._draining = threading.Event()
+        self._armed = False
+
+    # ------------------------------------------------------------- wiring
+
+    def set_state_provider(
+        self, provider: Callable[[], Optional[Tuple[int, Any]]]
+    ) -> None:
+        self._state_provider = provider
+
+    @property
+    def notice_budget_s(self) -> float:
+        return self._budget
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    # ------------------------------------------------------------- arming
+
+    def arm(self, signums=(signal.SIGTERM,)) -> bool:
+        """Install the drain handler, chaining whatever disposition was
+        there before (flight recorder included). Idempotent; returns
+        False off the main thread (CPython restricts signal.signal)."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        armed = False
+        with self._lock:
+            for signum in signums:
+                if signum in self._prev:
+                    armed = True
+                    continue
+                try:
+                    prev = signal.signal(signum, self._on_signal)
+                except (ValueError, OSError) as e:
+                    logger.warning(
+                        "drain handler for signal %s failed: %s",
+                        signum, e,
+                    )
+                    continue
+                self._prev[signum] = prev
+                armed = True
+            self._armed = self._armed or armed
+        return armed
+
+    def disarm(self) -> None:
+        """Restore pre-arm dispositions (tests)."""
+        with self._lock:
+            for signum, prev in list(self._prev.items()):
+                try:
+                    signal.signal(
+                        signum, prev if prev is not None else signal.SIG_DFL
+                    )
+                except (ValueError, OSError):
+                    pass
+                del self._prev[signum]
+            self._armed = False
+
+    # ------------------------------------------------------------ sequence
+
+    def _on_signal(self, signum, frame):
+        if self._draining.is_set():
+            # a second notice mid-drain adds nothing; the reclaim
+            # deadline is already running
+            return
+        try:
+            name = signal.Signals(signum).name
+        except (ValueError, AttributeError):
+            name = str(signum)
+        self.drain(reason=f"signal-{name.lower()}")
+        self._chain_prev(signum, frame)
+        self._exit_fn(DRAIN_EXIT_CODE)
+
+    def trigger(self, reason: str = "maintenance") -> None:
+        """Non-signal entry (maintenance notices): run the sequence and
+        exit. Never returns with the default ``exit_fn``."""
+        if self._draining.is_set():
+            return
+        self.drain(reason=reason)
+        self._exit_fn(DRAIN_EXIT_CODE)
+
+    def drain(self, reason: str = "sigterm") -> dict:
+        """The bounded sequence itself; returns a result dict (tests).
+        Never raises, never blocks past the notice deadline."""
+        self._draining.set()
+        deadline = time.monotonic() + self._budget
+        result = {"reason": reason, "budget_s": self._budget}
+        step_state = None
+        try:
+            if self._state_provider is not None:
+                step_state = self._state_provider()
+        except Exception as e:
+            logger.warning("drain state provider failed: %s", e)
+        step = step_state[0] if step_state else -1
+        record(
+            "preempt.notice", reason=reason, step=step,
+            notice_budget_s=self._budget,
+            restart_count=self._restart_count,
+        )
+        counter(
+            "dlrover_preemptions_total",
+            "Termination notices handled by the drain sequence",
+            ["reason"],
+        ).labels(reason=reason[:40]).inc()
+        logger.warning(
+            "PREEMPTION NOTICE (%s): draining with %.1fs budget",
+            reason, self._budget,
+        )
+
+        # 1. tell the master first: rendezvous eviction and the
+        # budget-free relaunch flag must land even if the rest of the
+        # window is lost
+        result["reported"] = self._bounded(
+            "report", deadline,
+            lambda: self._report_preemption(reason, deadline),
+        )
+        # 2. emergency checkpoint with whatever budget remains
+        result["checkpoint"] = self._emergency_checkpoint(
+            step_state, deadline
+        )
+        # 3. hand in-flight shards back NOW, not at watchdog timeout
+        result["relinquished"] = self._bounded(
+            "relinquish", deadline, self._relinquish_shards
+        )
+        # 4. final goodput snapshot closes the incarnation under the
+        # preempt cause instead of an open-ended restart window
+        result["goodput"] = self._bounded(
+            "goodput", deadline, self._final_goodput
+        )
+        record(
+            "preempt.drained", reason=reason, step=step,
+            remaining_s=round(max(0.0, deadline - time.monotonic()), 3),
+            reported=bool(result.get("reported", {}).get("ok")),
+            relinquished=result.get("relinquished", {}).get("value"),
+        )
+        return result
+
+    # ------------------------------------------------------------- steps
+
+    def _report_preemption(self, reason: str, deadline: float):
+        client = self._master_client_fn()
+        if client is None:
+            return None
+        return client.report_preemption(
+            reason=reason,
+            notice_budget_s=self._budget,
+            deadline_ts=time.time() + max(0.0, deadline - time.monotonic()),
+            restart_count=self._restart_count,
+        )
+
+    def _emergency_checkpoint(self, step_state, deadline: float) -> dict:
+        out = {"attempted": False, "ok": False, "durable": False}
+        ckpt = self._checkpointer_fn()
+        if ckpt is None or not step_state:
+            return out
+        step, state = step_state
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return out
+        # durable (drain the persist pipeline) only when the window can
+        # plausibly cover it; otherwise the staged RAM tier is the best
+        # checkpoint a guillotined process can leave behind
+        durable = remaining > DURABLE_FLOOR_S
+        out.update(attempted=True, durable=durable, step=step)
+        t0 = time.monotonic()
+
+        def save():
+            stall_ms = ckpt.save(
+                step, state, force_persist=True, durable=durable
+            )
+            if durable:
+                # save(durable=True) drains to the RAM tier only, and
+                # tmpfs dies with the reclaimed host: the forced
+                # persist must land on the durable store too
+                wait = getattr(ckpt, "wait", None)
+                if wait is not None:
+                    wait()
+            return stall_ms
+
+        res = self._bounded("emergency_ckpt", deadline, save)
+        out["ok"] = bool(res.get("ok"))
+        out["timed_out"] = bool(res.get("timed_out"))
+        record(
+            "preempt.emergency_ckpt", step=step, durable=durable,
+            ok=out["ok"], timed_out=out["timed_out"],
+            elapsed_s=round(time.monotonic() - t0, 3),
+        )
+        return out
+
+    def _relinquish_shards(self):
+        client = self._master_client_fn()
+        if client is None:
+            return None
+        return client.relinquish_shards()
+
+    def _final_goodput(self):
+        client = self._master_client_fn()
+        if client is None:
+            return None
+        return client.report_goodput(final=True)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _bounded(self, name: str, deadline: float,
+                 fn: Callable[[], Any]) -> dict:
+        """Run ``fn`` in a daemon thread joined against the remaining
+        budget. A hung RPC (dead master behind the reconnect
+        supervisor) costs this step's slice of the window, nothing
+        more; the abandoned thread cannot outlive the imminent exit."""
+        out = {"ok": False, "timed_out": False, "value": None}
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            out["timed_out"] = True
+            record("preempt.step_skipped", step_name=name)
+            return out
+
+        def run():
+            try:
+                out["value"] = fn()
+                out["ok"] = True
+            except Exception as e:
+                out["error"] = str(e)[:200]
+                logger.warning("drain step %s failed: %s", name, e)
+
+        t = threading.Thread(
+            target=run, name=f"drain-{name}", daemon=True
+        )
+        t.start()
+        t.join(remaining)
+        if t.is_alive():
+            out["timed_out"] = True
+            record(
+                "preempt.step_timeout", step_name=name,
+                waited_s=round(remaining, 3),
+            )
+            logger.warning(
+                "drain step %s still running at deadline (waited "
+                "%.1fs); moving on", name, remaining,
+            )
+        return out
+
+    def _chain_prev(self, signum, frame) -> None:
+        """Compose with the pre-arm disposition. The flight recorder's
+        hook is special-cased in BOTH directions: when it was installed
+        first (we chained onto it), calling it back would re-deliver
+        the signal after its own chain bottoms out on SIG_DFL and kill
+        the process with the wrong rc — dump directly instead."""
+        prev = self._prev.get(signum)
+        if prev in (None, signal.SIG_IGN, signal.SIG_DFL):
+            return
+        if (
+            getattr(prev, "__func__", None) is DrainCoordinator._on_signal
+        ):
+            # another coordinator armed earlier in this process (the
+            # trainer's, say): the drain has already run once, and
+            # invoking the older handler would start a second sequence
+            # and hard-exit through ITS exit_fn
+            return
+        try:
+            from dlrover_tpu.telemetry import flight_recorder
+
+            if prev is flight_recorder._on_signal:
+                flight_recorder.dump_flight_record(
+                    "preempt-drain"
+                )
+                return
+        except Exception:
+            pass
+        if callable(prev):
+            try:
+                prev(signum, frame)
+            except Exception as e:
+                logger.warning(
+                    "chained signal handler failed: %s", e
+                )

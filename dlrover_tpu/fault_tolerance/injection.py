@@ -19,6 +19,11 @@ Two triggers:
   - ``preempt@5``                   SIGTERM own process group (spot-VM
                                     reclaim shape: agent sees a signal
                                     death, not a Python traceback)
+  - ``preempt@5:notice=5``          SIGTERM now, hard SIGKILL reclaim
+                                    5 s later — the termination-notice
+                                    window the graceful drain
+                                    (fault_tolerance/drain.py) must
+                                    beat
   - ``master_crash@5`` / ``master_crash@5:2``  kill the JOB MASTER
                                     (rc 28) once the reported global
                                     step reaches 5, after an optional
@@ -43,6 +48,7 @@ Two triggers:
 
 import os
 import signal
+import threading
 import time
 from dataclasses import dataclass
 from typing import List, Optional
@@ -63,6 +69,31 @@ MASTER_KINDS = frozenset({"master_crash"})
 #: (main.JOB_FAILED_EXIT_CODE=3): the operator should see a master
 #: CRASH and relaunch it against the same state dir
 MASTER_CRASH_EXIT_CODE = 28
+
+
+def _signal_own_group(sig: int) -> None:
+    """Signal the whole process group, like a real node preemption
+    (coworker loaders die with the trainer) — but ONLY when this
+    process leads its own group (the agent spawns workers with
+    start_new_session); in a shared group, group-wide delivery would
+    kill the supervisor that must observe the death and relaunch."""
+    try:
+        if os.getpgid(0) == os.getpid():
+            os.killpg(os.getpgid(0), sig)
+        else:
+            os.kill(os.getpid(), sig)
+    except (OSError, PermissionError):
+        os.kill(os.getpid(), sig)
+
+
+def _reclaim_after(notice: float) -> None:
+    """The platform's hard deadline: nothing the process does extends
+    it. SIGKILL, so not even a signal handler can intercept."""
+    time.sleep(notice)
+    print(
+        f"INJECTED RECLAIM after {notice}s notice window", flush=True,
+    )
+    _signal_own_group(signal.SIGKILL)
 
 
 @dataclass
@@ -223,18 +254,26 @@ class FaultInjector:
                 fault.arg or f"injected error at step {step}"
             )
         elif fault.kind == "preempt":
-            print(f"INJECTED PREEMPTION at step {step}", flush=True)
-            try:
-                # the whole process group, like a real node preemption
-                # (coworker loaders die with the trainer) — but ONLY
-                # when this trainer leads its own group (the agent
-                # spawns workers with start_new_session); in a shared
-                # group, group-wide SIGTERM would kill the supervisor
-                # that must observe the death and relaunch
-                if os.getpgid(0) == os.getpid():
-                    os.killpg(os.getpgid(0), signal.SIGTERM)
-                else:
-                    os.kill(os.getpid(), signal.SIGTERM)
-            except (OSError, PermissionError):
-                os.kill(os.getpid(), signal.SIGTERM)
-            time.sleep(30)  # await delivery
+            # arg ``notice=N``: the platform's termination-notice
+            # window — SIGTERM now, hard SIGKILL reclaim N seconds
+            # later, the spot-VM preemption shape the drain sequence
+            # (fault_tolerance/drain.py) must beat. Without it the
+            # process only gets the SIGTERM (legacy drills).
+            notice = None
+            for kv in fault.arg.split(","):
+                k, _, v = kv.partition("=")
+                if k.strip() == "notice" and v.strip():
+                    notice = float(v)
+            print(
+                f"INJECTED PREEMPTION at step {step} "
+                f"(notice={notice})", flush=True,
+            )
+            if notice is not None:
+                threading.Thread(
+                    target=_reclaim_after, args=(notice,),
+                    name="preempt-reclaim", daemon=True,
+                ).start()
+            _signal_own_group(signal.SIGTERM)
+            # await delivery; the drain handler (or the reclaim
+            # thread) ends the process before this returns
+            time.sleep(notice + 10 if notice is not None else 30)

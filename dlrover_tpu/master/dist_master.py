@@ -42,6 +42,12 @@ from dlrover_tpu.telemetry import goodput as goodput_mod
 from dlrover_tpu.telemetry import record
 from dlrover_tpu.telemetry.http import start_metrics_server
 
+#: how long the servicer stays up after the last data task completes:
+#: must cover a full WAIT-poll cycle of the sharding client (0.5s)
+#: plus scheduling slack, so every worker sees the dataset drain
+#: instead of a dead socket
+_COMPLETION_GRACE = 2.0
+
 
 class DistributedJobMaster:
     """Composes every master-side manager and runs the job loop.
@@ -339,7 +345,14 @@ class DistributedJobMaster:
                 if self.task_manager.finished():
                     logger.info("All data tasks done; stopping master")
                     self._exit_reason = JobExitReason.SUCCEEDED
-                    self._broadcast_stop(check_interval)
+                    # workers poll get_tasks on a ~0.5s WAIT cycle: the
+                    # server must outlive the completion long enough
+                    # for every poller to observe the drained dataset
+                    # ([] response) — a socket that dies first costs
+                    # them the full reconnect-supervisor timeout
+                    self._broadcast_stop(
+                        max(check_interval, _COMPLETION_GRACE)
+                    )
                     break
                 if self.job_manager.all_running_node_hanged():
                     logger.error("All nodes hang; failing the job")

@@ -30,6 +30,7 @@ from dlrover_tpu.master.node.status_flow import get_node_state_flow
 from dlrover_tpu.master.node.training_node import TrainingNodeManager
 from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
 from dlrover_tpu.master.watcher.base_watcher import NodeEvent, NodeWatcher
+from dlrover_tpu.telemetry import record
 
 
 class DistributedJobManager:
@@ -168,6 +169,16 @@ class DistributedJobManager:
         mgr = self._node_managers.setdefault(
             node.type, TrainingNodeManager(node.type)
         )
+        # scheduler maintenance warning (tpu_vm_watcher): the VM is
+        # still RUNNING but will be reclaimed — issue the graceful
+        # DRAIN directive once, before any status-flow gating (there
+        # is no status transition to gate on)
+        if getattr(node, "maintenance_pending", False):
+            known = mgr.get_node(node.id)
+            if known is not None and not known.preempt_announced:
+                self.request_node_drain(
+                    node.type, node.id, reason="maintenance"
+                )
         with self._lock:
             cur = mgr.get_node(node.id)
             if cur is None:
@@ -292,18 +303,71 @@ class DistributedJobManager:
         """parity: dist_job_manager.py:512 _relaunch_node."""
         mgr = self._node_managers[node.type]
         new_id = mgr.next_node_id()
-        new_node = node.get_relaunch_node_info(new_id)
+        # an ANNOUNCED preemption relaunches for free: the platform
+        # reclaimed the host, the node did nothing wrong
+        charge = not (
+            node.preempt_announced
+            and node.exit_reason == NodeExitReason.PREEMPTED
+        )
+        new_node = node.get_relaunch_node_info(new_id,
+                                               charge_budget=charge)
         mgr.add_node(new_node)
         node.is_released = True
         logger.info(
-            "Relaunch %s -> %s (count %d, reason %s)",
+            "Relaunch %s -> %s (count %d, reason %s%s)",
             node.name, new_node.name, new_node.relaunch_count,
-            node.exit_reason,
+            node.exit_reason, "" if charge else ", budget uncharged",
         )
+        if not charge:
+            record(
+                "preempt.relaunched", node=node.name,
+                new_node=new_node.name,
+                relaunch_count=new_node.relaunch_count,
+                max_relaunch_count=new_node.max_relaunch_count,
+            )
         if self._scaler:
             self._scaler.scale(ScalePlan(
                 launch_nodes=[new_node], remove_nodes=[node],
             ))
+
+    def handle_preemption_notice(self, node_type: str, node_id: int,
+                                 reason: str = ""):
+        """Drain step 1 landed: the node is still alive but will die
+        within its notice window. Remember the announcement so the
+        eventual FAILED transition relaunches without charging the
+        relaunch budget, and so the heartbeat watchdog doesn't relabel
+        the death as KILLED."""
+        node = self.get_node(node_type, node_id)
+        if node is None:
+            # externally-launched node (drill / custom placement) the
+            # scaler never registered: create it so the relaunch
+            # policy has a node to clone
+            self.update_node_status(node_type, node_id,
+                                    NodeStatus.RUNNING)
+            node = self.get_node(node_type, node_id)
+        if node is None:
+            return
+        node.preempt_announced = True
+        node.set_exit_reason(NodeExitReason.PREEMPTED)
+        logger.info(
+            "Preemption notice from %s (%s); relaunch will not charge "
+            "the budget (%d/%d used)", node.name, reason or "unknown",
+            node.relaunch_count, node.max_relaunch_count,
+        )
+
+    def request_node_drain(self, node_type: str, node_id: int,
+                           reason: str = ""):
+        """Master-initiated drain (scheduler maintenance signal): mark
+        the announcement now and deliver a DRAIN directive on the
+        node's next heartbeat — the agent SIGTERMs its worker group so
+        the in-process DrainCoordinator spends the notice window."""
+        self.handle_preemption_notice(node_type, node_id, reason)
+        with self._lock:
+            self._pending_actions[(node_type, node_id)] = NodeAction.DRAIN
+        record(
+            "preempt.drain_requested", node_type=node_type,
+            node_id=node_id, reason=reason,
+        )
 
     # -- heartbeat / hang detection --------------------------------------
 
@@ -364,7 +428,11 @@ class DistributedJobManager:
         """A hung node's PROCESS is still alive: relaunch_node's plan
         removes it; when relaunch is declined the removal must still be
         issued explicitly (parity with the process_event FAILED path)."""
-        node.set_exit_reason(NodeExitReason.KILLED)
+        if not node.preempt_announced:
+            # a node that announced its preemption and then went silent
+            # died of the reclaim, not of a hang — keep PREEMPTED so
+            # the relaunch stays budget-free
+            node.set_exit_reason(NodeExitReason.KILLED)
         relaunchable = self._should_relaunch(node)
         node.update_status(NodeStatus.FAILED)
         node.heartbeat_time = 0.0

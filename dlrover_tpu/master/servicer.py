@@ -61,6 +61,9 @@ class MasterServicer:
         self._kv_store = kv_store or KVStoreService()
         self._start_training_time = 0.0
         self.run_configs = {}
+        # ranks with an announced preemption in flight: their next
+        # RUNNING report closes the goodput fault window
+        self._preempted_ranks = set()
 
     def _running_nodes(self):
         """Deferred node-list snapshot for the stats collector: only
@@ -315,6 +318,17 @@ class MasterServicer:
 
     # ---------------------------------------------------------- node status
 
+    def _rank_of(self, node_type: str, node_id: int) -> int:
+        """Rendezvous sets are keyed by node RANK (agents join with
+        their rank); a relaunched node has a fresh id but keeps its
+        rank."""
+        rank = node_id
+        if self._job_manager:
+            node = self._job_manager.get_node(node_type, node_id)
+            if node is not None and node.rank_index is not None:
+                rank = node.rank_index
+        return rank
+
     def rpc_update_node_status(
         self, req: comm.NodeStatusRequest
     ) -> comm.Response:
@@ -323,19 +337,74 @@ class MasterServicer:
                 req.node_type, req.node_id, req.status, req.exit_reason,
                 req.restart_count,
             )
-        # rendezvous sets are keyed by node RANK (agents join with their
-        # rank); a relaunched node has a fresh id but keeps its rank
-        rank = req.node_id
-        if self._job_manager:
-            node = self._job_manager.get_node(req.node_type, req.node_id)
-            if node is not None and node.rank_index is not None:
-                rank = node.rank_index
+        rank = self._rank_of(req.node_type, req.node_id)
         for mgr in self._rdzv_managers.values():
             if req.status == "succeeded":
                 mgr.mark_node_succeeded(rank)
             elif req.status in ("failed", "deleted"):
                 mgr.remove_alive_node(rank)
+        if req.status == "running" and rank in self._preempted_ranks:
+            # the relaunched incarnation is back: the preemption window
+            # closes here for MTTR accounting
+            self._preempted_ranks.discard(rank)
+            if self._goodput is not None:
+                self._goodput.mark_recovered("preempt")
+            record(
+                "preempt.recovered", node_type=req.node_type,
+                node_id=req.node_id, rank=rank,
+            )
         return comm.Response(success=True)
+
+    def rpc_report_preemption(
+        self, req: comm.PreemptionNotice
+    ) -> comm.Response:
+        """Drain step 1 lands here while the node is still alive: mark
+        it PREEMPTED, evict its rank from every rendezvous so the next
+        round never waits on a departed peer, and schedule a relaunch
+        that does NOT burn the node's relaunch budget
+        (fault_tolerance/drain.py)."""
+        record(
+            "preempt.reported", node_type=req.node_type,
+            node_id=req.node_id, reason=req.reason,
+            notice_budget_s=req.notice_budget_s,
+            restart_count=req.restart_count,
+        )
+        counter(
+            "dlrover_preemptions_reported_total",
+            "Preemption notices received from draining nodes",
+        ).inc()
+        rank = self._rank_of(req.node_type, req.node_id)
+        self._preempted_ranks.add(rank)
+        if self._job_manager:
+            handle = getattr(
+                self._job_manager, "handle_preemption_notice", None
+            )
+            if handle is not None:
+                handle(req.node_type, req.node_id, req.reason)
+        # instant rendezvous eviction: waiting AND alive sets, so a
+        # round forming right now re-forms without the departing peer
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(rank)
+        if self._goodput is not None:
+            self._goodput.note_fault(cause="preempt", node_id=req.node_id)
+        return comm.Response(success=True)
+
+    def rpc_relinquish_shards(
+        self, req: comm.RelinquishShardsRequest
+    ) -> comm.RelinquishShardsResponse:
+        """Drain step 3: requeue the draining node's in-flight shards
+        immediately (group-committed) instead of waiting out the
+        task-timeout watchdog."""
+        requeued = 0
+        if self._task_manager is not None:
+            requeued = self._task_manager.relinquish_tasks(
+                req.node_type, req.node_id, dataset_name=req.dataset_name
+            )
+        record(
+            "preempt.relinquished", node_type=req.node_type,
+            node_id=req.node_id, requeued=requeued,
+        )
+        return comm.RelinquishShardsResponse(requeued=requeued)
 
     def rpc_update_node_address(
         self, req: comm.NodeAddressRequest

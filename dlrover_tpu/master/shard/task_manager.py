@@ -224,6 +224,32 @@ class TaskManager:
                             ids, node_id, name,
                         )
 
+    def relinquish_tasks(self, node_type: str, node_id: int,
+                         dataset_name: str = "") -> int:
+        """Proactive drain handoff (fault_tolerance/drain.py): requeue
+        the node's in-flight tasks NOW, group-committed through the
+        state journal, instead of waiting out the task-timeout
+        watchdog. Exactly-once unchanged: a late completion report for
+        a requeued task is rejected by ``report_task_status``. Empty
+        ``dataset_name`` covers every dataset; returns the requeue
+        count."""
+        requeued = 0
+        with self._lock:
+            for name, ds in self._datasets.items():
+                if dataset_name and name != dataset_name:
+                    continue
+                recover = getattr(ds, "recover_tasks_of_node", None)
+                if recover:
+                    ids = recover(node_id)
+                    if ids:
+                        requeued += len(ids)
+                        self._persist(name)
+                        logger.info(
+                            "Relinquished tasks %s of node %s in "
+                            "dataset %s", ids, node_id, name,
+                        )
+        return requeued
+
     def finished(self) -> bool:
         """All registered datasets have dispatched and completed all tasks."""
         if not self._datasets:

@@ -12,6 +12,9 @@ maps VM states to the node status/exit-reason model:
   PREEMPTED                     -> FAILED, exit PREEMPTED (relaunch)
   REPAIRING / unhealthy         -> FAILED, exit HARDWARE_ERROR
                                    (relaunch on a fresh VM)
+  READY + UNHEALTHY_MAINTENANCE -> RUNNING + maintenance_pending (the
+                                   job manager issues a graceful DRAIN
+                                   directive, not a failure)
   TERMINATED/STOPPED            -> FAILED, exit KILLED
   DELETING / gone               -> DELETED
 """
@@ -53,13 +56,21 @@ def vm_to_node(rec: TpuVmRecord) -> Optional[Node]:
     status, exit_reason = _STATE_MAP.get(
         rec.state, (NodeStatus.UNKNOWN, "")
     )
-    if status == NodeStatus.RUNNING and rec.get("health") not in (
-        None, "", "HEALTHY", "HEALTH_UNSPECIFIED",
-    ):
-        # chips up but unhealthy (e.g. UNHEALTHY_TPU / UNHEALTHY_MAINTENANCE)
-        status, exit_reason = (
-            NodeStatus.FAILED, NodeExitReason.HARDWARE_ERROR,
-        )
+    maintenance = False
+    if status == NodeStatus.RUNNING:
+        health = rec.get("health")
+        if health == "UNHEALTHY_MAINTENANCE":
+            # chips still up, platform maintenance imminent: NOT a
+            # failure yet — the job manager turns this into a graceful
+            # DRAIN directive (fault_tolerance/drain.py) so the worker
+            # spends its notice window checkpointing and handing back
+            # shards instead of dying mid-step
+            maintenance = True
+        elif health not in (None, "", "HEALTHY", "HEALTH_UNSPECIFIED"):
+            # chips up but unhealthy (e.g. UNHEALTHY_TPU)
+            status, exit_reason = (
+                NodeStatus.FAILED, NodeExitReason.HARDWARE_ERROR,
+            )
     node = Node(
         labels.get("dlrover-type", NodeType.WORKER),
         int(node_id),
@@ -68,6 +79,7 @@ def vm_to_node(rec: TpuVmRecord) -> Optional[Node]:
         rank_index=int(labels.get("dlrover-rank", node_id)),
         start_time=rec.get("create_time"),
     )
+    node.maintenance_pending = maintenance
     if exit_reason:
         node.set_exit_reason(exit_reason)
     return node
@@ -108,7 +120,11 @@ class TpuVmWatcher(NodeWatcher):
         events: List[NodeEvent] = []
         current = self._snapshot()
         for name, node in current.items():
-            key = (node.status, node.exit_reason or "")
+            # maintenance_pending is part of the diff key: the status
+            # stays RUNNING when it flips on, and the MODIFIED event
+            # is what carries the drain signal to the job manager
+            key = (node.status, node.exit_reason or "",
+                   getattr(node, "maintenance_pending", False))
             if name not in self._known:
                 events.append(NodeEvent(NodeEventType.ADDED, node))
             elif self._known[name] != key:

@@ -77,19 +77,20 @@ class Phase:
     CKPT_STALL = "ckpt_stall"  # train thread blocked on checkpointing
     HANG = "hang"              # stall window flagged by the detector
     RESTART = "restart"        # fault-to-recovery (incl. master loss)
+    PREEMPT = "preempt"        # reclaim notice -> drain -> relaunch
     IDLE = "idle"              # unattributed
 
 
 PHASES: Tuple[str, ...] = (
     Phase.INIT, Phase.RENDEZVOUS, Phase.TRAINING, Phase.CKPT_STALL,
-    Phase.HANG, Phase.RESTART, Phase.IDLE,
+    Phase.HANG, Phase.RESTART, Phase.PREEMPT, Phase.IDLE,
 )
 
 #: badput breakdown keys: every phase that is neither useful training
 #: nor unattributed
 BADPUT_CAUSES: Tuple[str, ...] = (
     Phase.INIT, Phase.RENDEZVOUS, Phase.CKPT_STALL, Phase.HANG,
-    Phase.RESTART,
+    Phase.RESTART, Phase.PREEMPT,
 )
 
 
@@ -128,7 +129,7 @@ class PhaseLedger:
             ts = self._now(ts)
             self._totals[self._phase] += max(0.0, ts - self._mark)
             prev = self._phase
-            if prev not in (Phase.HANG, Phase.RESTART):
+            if prev not in (Phase.HANG, Phase.RESTART, Phase.PREEMPT):
                 # a fault phase ends by returning to what it interrupted
                 self._resume_phase = prev
             self._phase = phase
@@ -281,6 +282,10 @@ EVENT_RULES: Dict[str, Callable[[PhaseLedger, float, Dict], None]] = {
         lambda led, ts, data: led.transition(Phase.RESTART, ts=ts),
     "rendezvous.joined":
         _on_rdzv_joined,
+    # the drain sequence opens the preempt window; the process dies in
+    # it, and the master books the relaunch gap under the same cause
+    "preempt.notice":
+        lambda led, ts, data: led.transition(Phase.PREEMPT, ts=ts),
 }
 
 
@@ -546,15 +551,24 @@ def summarize(procs: Dict[str, Dict[str, Any]],
         for ph in PHASES:
             node["phases"][ph] += p["phases"].get(ph, 0.0)
 
+    # nodes with an announced preemption: their un-ledgered relaunch
+    # gap is preempt badput, not a generic restart
+    preempted_nodes = {
+        f.get("node_id") for f in faults
+        if f.get("cause") == Phase.PREEMPT and f.get("node_id") is not None
+    }
+
     phases = {ph: 0.0 for ph in PHASES}
     wall = 0.0
-    for node in nodes.values():
+    for node_id, node in nodes.items():
         node_wall = max(0.0, node["last_end"] - node["first_start"])
         # the un-ledgered window between incarnations: nobody was alive
         # to attribute it, and the only way to be dead mid-job is a
-        # restart in flight
+        # restart (or announced preemption) in flight
         gap = max(0.0, node_wall - node["covered_s"])
-        node["phases"][Phase.RESTART] += gap
+        gap_cause = (Phase.PREEMPT if node_id in preempted_nodes
+                     else Phase.RESTART)
+        node["phases"][gap_cause] += gap
         node["wall_s"] = round(node_wall, 6)
         node["restart_gap_s"] = round(gap, 6)
         node["goodput_percent"] = _pct(

@@ -1142,6 +1142,22 @@ class FlashCheckpointer:
             except (KeyError, ckpt_store.ArchiveError) as e:
                 # missing OR corrupt: keep walking down — an unreadable
                 # newest step must not abort the promised fallback
+                if isinstance(e, ckpt_store.DigestMismatchError):
+                    reason = "digest_mismatch"
+                elif isinstance(e, ckpt_store.ArchiveError):
+                    reason = "archive_error"
+                else:
+                    reason = "missing"
+                record(
+                    "checkpoint.restore_fallback", step=cand,
+                    requested_step=step, reason=reason,
+                    error=str(e)[:200],
+                )
+                counter(
+                    "dlrover_ckpt_restore_fallbacks_total",
+                    "Persist-tier restore candidates rejected during "
+                    "the walk-down", ["reason"],
+                ).labels(reason=reason).inc()
                 logger.warning(
                     "Persist step %d unusable (%s); trying older", cand, e,
                 )

@@ -32,6 +32,7 @@ intent: a spare host must be able to read a dead host's state — local
 disk cannot provide that).
 """
 
+import hashlib
 import io
 import json
 import os
@@ -44,6 +45,7 @@ import numpy as np
 
 __all__ = [
     "ArchiveError",
+    "DigestMismatchError",
     "ObjectStore",
     "LocalFsStore",
     "GcsStore",
@@ -60,6 +62,31 @@ _STREAM_CHUNK = 1 << 20
 
 class ArchiveError(ValueError):
     """A checkpoint archive failed validation; never executed."""
+
+
+class DigestMismatchError(ArchiveError):
+    """An archive member's content hash differs from the sha256 the
+    writer recorded in the manifest: silent corruption (torn object,
+    bit rot, truncated upload). Restore treats the candidate as
+    unusable and walks down to an older step."""
+
+
+class _HashingWriter:
+    """Tee writes into a hash while streaming a member into the zip —
+    the digest costs no extra pass over the data at save time."""
+
+    def __init__(self, inner: BinaryIO, digest):
+        self._inner = inner
+        self._digest = digest
+
+    def write(self, data):
+        self._digest.update(data)
+        return self._inner.write(data)
+
+    def flush(self):
+        flush = getattr(self._inner, "flush", None)
+        if flush is not None:
+            flush()
 
 
 # --------------------------------------------------------------------------
@@ -137,6 +164,11 @@ def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO) -> int:
         # bytes + a recorded dtype name: numpy's .npy descr cannot
         # carry ml_dtypes types (they load back as void)
         "encodings": {},
+        # member name -> sha256 of its serialized bytes: restore
+        # verifies before trusting the content and walks down the
+        # candidate chain on mismatch (archives written before this
+        # field existed simply skip verification)
+        "digests": {},
     }
     counter = [0]
 
@@ -161,8 +193,12 @@ def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO) -> int:
                 # ascontiguousarray only when needed: it promotes 0-d
                 # scalars to 1-d, which would corrupt shard shapes
                 arr = np.ascontiguousarray(arr)
+            digest = hashlib.sha256()
             with zf.open(name + ".npy", "w", force_zip64=True) as m:
-                np.lib.format.write_array(m, arr, allow_pickle=False)
+                np.lib.format.write_array(
+                    _HashingWriter(m, digest), arr, allow_pickle=False
+                )
+            manifest["digests"][name + ".npy"] = digest.hexdigest()
             return name
 
         for path, leaf in leaves:
@@ -212,6 +248,7 @@ def _load_archive_file(fileobj: BinaryIO):
     try:
         with zipfile.ZipFile(fileobj) as zf:
             manifest = json.loads(zf.read(_MANIFEST).decode("utf-8"))
+            _verify_digests(zf, manifest)
         fileobj.seek(0)
         arrays = np.load(fileobj, allow_pickle=False)
         # materialize while the file object is open
@@ -245,6 +282,29 @@ def _load_archive_file(fileobj: BinaryIO):
                 f"encoding: {e}"
             )
     return manifest, arrays
+
+
+def _verify_digests(zf: zipfile.ZipFile, manifest) -> None:
+    """Check every member the manifest carries a sha256 for. Members
+    without a recorded digest (pre-digest archives) are accepted as-is
+    — integrity is an upgrade, not a compatibility break."""
+    digests = manifest.get("digests") or {}
+    if not isinstance(digests, dict):
+        raise ArchiveError("archive digests field malformed")
+    members = set(zf.namelist())
+    for member, want in digests.items():
+        if member not in members:
+            raise ArchiveError(f"archive missing member {member!r}")
+        h = hashlib.sha256()
+        with zf.open(member) as m:
+            for chunk in iter(lambda: m.read(_STREAM_CHUNK), b""):
+                h.update(chunk)
+        if h.hexdigest() != want:
+            raise DigestMismatchError(
+                f"archive member {member!r} sha256 mismatch "
+                f"(stored {want[:12]}…, computed "
+                f"{h.hexdigest()[:12]}…): checkpoint corrupt"
+            )
 
 
 def _load_archive(data: bytes):

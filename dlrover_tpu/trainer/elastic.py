@@ -128,6 +128,7 @@ class ElasticTrainer:
         DLROVER_HANG_MIN_TIMEOUT / _MULTIPLIER tune the threshold."""
         import os
 
+        from dlrover_tpu.common.constants import NodeEnv
         from dlrover_tpu.fault_tolerance import (
             FaultInjector,
             HangingDetector,
@@ -139,6 +140,29 @@ class ElasticTrainer:
         from dlrover_tpu.trainer.profiler import TraceCapture
 
         self._trace_capture = TraceCapture.from_env()
+        # graceful drain on SIGTERM (fault_tolerance/drain.py): armed
+        # BEFORE the flight recorder so the recorder's hook chains the
+        # drain handler (dump first, then drain) instead of
+        # re-delivering the signal. Lazy accessors: the checkpointer
+        # attaches and steps advance after arming.
+        from dlrover_tpu.fault_tolerance.drain import DrainCoordinator
+
+        self._last_state = None
+        self._drain = DrainCoordinator(
+            master_client_fn=lambda: self._master_client,
+            checkpointer_fn=lambda: self._checkpointer,
+            state_provider=lambda: (
+                (self._global_step, self._last_state)
+                if self._last_state is not None else None
+            ),
+            restart_count=int(
+                os.environ.get(NodeEnv.RESTART_COUNT, "0") or 0
+            ),
+        )
+        try:
+            self._drain.arm()
+        except Exception as e:  # drain is best-effort, never fatal
+            logger.warning("drain arming failed: %s", e)
         if self._master_client is None:
             return
         if hang_detection is None:
@@ -339,6 +363,11 @@ class ElasticTrainer:
         each step with the post-update state). Returns the train-thread
         stall in ms when a save was issued, else None. Checkpoint
         failures are reported, never raised into the step loop."""
+        # the drain coordinator's emergency save reads the freshest
+        # state seen here (a pytree reference, not a copy); callers
+        # with donating step functions should prefer
+        # drain.set_state_provider with an un-donated source
+        self._last_state = state
         if self._checkpointer is None:
             return None
         step = self._global_step if step is None else step
@@ -366,3 +395,10 @@ class ElasticTrainer:
     @property
     def global_step(self) -> int:
         return self._global_step
+
+    @property
+    def drain(self):
+        """The armed :class:`~dlrover_tpu.fault_tolerance.drain.
+        DrainCoordinator` (override its state provider when the step
+        loop donates buffers)."""
+        return self._drain

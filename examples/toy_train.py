@@ -86,6 +86,15 @@ def main():
     params, opt_state = state["params"], state["opt_state"]
     step = start_step
     loss = None
+    # wire the trainer's drain coordinator to the live state: a
+    # preemption notice (SIGTERM) lands an emergency checkpoint of the
+    # CURRENT step inside the notice window, instead of falling back
+    # to the last cadenced save
+    cur = {"step": step, "state": state}
+    trainer.attach_checkpointer(ckpt)
+    trainer.drain.set_state_provider(
+        lambda: (cur["step"], cur["state"])
+    )
     while step < args.steps:
         shard = sharding.fetch_shard()
         if shard is None:
@@ -104,6 +113,13 @@ def main():
         sharding.report_batch_done()
         step += 1
         trainer.report_step(step)
+        # host copies: train_step donates (params, opt_state), so the
+        # signal-time emergency save must not read device buffers the
+        # next dispatch may have invalidated
+        cur["step"], cur["state"] = step, jax.device_get({
+            "params": params, "opt_state": opt_state,
+            "step": jnp.array(step),
+        })
         if step % 10 == 0 or step == args.steps:
             ckpt.save(
                 step,

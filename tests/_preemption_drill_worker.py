@@ -1,0 +1,183 @@
+"""Drill worker for the preemption chaos test (not a test module).
+
+Speaks the real agent protocol against a live master with a real
+FlashCheckpointer and an armed DrainCoordinator: joins the training
+rendezvous, consumes data shards (saving a RAM-tier checkpoint every
+step), and reports the global step.
+
+Fault surface: ``DLROVER_FAULT_INJECT=preempt@N:notice=S`` delivers
+SIGTERM to this process mid-epoch and arms a SIGKILL reclaim S seconds
+later — the platform preemption the drain must beat. The armed
+DrainCoordinator turns the SIGTERM into the deadline-budgeted drain
+(report PREEMPTED, emergency durable checkpoint, relinquish in-flight
+shards, final goodput) and exits rc 21 before the reclaim lands.
+
+The relaunched incarnation (RESTART_COUNT=1 gates the injection off)
+restores from the emergency checkpoint, emits ``RESUMED <step>``, and
+finishes the epoch — the test asserts the SHARD ranges across all
+incarnations exactly partition the dataset.
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _state_for(step: int):
+    # step-stamped payload: the resumed incarnation can verify the
+    # restored arrays really belong to the step the manifest claims
+    return {"w": np.full((8,), float(step)), "bias": np.arange(4.0) + step}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master_addr", required=True)
+    p.add_argument("--node_id", type=int, required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--ckpt_dir", required=True)
+    p.add_argument("--ram_dir", required=True)
+    p.add_argument("--dataset_size", type=int, default=96)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--shard_secs", type=float, default=0.08,
+                   help="simulated train time per shard")
+    args = p.parse_args()
+
+    from dlrover_tpu.common.log import set_process_index
+
+    set_process_index(args.node_id)
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.sharding.client import ShardingClient
+    from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+    from dlrover_tpu.fault_tolerance.drain import DrainCoordinator
+    from dlrover_tpu.fault_tolerance.injection import FaultInjector
+    from dlrover_tpu.telemetry import goodput
+    from dlrover_tpu.telemetry import record
+    from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+    led = goodput.install()
+    restart_count = int(os.environ.get(NodeEnv.RESTART_COUNT, "0") or 0)
+
+    out = open(args.out, "a", buffering=1)
+
+    def emit(line: str):
+        out.write(line + "\n")
+        print(f"[worker {args.node_id}] {line}", flush=True)
+
+    client = MasterClient(
+        args.master_addr, node_id=args.node_id, node_type="worker",
+    )
+    # the RUNNING report is what closes the preemption fault window on
+    # the master when the relaunched incarnation comes back (servicer
+    # _preempted_ranks -> preempt.recovered)
+    client.update_node_status("running", "", restart_count)
+    reconnected = threading.Event()
+    client.add_reconnect_hook("drill-flag", reconnected.set)
+    injector = FaultInjector.from_env(role="worker")
+
+    # persist_interval=0: the persistent tier is written only by the
+    # emergency (force_persist) save, so a persisted archive in
+    # ckpt_dir proves the drain ran — not a periodic save
+    ckpt = FlashCheckpointer(
+        args.ckpt_dir,
+        ram_dir=args.ram_dir,
+        persist_interval=0,
+        use_orbax=False,
+        stage="sync",
+    )
+
+    cur = {"step": 0, "state": _state_for(0)}
+    state0, step0 = ckpt.restore()
+    if step0 is not None:
+        cur["step"] = int(step0)
+        cur["state"] = state0
+        # prove the payload matches the step the tier claims
+        ok = int(state0["w"][0]) == int(step0)
+        emit(f"RESUMED {int(step0)} {'ok' if ok else 'STATE_MISMATCH'}")
+
+    drain = DrainCoordinator(
+        master_client_fn=lambda: client,
+        checkpointer_fn=lambda: ckpt,
+        state_provider=lambda: (cur["step"], cur["state"]),
+        restart_count=restart_count,
+    )
+    drain.arm()
+
+    def rendezvous(tag: str) -> int:
+        reconnected.clear()
+        client.join_rendezvous(args.node_id, 1)
+        deadline = time.monotonic() + 60
+        while True:
+            if reconnected.is_set():
+                reconnected.clear()
+                client.join_rendezvous(args.node_id, 1)
+            rdzv_round, _, world = client.get_comm_world(
+                RendezvousName.TRAINING, args.node_id
+            )
+            if world and args.node_id in world:
+                record("rendezvous.joined", round=rdzv_round,
+                       node=args.node_id)
+                emit(f"{tag} {rdzv_round}")
+                return rdzv_round
+            if time.monotonic() > deadline:
+                emit(f"ERROR {tag} timeout")
+                raise TimeoutError(tag)
+            time.sleep(0.2)
+
+    # min_nodes=1: the relaunched incarnation re-joins alone mid-epoch
+    # (its peer is busy consuming) and the round must complete without
+    # waiting on the preempted rank — the instant-eviction assert
+    client.report_rdzv_params(
+        min_nodes=1, max_nodes=2, waiting_timeout=0.5, node_unit=1,
+    )
+    rendezvous("ROUND")
+
+    sharding = ShardingClient(
+        dataset_name="preempt-drill",
+        batch_size=args.batch_size,
+        num_epochs=1,
+        dataset_size=args.dataset_size,
+        shuffle=False,
+        num_minibatches_per_shard=1,
+        master_client=client,
+        fetch_batch=2,
+        lookahead=2,
+    )
+    step = cur["step"]
+    while True:
+        shard = sharding.fetch_shard(poll_interval=0.2, max_wait=120.0)
+        if shard is None:
+            break
+        emit(f"SHARD {shard.start} {shard.end}")
+        time.sleep(args.shard_secs)
+        step += 1
+        cur["state"] = _state_for(step)
+        cur["step"] = step
+        # RAM-tier-only save (persist_interval=0): keeps the pipeline
+        # warm so the emergency save exercises the loaded path
+        ckpt.save(step, cur["state"])
+        led.on_step()
+        client.report_global_step(step)
+        assert sharding._current_task is not None
+        sharding.report_task_done(sharding._current_task.task_id)
+        if injector is not None:
+            # preempt@N:notice=S fires here: SIGTERM -> armed drain ->
+            # rc 21, with the SIGKILL reclaim S seconds out
+            injector.maybe_inject(step)
+
+    emit(f"STEPS {step}")
+    snap = led.close()
+    client.report_goodput(final=True)
+    emit(f"ELAPSED {snap['elapsed_s']:.3f}")
+    emit("DONE")
+    ckpt.close()
+    client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,7 @@ DRILL_MODULES = {
     "test_operator",
     "test_four_node_drill",
     "test_goodput_drill",
+    "test_preemption_drill",
     "test_slice_soak_drill",
     "test_scale_up_drill",
     "test_streaming_e2e",
@@ -86,3 +87,89 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.drill)
         elif mod in HEAVY_MODULES:
             item.add_marker(pytest.mark.heavy)
+
+
+# -- tier-1 wall-clock budget guard (ISSUE 9) -----------------------------
+# Tier-1 stays fast because every module stays fast: a module that
+# creeps past its budget fails the run HERE with the measured time, not
+# three PRs later when the whole suite hits the CI timeout. Timed
+# drills and compile-heavy modules carry explicit measured budgets;
+# everything else gets the default. DLROVER_TPU_TEST_MODULE_BUDGET
+# overrides the default (seconds) or disables the guard ("off").
+
+DEFAULT_MODULE_BUDGET_S = 60.0
+#: measured ceilings + headroom for the known-expensive modules; a new
+#: module does NOT belong here unless its cost is inherent (wall-clock
+#: SLA drills, XLA compiles), not accidental
+MODULE_BUDGET_OVERRIDES = {
+    "test_four_node_drill": 240.0,
+    "test_goodput_drill": 180.0,
+    "test_preemption_drill": 120.0,
+    "test_master_failover": 180.0,
+    "test_two_node_failover": 180.0,
+    "test_e2e_elastic_run": 180.0,
+    "test_slice_soak_drill": 180.0,
+    "test_scale_up_drill": 120.0,
+    "test_streaming_e2e": 120.0,
+    "test_auto": 120.0,
+    "test_context_parallel": 180.0,
+    "test_flash_attention": 180.0,
+    "test_gpt": 120.0,
+    "test_moe": 120.0,
+    "test_parallel": 120.0,
+    "test_pipeline": 120.0,
+    "test_pp_memory": 120.0,
+    "test_trainer": 120.0,
+    "test_zero2_hlo": 120.0,
+}
+
+_module_spent = {}
+
+
+def _module_budget_default():
+    raw = os.environ.get("DLROVER_TPU_TEST_MODULE_BUDGET", "")
+    if raw.lower() in ("off", "no", "false", "0"):
+        return None
+    try:
+        return float(raw) if raw else DEFAULT_MODULE_BUDGET_S
+    except ValueError:
+        return DEFAULT_MODULE_BUDGET_S
+
+
+def pytest_runtest_logreport(report):
+    mod = os.path.basename(report.nodeid.split("::", 1)[0])
+    if mod.endswith(".py"):
+        mod = mod[:-3]
+    _module_spent[mod] = (
+        _module_spent.get(mod, 0.0) + getattr(report, "duration", 0.0)
+    )
+
+
+def _budget_violations():
+    default = _module_budget_default()
+    if default is None:
+        return []
+    out = []
+    for mod, spent in sorted(_module_spent.items()):
+        budget = MODULE_BUDGET_OVERRIDES.get(mod, default)
+        if spent > budget:
+            out.append((mod, spent, budget))
+    return out
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    violations = _budget_violations()
+    if not violations:
+        return
+    terminalreporter.section("module wall-clock budget exceeded")
+    for mod, spent, budget in violations:
+        terminalreporter.line(
+            f"{mod}: {spent:.1f}s > {budget:.0f}s budget — split the "
+            "module, mark the culprits slow, or (if the cost is "
+            "inherent) add a measured override in tests/conftest.py"
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if exitstatus == 0 and _budget_violations():
+        session.exitstatus = 1

@@ -1,0 +1,467 @@
+"""DrainCoordinator unit tests: the bounded drain sequence, the
+signal-handler composition contract with the flight recorder (both
+arming orders), the budget-free relaunch of an announced preemption,
+and a lint that every ``signal.signal`` registration in the tree
+chains the prior disposition instead of clobbering it.
+"""
+
+import ast
+import os
+import signal
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from dlrover_tpu import telemetry as T
+from dlrover_tpu.common.constants import (
+    NodeAction,
+    NodeEnv,
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.fault_tolerance.drain import (
+    DEFAULT_NOTICE_BUDGET_S,
+    DRAIN_EXIT_CODE,
+    DURABLE_FLOOR_S,
+    DrainCoordinator,
+    notice_budget_from_env,
+)
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.node.dist_job_manager import create_job_manager
+from dlrover_tpu.master.resource.local_optimizer import TPULocalOptimizer
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.master.watcher.base_watcher import NodeEvent
+from dlrover_tpu.telemetry import flight_recorder
+from dlrover_tpu.telemetry.journal import EventJournal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_defaults():
+    T.set_default_registry(None)
+    T.set_default_journal(EventJournal(None))
+    yield
+    T.set_default_registry(None)
+    T.set_default_journal(EventJournal(None))
+
+
+class StubClient:
+    def __init__(self, relinquished=3, report_delay=0.0):
+        self.relinquished = relinquished
+        self.report_delay = report_delay
+        self.preemption = None
+        self.relinquish_calls = 0
+        self.goodput_final = None
+
+    def report_preemption(self, reason="", notice_budget_s=0.0,
+                          deadline_ts=0.0, restart_count=0):
+        if self.report_delay:
+            time.sleep(self.report_delay)
+        self.preemption = dict(
+            reason=reason, notice_budget_s=notice_budget_s,
+            deadline_ts=deadline_ts, restart_count=restart_count,
+        )
+
+    def relinquish_shards(self, dataset_name=""):
+        self.relinquish_calls += 1
+        return self.relinquished
+
+    def report_goodput(self, final=False):
+        self.goodput_final = final
+
+
+class StubCkpt:
+    def __init__(self):
+        self.saves = []
+        self.waited = 0
+
+    def save(self, step, state, force_persist=False, durable=False):
+        self.saves.append(dict(step=step, state=state,
+                               force_persist=force_persist,
+                               durable=durable))
+        return 1.0
+
+    def wait(self):
+        self.waited += 1
+
+
+# ------------------------------------------------------------ budget env
+
+
+def test_notice_budget_from_env(monkeypatch):
+    monkeypatch.delenv(NodeEnv.PREEMPT_NOTICE_BUDGET, raising=False)
+    assert notice_budget_from_env() == DEFAULT_NOTICE_BUDGET_S
+    monkeypatch.setenv(NodeEnv.PREEMPT_NOTICE_BUDGET, "12.5")
+    assert notice_budget_from_env() == 12.5
+    monkeypatch.setenv(NodeEnv.PREEMPT_NOTICE_BUDGET, "garbage")
+    assert notice_budget_from_env() == DEFAULT_NOTICE_BUDGET_S
+    monkeypatch.setenv(NodeEnv.PREEMPT_NOTICE_BUDGET, "-3")
+    assert notice_budget_from_env() == DEFAULT_NOTICE_BUDGET_S
+
+
+# --------------------------------------------------------- drain sequence
+
+
+def test_drain_runs_every_step_and_journals():
+    client, ckpt = StubClient(), StubCkpt()
+    d = DrainCoordinator(
+        master_client_fn=lambda: client,
+        checkpointer_fn=lambda: ckpt,
+        state_provider=lambda: (7, {"w": 1}),
+        notice_budget_s=10.0,
+        restart_count=2,
+    )
+    result = d.drain(reason="unit-test")
+    assert client.preemption["reason"] == "unit-test"
+    assert client.preemption["restart_count"] == 2
+    assert client.relinquish_calls == 1
+    assert client.goodput_final is True
+    # 10s budget > DURABLE_FLOOR: the durable path drains the persist
+    # queue too (tmpfs dies with a reclaimed host)
+    assert ckpt.saves == [dict(step=7, state={"w": 1},
+                               force_persist=True, durable=True)]
+    assert ckpt.waited == 1
+    assert result["checkpoint"]["ok"]
+    assert result["relinquished"]["value"] == 3
+
+    jr = T.default_journal()
+    notice = jr.events("preempt.notice")[0]["data"]
+    assert notice["step"] == 7 and notice["restart_count"] == 2
+    eck = jr.events("preempt.emergency_ckpt")[0]["data"]
+    assert eck["ok"] and eck["durable"] and eck["step"] == 7
+    assert jr.events("preempt.drained")
+
+
+def test_drain_never_blocks_past_the_deadline():
+    # the report step eats the whole window: the remaining steps are
+    # skipped, and drain() still returns quickly
+    client, ckpt = StubClient(report_delay=5.0), StubCkpt()
+    d = DrainCoordinator(
+        master_client_fn=lambda: client,
+        checkpointer_fn=lambda: ckpt,
+        state_provider=lambda: (1, {}),
+        notice_budget_s=0.3,
+    )
+    t0 = time.monotonic()
+    result = d.drain()
+    assert time.monotonic() - t0 < 2.0
+    assert result["reported"]["timed_out"]
+    assert not result["checkpoint"]["attempted"]
+    assert ckpt.saves == []
+    jr = T.default_journal()
+    assert jr.events("preempt.step_timeout")
+    assert jr.events("preempt.step_skipped")
+
+
+def test_emergency_checkpoint_falls_back_to_ram_tier():
+    # remaining budget below DURABLE_FLOOR: save still fires, but
+    # durable=False (staged RAM tier) and no persist-queue drain
+    client, ckpt = StubClient(), StubCkpt()
+    d = DrainCoordinator(
+        master_client_fn=lambda: client,
+        checkpointer_fn=lambda: ckpt,
+        state_provider=lambda: (4, {}),
+        notice_budget_s=DURABLE_FLOOR_S - 1.0,
+    )
+    result = d.drain()
+    assert ckpt.saves[0]["durable"] is False
+    assert ckpt.saves[0]["force_persist"] is True
+    assert ckpt.waited == 0
+    assert result["checkpoint"]["ok"]
+
+
+def test_drain_survives_failing_dependencies():
+    class Exploding:
+        def __getattr__(self, name):
+            raise RuntimeError("boom")
+
+    d = DrainCoordinator(
+        master_client_fn=lambda: Exploding(),
+        checkpointer_fn=lambda: Exploding(),
+        state_provider=lambda: (_ for _ in ()).throw(RuntimeError("np")),
+        notice_budget_s=1.0,
+    )
+    result = d.drain()  # must not raise
+    assert result["reported"]["ok"] is False
+
+
+# ------------------------------------------------------ signals + arming
+
+
+def test_arm_is_idempotent_and_disarm_restores():
+    before = signal.getsignal(signal.SIGTERM)
+    d = DrainCoordinator(notice_budget_s=1.0, exit_fn=lambda rc: None)
+    try:
+        assert d.arm()
+        assert d.arm()  # second arm: no re-registration
+        assert signal.getsignal(signal.SIGTERM) == d._on_signal
+    finally:
+        d.disarm()
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_sigterm_triggers_drain_and_distinct_exit_code():
+    exits = []
+    client = StubClient()
+    d = DrainCoordinator(
+        master_client_fn=lambda: client,
+        state_provider=lambda: (3, {}),
+        notice_budget_s=2.0,
+        exit_fn=exits.append,
+    )
+    try:
+        assert d.arm()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        d.disarm()
+    assert exits == [DRAIN_EXIT_CODE]
+    assert client.preemption is not None
+    # a second notice mid/post-drain is a no-op, not a second sequence
+    d.trigger()
+    assert exits == [DRAIN_EXIT_CODE]
+
+
+def test_chained_coordinator_never_double_drains():
+    """Two armed coordinators (the trainer's plus a caller's): one
+    SIGTERM runs ONE drain. The newer handler must not chain into the
+    older coordinator — that would start a second sequence and
+    hard-exit through the older exit_fn (os._exit in production)."""
+    exits_a, exits_b = [], []
+    a = DrainCoordinator(notice_budget_s=1.0, exit_fn=exits_a.append)
+    b = DrainCoordinator(notice_budget_s=1.0, exit_fn=exits_b.append)
+    try:
+        assert a.arm()
+        assert b.arm()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not exits_b and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        b.disarm()
+        a.disarm()
+    assert exits_b == [DRAIN_EXIT_CODE]
+    assert exits_a == []
+    assert b.draining and not a.draining
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert cond()
+
+
+def test_composes_with_flight_recorder_drain_armed_first(
+    tmp_path, monkeypatch
+):
+    """Trainer order: drain armed, then the flight recorder hooks on
+    top. SIGTERM must BOTH dump stacks and run the drain."""
+    monkeypatch.setenv(flight_recorder.ENV_FLIGHT_RECORDER, "1")
+    monkeypatch.setenv(flight_recorder.ENV_CRASH_DIR, str(tmp_path))
+    exits = []
+    client = StubClient()
+    d = DrainCoordinator(
+        master_client_fn=lambda: client,
+        state_provider=lambda: (5, {}),
+        notice_budget_s=2.0,
+        exit_fn=exits.append,
+    )
+    try:
+        assert d.arm()
+        assert flight_recorder.install_signal_hook()
+        os.kill(os.getpid(), signal.SIGTERM)
+        _wait_for(lambda: exits)
+    finally:
+        flight_recorder.uninstall_signal_hook()
+        d.disarm()
+    assert exits == [DRAIN_EXIT_CODE]
+    assert client.preemption is not None
+    dumps = [p for p in os.listdir(tmp_path) if p.startswith("flight-")]
+    assert dumps, "flight recorder did not dump on preemption"
+
+
+def test_composes_with_flight_recorder_recorder_first(
+    tmp_path, monkeypatch
+):
+    """Reverse order: recorder hooked first, drain armed on top. The
+    drain chains the recorder's dump WITHOUT re-delivering the signal
+    (the recorder's non-callable-prev branch would kill the process
+    with the wrong rc)."""
+    monkeypatch.setenv(flight_recorder.ENV_FLIGHT_RECORDER, "1")
+    monkeypatch.setenv(flight_recorder.ENV_CRASH_DIR, str(tmp_path))
+    exits = []
+    client = StubClient()
+    d = DrainCoordinator(
+        master_client_fn=lambda: client,
+        state_provider=lambda: (6, {}),
+        notice_budget_s=2.0,
+        exit_fn=exits.append,
+    )
+    try:
+        assert flight_recorder.install_signal_hook()
+        assert d.arm()
+        os.kill(os.getpid(), signal.SIGTERM)
+        _wait_for(lambda: exits)
+    finally:
+        d.disarm()
+        flight_recorder.uninstall_signal_hook()
+    assert exits == [DRAIN_EXIT_CODE]
+    assert client.preemption is not None
+    dumps = [p for p in os.listdir(tmp_path)
+             if p.startswith("flight-") and p.endswith("preempt-drain")]
+    assert dumps, "drain did not chain the flight-recorder dump"
+
+
+# ------------------------------------------------- budget-free relaunch
+
+
+class RecordingScaler(Scaler):
+    def __init__(self):
+        super().__init__("test")
+        self.plans = []
+
+    def scale(self, plan: ScalePlan):
+        self.plans.append(plan)
+
+
+def _mgr(scaler, node_num=2):
+    args = SimpleNamespace(node_num=node_num,
+                           node_resource=NodeResource(memory=1024))
+    return create_job_manager(
+        args, SpeedMonitor(), scaler=scaler,
+        job_optimizer=TPULocalOptimizer(job_args=args),
+    )
+
+
+def _evt(node_id, status, exit_reason=""):
+    n = Node(NodeType.WORKER, node_id, status=status)
+    if exit_reason:
+        n.set_exit_reason(exit_reason)
+    return NodeEvent(NodeEventType.MODIFIED, n)
+
+
+def test_announced_preemption_relaunches_without_charging_budget():
+    scaler = RecordingScaler()
+    mgr = _mgr(scaler)
+    mgr.start()
+    try:
+        mgr.process_event(_evt(0, NodeStatus.RUNNING))
+        mgr.handle_preemption_notice(NodeType.WORKER, 0, "signal-sigterm")
+        mgr.process_event(_evt(0, NodeStatus.FAILED,
+                               NodeExitReason.PREEMPTED))
+    finally:
+        mgr.stop()
+    relaunch = [p for p in scaler.plans[1:] if p.launch_nodes]
+    assert len(relaunch) == 1
+    new_node = relaunch[0].launch_nodes[0]
+    assert new_node.rank_index == 0
+    assert new_node.relaunch_count == 0  # budget intact
+    assert T.default_journal().events("preempt.relaunched")
+
+
+def test_unannounced_preemption_still_charges_budget():
+    scaler = RecordingScaler()
+    mgr = _mgr(scaler)
+    mgr.start()
+    try:
+        mgr.process_event(_evt(0, NodeStatus.RUNNING))
+        mgr.process_event(_evt(0, NodeStatus.FAILED,
+                               NodeExitReason.PREEMPTED))
+    finally:
+        mgr.stop()
+    relaunch = [p for p in scaler.plans[1:] if p.launch_nodes]
+    assert len(relaunch) == 1
+    assert relaunch[0].launch_nodes[0].relaunch_count == 1
+    assert not T.default_journal().events("preempt.relaunched")
+
+
+def test_maintenance_event_queues_drain_heartbeat_action():
+    scaler = RecordingScaler()
+    mgr = _mgr(scaler)
+    mgr.start()
+    try:
+        mgr.process_event(_evt(0, NodeStatus.RUNNING))
+        n = Node(NodeType.WORKER, 0, status=NodeStatus.RUNNING)
+        n.maintenance_pending = True
+        mgr.process_event(NodeEvent(NodeEventType.MODIFIED, n))
+        action = mgr.collect_node_heartbeat(NodeType.WORKER, 0,
+                                            time.time())
+        assert action == NodeAction.DRAIN
+        # once only: the announcement flag suppresses a duplicate
+        # directive on the next identical watcher event
+        mgr.process_event(NodeEvent(NodeEventType.MODIFIED, n))
+        assert mgr.collect_node_heartbeat(
+            NodeType.WORKER, 0, time.time()
+        ) != NodeAction.DRAIN
+        assert mgr.get_node(NodeType.WORKER, 0).preempt_announced
+    finally:
+        mgr.stop()
+    assert T.default_journal().events("preempt.drain_requested")
+
+
+# ----------------------------------------------------- signal-chain lint
+
+
+def _signal_registrations(tree):
+    """Yield (call, parent) for every ``signal.signal(...)`` call."""
+    parents = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "signal"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "signal"):
+            yield node, parents.get(node)
+
+
+def _handler_chains_prior(expr) -> bool:
+    """True when the installed handler references a captured prior
+    disposition (``prev``-named variable) or an explicit SIG_DFL /
+    SIG_IGN restore."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and "prev" in n.id:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in ("SIG_DFL",
+                                                       "SIG_IGN"):
+            return True
+    return False
+
+
+def test_every_signal_registration_chains_the_prior_disposition():
+    """Handlers must compose: a ``signal.signal`` call either CAPTURES
+    the previous disposition (assignment, so the new handler can chain
+    it) or RESTORES one (handler expression references prev/SIG_DFL/
+    SIG_IGN). A bare overwrite silently disables whichever of the
+    drain coordinator / flight recorder armed first."""
+    violations = []
+    for dirpath, _, files in os.walk(os.path.join(REPO, "dlrover_tpu")):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            tree = ast.parse(open(path).read(), filename=path)
+            for call, parent in _signal_registrations(tree):
+                captured = isinstance(parent, (ast.Assign, ast.AnnAssign))
+                restores = (
+                    len(call.args) >= 2
+                    and _handler_chains_prior(call.args[1])
+                )
+                if not (captured or restores):
+                    rel = os.path.relpath(path, REPO)
+                    violations.append(f"{rel}:{call.lineno}")
+    assert not violations, (
+        "signal.signal call neither captures nor restores the prior "
+        f"disposition: {violations}"
+    )

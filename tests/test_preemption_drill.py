@@ -1,0 +1,261 @@
+"""Preemption chaos drill: graceful drain inside a 5s notice window.
+
+A real master serves two protocol-speaking workers
+(``_preemption_drill_worker.py``), each with a live goodput ledger, a
+real FlashCheckpointer and an armed DrainCoordinator.
+``DLROVER_FAULT_INJECT=preempt@4:notice=5`` preempts worker 0
+mid-epoch: SIGTERM now, hard SIGKILL reclaim 5 s later. The armed
+drain must beat the reclaim — report PREEMPTED, land the emergency
+checkpoint, relinquish the in-flight shards, push the final goodput —
+and exit rc 21 (DRAIN_EXIT_CODE), not die to the SIGKILL.
+
+Asserted: worker 0 exits rc 21 inside the notice window; the
+relinquished shards were requeued within the drain (journal
+``preempt.relinquished`` lands seconds after ``preempt.notice``, far
+inside the 20 s task-timeout watchdog interval) and the dataset is
+still consumed exactly once across all incarnations; the peer and the
+relaunched worker both finish without a rendezvous stall (the
+preempted rank was evicted from the waiting/alive sets); the relaunch
+resumes from the emergency checkpoint step; and the master's goodput
+account books the relaunch gap under the ``preempt`` badput cause.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_goodput_drill import (  # noqa: E402
+    _drill_env,
+    _free_port,
+    _killpg,
+    _master_port,
+    _poll_goodput,
+    _tail,
+    _wait,
+)
+
+from dlrover_tpu.fault_tolerance.drain import DRAIN_EXIT_CODE
+from dlrover_tpu.telemetry import goodput
+from dlrover_tpu.telemetry.goodput import Phase
+from dlrover_tpu.telemetry.journal import read_journal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DATASET_SIZE = 192
+BATCH_SIZE = 4
+SHARD_SECS = 0.2
+NOTICE_S = 5.0
+#: the watchdog interval the proactive relinquish must beat
+TASK_TIMEOUT_S = 20.0
+
+
+def _spawn_master(tmp, env, state_dir, port, tag):
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.master.main",
+        "--platform", "process", "--node_num", "0",
+        "--job_name", "preempt-drill", "--port", str(port),
+        "--state_dir", state_dir,
+        "--autoscale_interval", "600", "--check_interval", "0.2",
+    ]
+    return subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=open(os.path.join(tmp, f"master-{tag}.out"), "w"),
+        stderr=open(os.path.join(tmp, f"master-{tag}.err"), "w"),
+        start_new_session=True,
+    )
+
+
+def _spawn_worker(tmp, env, port, node_id, tag, ckpt_dir, ram_dir):
+    return subprocess.Popen(
+        [sys.executable,
+         os.path.join(REPO, "tests", "_preemption_drill_worker.py"),
+         "--master_addr", f"localhost:{port}",
+         "--node_id", str(node_id),
+         "--out", os.path.join(tmp, f"worker-{tag}.txt"),
+         "--ckpt_dir", ckpt_dir,
+         "--ram_dir", ram_dir,
+         "--dataset_size", str(DATASET_SIZE),
+         "--batch_size", str(BATCH_SIZE),
+         "--shard_secs", str(SHARD_SECS)],
+        cwd=REPO, env=env,
+        stdout=open(os.path.join(tmp, f"worker-{tag}.out"), "w"),
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+
+
+def _worker_lines(tmp, tag, token):
+    path = os.path.join(tmp, f"worker-{tag}.txt")
+    try:
+        lines = open(path).read().splitlines()
+    except OSError:
+        return []
+    return [l.split() for l in lines if l.startswith(token)]
+
+
+def test_preemption_graceful_drain_drill(tmp_path):
+    tmp = str(tmp_path)
+    state_dir = os.path.join(tmp, "state")
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    ckpt_dir = {i: os.path.join(tmp, f"ckpt-{i}") for i in (0, 1)}
+    ram_dir = {i: os.path.join(tmp, f"ram-{i}") for i in (0, 1)}
+    env = _drill_env(journal_path)
+    metrics_port = _free_port()
+    master_env = dict(
+        env,
+        DLROVER_TPU_CTX_TASK_PROCESS_TIMEOUT=str(int(TASK_TIMEOUT_S)),
+        DLROVER_TPU_METRICS_PORT=str(metrics_port),
+    )
+    worker_env = dict(
+        env,
+        DLROVER_TPU_MASTER_RECONNECT_TIMEOUT="90",
+        DLROVER_TPU_PREEMPT_NOTICE_BUDGET=str(NOTICE_S),
+    )
+
+    procs = []
+    try:
+        m = _spawn_master(tmp, master_env, state_dir, 0, "1")
+        procs.append(m)
+        port = _master_port(tmp, "1", m)
+
+        # worker 0 is preempted at its own step 4 with a 5s notice:
+        # SIGTERM immediately, SIGKILL reclaim 5s later
+        w0a = _spawn_worker(
+            tmp, dict(worker_env,
+                      DLROVER_FAULT_INJECT="preempt@4:notice=5",
+                      DLROVER_TPU_NODE_RANK="0"),
+            port, 0, "0-a", ckpt_dir[0], ram_dir[0],
+        )
+        w1 = _spawn_worker(
+            tmp, dict(worker_env, DLROVER_TPU_NODE_RANK="1"),
+            port, 1, "1", ckpt_dir[1], ram_dir[1],
+        )
+        procs += [w0a, w1]
+
+        rc = _wait(w0a, 120, "worker 0 (preemption expected)", tmp,
+                   ["worker-0-a.out", "master-1.err"])
+        # rc 21 == the drain beat the 5s reclaim; -SIGKILL/137 would
+        # mean the guillotine landed first
+        assert rc == DRAIN_EXIT_CODE, (
+            f"worker 0 exited rc={rc}, wanted graceful drain "
+            f"rc={DRAIN_EXIT_CODE}; " + _tail(tmp, "worker-0-a.out")
+        )
+
+        # relaunch the SAME node id: RESTART_COUNT=1 gates the env
+        # injection off; the incarnation must resume from the
+        # emergency checkpoint the drain landed
+        w0b = _spawn_worker(
+            tmp, dict(worker_env,
+                      DLROVER_FAULT_INJECT="preempt@4:notice=5",
+                      DLROVER_TPU_NODE_RANK="0",
+                      DLROVER_TPU_RESTART_COUNT="1"),
+            port, 0, "0-b", ckpt_dir[0], ram_dir[0],
+        )
+        procs.append(w0b)
+
+        # live /goodput mid-run: the preemption is an open (or already
+        # recovered) fault window on the aggregator
+        live = _poll_goodput(metrics_port)
+        assert any(
+            f["cause"] == Phase.PREEMPT for f in live["faults"]
+        ), live["faults"]
+
+        for tag, w in (("0-b", w0b), ("1", w1)):
+            rc = _wait(w, 180, f"worker {tag}", tmp,
+                       ["worker-0-b.out", "worker-1.out", "master-1.err"])
+            assert rc == 0, (
+                f"worker {tag} exited rc={rc}; "
+                + _tail(tmp, f"worker-{tag}.out")
+            )
+        rc_m = _wait(m, 60, "master", tmp, ["master-1.err"])
+        assert rc_m == 0, _tail(tmp, "master-1.err")
+    finally:
+        for p in procs:
+            _killpg(p, signal.SIGTERM)
+        time.sleep(0.5)
+        for p in procs:
+            _killpg(p)
+
+    # ---- exactly-once across the preemption --------------------------
+    ranges = []
+    for tag in ("0-a", "0-b", "1"):
+        for parts in _worker_lines(tmp, tag, "SHARD"):
+            ranges.append((int(parts[1]), int(parts[2])))
+    ranges.sort()
+    assert ranges[0][0] == 0 and ranges[-1][1] == DATASET_SIZE, ranges
+    for (_, end), (start, _) in zip(ranges, ranges[1:]):
+        assert end == start, f"shard gap/overlap at {start}: {ranges}"
+
+    # the preempted incarnation trained before the notice and never
+    # finished; both survivors (peer + relaunch) completed their epoch
+    assert _worker_lines(tmp, "0-a", "SHARD"), "no pre-preemption work"
+    assert not _worker_lines(tmp, "0-a", "DONE")
+    assert _worker_lines(tmp, "1", "DONE")
+    assert _worker_lines(tmp, "0-b", "DONE")
+    # the relaunched worker joined a rendezvous round — the evicted
+    # rank never blocked the re-formation
+    assert _worker_lines(tmp, "0-b", "ROUND")
+
+    # ---- journal: the drain sequence, step by step -------------------
+    events = read_journal(journal_path)
+    kinds = [e.get("kind") for e in events]
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e.get("kind"), []).append(e)
+
+    injected = [e for e in by_kind.get("fault.injected", ())
+                if e["data"]["fault"] == "preempt"]
+    assert len(injected) == 1, by_kind.get("fault.injected")
+
+    notice = by_kind["preempt.notice"][0]
+    assert notice["data"]["reason"] == "signal-sigterm", notice
+    assert notice["data"]["notice_budget_s"] == NOTICE_S, notice
+
+    assert "preempt.reported" in kinds, kinds
+    assert "preempt.drained" in kinds, kinds
+
+    # the emergency checkpoint landed inside the window...
+    eck = by_kind["preempt.emergency_ckpt"][0]["data"]
+    assert eck["ok"] and not eck["timed_out"], eck
+    emergency_step = eck["step"]
+    assert emergency_step >= 4, eck
+    # ...and the relaunched incarnation resumed exactly from it, with
+    # the restored arrays matching the step the manifest claims
+    resumed = _worker_lines(tmp, "0-b", "RESUMED")
+    assert resumed, _tail(tmp, "worker-0-b.txt")
+    assert int(resumed[0][1]) == emergency_step, (resumed, eck)
+    assert resumed[0][2] == "ok", resumed
+
+    # in-flight shards were handed back by the drain — seconds after
+    # the notice, not TASK_TIMEOUT_S later by the watchdog
+    rel = by_kind["preempt.relinquished"][0]
+    assert rel["data"]["requeued"] >= 1, rel
+    lag = rel["ts"] - notice["ts"]
+    assert 0 <= lag < NOTICE_S, (
+        f"relinquish landed {lag:.1f}s after the notice; the proactive "
+        f"drain must beat the {TASK_TIMEOUT_S}s watchdog"
+    )
+
+    # the relaunched incarnation's RUNNING report closed the window
+    assert "preempt.recovered" in kinds, kinds
+
+    # ---- goodput: the gap is preempt badput, not generic restart -----
+    summaries = by_kind.get("goodput.job_summary", [])
+    assert len(summaries) == 1, summaries
+    live_job = summaries[0]["data"]
+    assert live_job["badput_s"][Phase.PREEMPT] > 0.0, live_job
+
+    # offline replay tells the same story: the injected preemption is a
+    # recovered fault window and node 0's relaunch gap books as preempt
+    report = goodput.reconstruct(events)
+    win = next(
+        f for f in report["faults"] if f["cause"] == Phase.PREEMPT
+    )
+    assert win["recovered_ts"] and win["recovered_ts"] >= win["ts"], win
+    assert report["job"]["badput_s"][Phase.PREEMPT] > 0.0, report["job"]
+    assert report["job"]["procs"] == 3, report["procs"]

@@ -198,6 +198,59 @@ def test_group_commit_crash_restore_exact_partition(tmp_path):
     assert tm2.finished()
 
 
+def test_clean_exit_without_relinquish_recovered_by_watchdog(tmp_path):
+    """A worker that exits cleanly mid-shard WITHOUT relinquishing
+    (drain not armed, or an older agent) must still be recovered: the
+    task-timeout watchdog requeues its in-flight batch members after
+    ``_task_timeout``, a peer drains them, and the dataset is consumed
+    exactly once — no gap, no double-count. The proactive relinquish
+    path (fault_tolerance/drain.py) is an optimization on top of this
+    backstop, not a correctness requirement."""
+    _, tm = _new_task_manager(PARAMS, state_dir=str(tmp_path))
+    tm._task_timeout = 0.5
+
+    batch = tm.get_dataset_tasks(NodeType.WORKER, 0, "batch-ds",
+                                 max_tasks=4)
+    assert len(batch) == 4
+    # node 0 completes its first shard, then exits cleanly with three
+    # batch members still in flight — and never calls relinquish
+    assert tm.report_dataset_task("batch-ds", batch[0].task_id, True)
+    consumed = [(batch[0].shard.start, batch[0].shard.end)]
+
+    tm.start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if not tm._datasets["batch-ds"].get_doing_tasks():
+                break
+            time.sleep(0.1)
+        assert not tm._datasets["batch-ds"].get_doing_tasks(), (
+            "watchdog never requeued the abandoned in-flight tasks"
+        )
+    finally:
+        tm.stop()
+
+    # a ghost report from the dead worker's id is rejected — the
+    # requeued shard must not be counted twice
+    assert not tm.report_dataset_task("batch-ds", batch[1].task_id, True)
+
+    # the surviving peer drains everything, requeued shards included
+    while True:
+        got = tm.get_dataset_tasks(NodeType.WORKER, 1, "batch-ds",
+                                   max_tasks=6)
+        if got[0].task_id < 0:
+            break
+        for t in got:
+            consumed.append((t.shard.start, t.shard.end))
+            assert tm.report_dataset_task("batch-ds", t.task_id, True)
+    ranges = sorted(consumed)
+    assert ranges[0][0] == 0
+    assert ranges[-1][1] == PARAMS["dataset_size"]
+    for (_, end), (start, _) in zip(ranges, ranges[1:]):
+        assert end == start, f"gap/overlap in {ranges}"
+    assert tm.finished()
+
+
 # ------------------------------------------------------------- real gRPC
 
 

@@ -665,6 +665,44 @@ def test_journal_event_names_are_snake_case_dotted():
     )
 
 
+#: the full vocabulary of the preemption drain (ISSUE 9): goodput's
+#: EVENT_RULES, the drill's journal asserts and the docs all match
+#: these names literally — an addition or rename must land here, in
+#: docs/TELEMETRY.md and in any consumer, in the same PR
+_PREEMPT_EVENTS = {
+    "preempt.notice",
+    "preempt.emergency_ckpt",
+    "preempt.step_timeout",
+    "preempt.step_skipped",
+    "preempt.drained",
+    "preempt.rpc_fallback",
+    "preempt.reported",
+    "preempt.relinquished",
+    "preempt.recovered",
+    "preempt.relaunched",
+    "preempt.drain_requested",
+    "preempt.drain_action",
+    "preempt.worker_exit",
+}
+
+
+def test_preempt_event_names_are_the_canonical_set():
+    """The preempt.* journal vocabulary is closed: every record() of a
+    preempt event uses exactly one of the documented names, and every
+    documented name is actually emitted somewhere. A drive-by
+    'preempt.notify' typo — or a deleted emitter that leaves the docs
+    and dashboards describing a ghost event — fails here."""
+    found = {
+        value
+        for _, _, value, kind in _record_call_literals()
+        if kind == "literal" and value.startswith("preempt.")
+    }
+    assert found == _PREEMPT_EVENTS, (
+        f"unexpected: {sorted(found - _PREEMPT_EVENTS)}, "
+        f"missing emitters for: {sorted(_PREEMPT_EVENTS - found)}"
+    )
+
+
 #: span names allow a single undotted segment ("data", "dispatch" —
 #: the bench's train-thread phases predate the dotted convention);
 #: anything dotted must be fully snake-case dotted like event names
