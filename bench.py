@@ -343,6 +343,17 @@ def main():
     # so this waits for all 20 steps without a per-step host round-trip
     loss_val = float(loss)
     dt = time.perf_counter() - t0
+    # silent-failure guard on the bench output itself: a non-finite
+    # final loss means the throughput was measured over garbage math —
+    # the row says so instead of publishing a clean-looking number.
+    # Checked outside the timed window (the loop deliberately avoids
+    # per-step host syncs); rollbacks are structurally 0 in this
+    # single-process bench, present so BENCH_*.json rows compare
+    # field-for-field with elastic runs.
+    from dlrover_tpu.fault_tolerance.sentinel import TrainingSentinel
+
+    sentinel = TrainingSentinel()
+    sentinel.check(steps, loss_val)
     # re-label the measured checkpoint costs (stalls + staging waits)
     # inside the window as ckpt_stall badput
     ledger.credit(
@@ -478,7 +489,12 @@ def main():
             "restart": round(
                 goodput_snap["phases"][Phase.RESTART] * 1e3, 3
             ),
+            "rollback": round(
+                goodput_snap["phases"][Phase.ROLLBACK] * 1e3, 3
+            ),
         },
+        "anomaly_count": sentinel.anomaly_count,
+        "rollbacks": 0,
     }
     if ckpt_stalls:
         # train-thread cost of the flash saves inside the timed loop

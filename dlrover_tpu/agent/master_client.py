@@ -496,6 +496,33 @@ class MasterClient:
             return None
 
     @supervised_rpc
+    def report_anomaly(self, kind: str, step: int, value: float = 0.0,
+                       zscore: float = 0.0, host: str = "",
+                       last_good_step: int = -1,
+                       restart_count: int = 0):
+        """Sentinel trip (fault_tolerance/sentinel.py): report a
+        silent-corruption signal and receive the master's verdict — a
+        coordinated rollback order, "none" (duplicate of an in-flight
+        rollback), or "job_failed" once the rollback budget is spent.
+        A master predating this RPC rejects the unknown message with an
+        application error; the sentinel then runs uncoordinated (its
+        local anomaly window still keeps poisoned saves untagged)."""
+        req = self._fill(comm.AnomalyReport(
+            kind=kind, step=step, value=value, zscore=zscore,
+            host=host or socket.gethostname(),
+            last_good_step=last_good_step, restart_count=restart_count,
+        ))
+        try:
+            return self._call("report_anomaly", req)
+        except Exception as e:
+            if is_connection_error(e):
+                raise
+            logger.warning("report_anomaly unsupported: %s", e)
+            record("anomaly.rpc_fallback", rpc="report_anomaly",
+                   error=str(e)[:200])
+            return None
+
+    @supervised_rpc
     def relinquish_shards(self, dataset_name: str = "") -> int:
         """Drain step 3: return this node's in-flight shards to the
         todo queue immediately (empty name = every dataset). Returns
@@ -715,6 +742,12 @@ class LocalMasterClient:
     def report_preemption(self, reason="", notice_budget_s=0.0,
                           deadline_ts=0.0, restart_count=0):
         pass
+
+    def report_anomaly(self, kind, step, value=0.0, zscore=0.0,
+                       host="", last_good_step=-1, restart_count=0):
+        # masterless: no one to coordinate a rollback with; the
+        # sentinel's local anomaly window is the whole story
+        return None
 
     def relinquish_shards(self, dataset_name=""):
         self._task_manager.recover_tasks(self._node_type, self._node_id)

@@ -375,14 +375,19 @@ class ShardingClient:
         # account the range as its own
         return bool(getattr(resp, "success", True))
 
-    def report_task_done(self, task_id: int, err: str = ""):
-        self._master_client.report_task_result(
+    def report_task_done(self, task_id: int, err: str = "") -> bool:
+        """Report completion; returns whether the master ACCEPTED it.
+        False means the task was unknown or already requeued (watchdog
+        reassignment, a shard-ledger rewind) — the caller must not
+        count the range as its own exactly-once consumption."""
+        resp = self._master_client.report_task_result(
             self._dataset_name, task_id, err
         )
         with self._lock:
             self._pending_tasks = deque(
                 t for t in self._pending_tasks if t.task_id != task_id
             )
+        return bool(getattr(resp, "success", True))
 
     def get_shard_checkpoint(self) -> str:
         return self._master_client.get_shard_checkpoint(self._dataset_name)

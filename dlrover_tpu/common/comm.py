@@ -405,6 +405,34 @@ class RelinquishShardsResponse(BaseMessage):
 
 
 @dataclass
+class AnomalyReport(BaseRequest):
+    """Sentinel trip (fault_tolerance/sentinel.py): this rank saw a
+    non-finite or spiking training signal. ``last_good_step`` is the
+    newest checkpoint the reporter's sentinel window was clean for
+    (-1 = none) — the master's rollback order targets it."""
+
+    kind: str = ""  # "nonfinite_loss" | "nonfinite_grad" | "loss_spike"
+    step: int = 0
+    value: float = 0.0
+    zscore: float = 0.0
+    host: str = ""
+    last_good_step: int = -1
+    restart_count: int = 0
+
+
+@dataclass
+class AnomalyResponse(BaseMessage):
+    """Master verdict on an anomaly report: coordinate a rollback,
+    carry on (duplicate report for an in-flight rollback), or fail the
+    job (rollback budget exhausted)."""
+
+    action: str = "none"  # "rollback" | "none" | "job_failed"
+    rollback_id: int = 0
+    rollback_step: int = -1
+    quarantined: bool = False
+
+
+@dataclass
 class HeartBeat(BaseRequest):
     timestamp: float = 0.0
 

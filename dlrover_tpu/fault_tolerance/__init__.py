@@ -4,10 +4,12 @@ from dlrover_tpu.fault_tolerance.drain import (
 )
 from dlrover_tpu.fault_tolerance.hanging_detector import HangingDetector
 from dlrover_tpu.fault_tolerance.injection import FaultInjector
+from dlrover_tpu.fault_tolerance.sentinel import TrainingSentinel
 
 __all__ = [
     "DRAIN_EXIT_CODE",
     "DrainCoordinator",
     "HangingDetector",
     "FaultInjector",
+    "TrainingSentinel",
 ]

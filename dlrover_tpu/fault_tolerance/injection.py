@@ -24,6 +24,20 @@ Two triggers:
                                     window the graceful drain
                                     (fault_tolerance/drain.py) must
                                     beat
+  - ``nan@5`` / ``nan@5:host=0``    replace the step-5 loss scalar with
+                                    NaN (silent corruption; the
+                                    sentinel must trip). ``host=H``
+                                    restricts the fault to node rank H
+                                    so a multi-worker drill poisons
+                                    exactly one host.
+  - ``sdc@5:flip=2``                flip 2 exponent bits of the step-5
+                                    loss scalar (finite but grossly
+                                    wrong — the MAD spike detector's
+                                    case); accepts ``host=H`` too:
+                                    a comma chunk without ``@``
+                                    (``sdc@5:flip=2,host=1``) extends
+                                    the previous fault's kv arg rather
+                                    than starting a new fault.
   - ``master_crash@5`` / ``master_crash@5:2``  kill the JOB MASTER
                                     (rc 28) once the reported global
                                     step reaches 5, after an optional
@@ -60,7 +74,15 @@ from dlrover_tpu.telemetry import record
 ENV_SPEC = "DLROVER_FAULT_INJECT"
 KV_PREFIX = "fault_inject"
 
-KINDS = ("crash", "hang", "oom", "error", "preempt", "master_crash")
+KINDS = (
+    "crash", "hang", "oom", "error", "preempt", "master_crash",
+    "nan", "sdc",
+)
+
+#: silent-corruption kinds: they do not kill the process — the trainer
+#: feeds its loss scalar through ``corrupt_loss`` and the sentinel
+#: (fault_tolerance/sentinel.py) must notice the poisoned value
+CORRUPTION_KINDS = frozenset({"nan", "sdc"})
 
 #: kinds executed by the MASTER's run loop, not a worker training loop
 MASTER_KINDS = frozenset({"master_crash"})
@@ -96,6 +118,29 @@ def _reclaim_after(notice: float) -> None:
     _signal_own_group(signal.SIGKILL)
 
 
+def _arg_kv(arg: str, key: str) -> Optional[str]:
+    """Value of ``key=`` in a comma-separated kv arg, or None."""
+    for kv in arg.split(","):
+        k, _, v = kv.partition("=")
+        if k.strip() == key and v.strip():
+            return v.strip()
+    return None
+
+
+def _flip_bits(x: float, nbits: int) -> float:
+    """Flip ``nbits`` low exponent bits of the float64 — an SDC-shaped
+    corruption: finite (bit 62 is never touched, so the exponent can't
+    saturate to inf/nan for a normal input) but orders of magnitude
+    wrong, the gross-but-plausible value the MAD detector exists for."""
+    import struct
+
+    nbits = max(1, min(10, int(nbits)))
+    (bits,) = struct.unpack("<Q", struct.pack("<d", float(x)))
+    bits ^= ((1 << nbits) - 1) << 52
+    (y,) = struct.unpack("<d", struct.pack("<Q", bits))
+    return y
+
+
 @dataclass
 class Fault:
     kind: str
@@ -118,7 +163,18 @@ def parse_spec(spec: str) -> List[Fault]:
         if every:
             part = part[:-1]
         if "@" not in part:
-            raise ValueError(f"fault spec {part!r}: expected kind@step")
+            # a ``k=v`` continuation of the previous fault's arg — the
+            # spec splits on commas, but so do kv args
+            # (``sdc@5:flip=2,host=1``), so a comma chunk without "@"
+            # extends the fault before it
+            if not faults or "=" not in part:
+                raise ValueError(
+                    f"fault spec {part!r}: expected kind@step"
+                )
+            prev = faults[-1]
+            prev.arg = f"{prev.arg},{part}" if prev.arg else part
+            prev.every_incarnation = prev.every_incarnation or every
+            continue
         kind, rest = part.split("@", 1)
         if kind not in KINDS:
             raise ValueError(
@@ -143,6 +199,7 @@ class FaultInjector:
         role: str = "worker",
     ):
         self._role = role
+        self._node_rank = node_rank
         self._faults = self._role_filter(parse_spec(spec) if spec else [])
         # first-incarnation gating for env faults
         if restart_count > 0:
@@ -150,18 +207,25 @@ class FaultInjector:
                 f for f in self._faults if f.every_incarnation
             ]
         self._client = master_client
-        self._node_rank = node_rank
         self._poll_every = max(1, poll_every)
         self._step_seen = 0
 
     def _role_filter(self, faults: List[Fault]) -> List[Fault]:
         """One spec may target both sides: each injector keeps only the
         kinds its role executes (a worker must not die on a
-        master_crash, nor the master on a worker crash)."""
-        return [
-            f for f in faults
-            if (f.kind in MASTER_KINDS) == (self._role == "master")
-        ]
+        master_crash, nor the master on a worker crash). Corruption
+        kinds additionally honor ``host=H`` so one shared spec poisons
+        exactly one node rank."""
+        kept = []
+        for f in faults:
+            if (f.kind in MASTER_KINDS) != (self._role == "master"):
+                continue
+            if f.kind in CORRUPTION_KINDS:
+                host = _arg_kv(f.arg, "host")
+                if host is not None and int(host) != self._node_rank:
+                    continue
+            kept.append(f)
+        return kept
 
     @classmethod
     def from_env(cls, master_client=None,
@@ -184,14 +248,45 @@ class FaultInjector:
     # -- trigger -----------------------------------------------------------
 
     def maybe_inject(self, step: int) -> None:
-        """Call once per completed step; executes any due fault."""
+        """Call once per completed step; executes any due fault.
+        Corruption kinds are NOT executed here — they fire from
+        ``corrupt_loss`` on the step's loss scalar instead."""
         self._step_seen = step
         if self._client is not None and step % self._poll_every == 0:
             self._poll_remote()
         for fault in self._faults:
-            if fault.due(step):
+            if fault.kind not in CORRUPTION_KINDS and fault.due(step):
                 fault.fired = True
                 self._execute(fault, step)
+
+    def corrupt_loss(self, step: int, loss: float) -> float:
+        """Apply any due nan/sdc fault to this step's loss scalar —
+        the trainer routes the value it is about to hand the sentinel
+        through here, so the corruption rides the normal signal path
+        instead of a side channel."""
+        for fault in self._faults:
+            if fault.kind not in CORRUPTION_KINDS or not fault.due(step):
+                continue
+            fault.fired = True
+            logger.warning(
+                "FAULT INJECTION: %s at step %d (arg=%r)",
+                fault.kind, step, fault.arg,
+            )
+            record(
+                "fault.injected", fault=fault.kind, step=step,
+                arg=fault.arg, node_rank=self._node_rank,
+            )
+            if fault.kind == "nan":
+                print(f"INJECTED NAN LOSS at step {step}", flush=True)
+                return float("nan")
+            flip = int(_arg_kv(fault.arg, "flip") or 2)
+            corrupted = _flip_bits(loss, flip)
+            print(
+                f"INJECTED SDC at step {step}: loss {loss!r} -> "
+                f"{corrupted!r} (flip={flip})", flush=True,
+            )
+            return corrupted
+        return loss
 
     def _poll_remote(self) -> None:
         try:

@@ -28,6 +28,7 @@ from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.node.dist_job_manager import create_job_manager
 from dlrover_tpu.master.node.job_auto_scaler import new_job_auto_scaler
+from dlrover_tpu.master.node.quarantine import QuarantineManager
 from dlrover_tpu.master.resource.local_optimizer import TPULocalOptimizer
 from dlrover_tpu.master.servicer import create_master_service
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
@@ -61,7 +62,17 @@ class DistributedJobMaster:
                  brain_client=None, state_dir: Optional[str] = None,
                  fresh: bool = False):
         self.speed_monitor = SpeedMonitor()
-        self.error_monitor = ErrorMonitor()
+        # anomaly attribution across incarnations: the quarantine
+        # rides on the error monitor so the servicer (anomaly reports)
+        # and the job manager (relaunch placement) share one verdict;
+        # newly quarantined hosts merge into the scaler's placement
+        # blacklist alongside the Brain's
+        self.quarantine = QuarantineManager(
+            placement_sink=(
+                scaler.add_avoid_hosts if scaler is not None else None
+            )
+        )
+        self.error_monitor = ErrorMonitor(quarantine=self.quarantine)
         job_name = getattr(job_args, "job_name", "") or "job"
         # durable job-state journal (master/state_journal.py): None
         # unless a state dir is configured (env or --state_dir)

@@ -278,6 +278,19 @@ class DistributedJobManager:
             self._job_optimizer.report_node_event(
                 node.host_name or node.name, node.exit_reason
             )
+        quarantine = getattr(self._error_monitor, "quarantine", None)
+        if quarantine is not None and quarantine.is_quarantined(
+            node.host_name or node.name
+        ):
+            # a quarantined host never gets the node back: the job
+            # runs on the remaining fleet (the anomaly attribution
+            # already evicted the rank from rendezvous)
+            logger.warning(
+                "Not relaunching %s: host %s is quarantined",
+                node.name, node.host_name or node.name,
+            )
+            node.relaunchable = False
+            return
         if not self._should_relaunch(node):
             if node.critical and not node.is_released:
                 # a critical node that will not come back: fail fast
@@ -353,6 +366,28 @@ class DistributedJobManager:
             "Preemption notice from %s (%s); relaunch will not charge "
             "the budget (%d/%d used)", node.name, reason or "unknown",
             node.relaunch_count, node.max_relaunch_count,
+        )
+
+    def handle_quarantine(self, node_type: str, node_id: int,
+                          host: str = ""):
+        """The quarantine verdict landed (servicer rpc_report_anomaly):
+        pin the node un-relaunchable so a later crash/exit of the
+        corrupting worker cannot resurrect it on the same host, and
+        keep placement away from the host on every platform that
+        supports avoidance (the QuarantineManager's placement sink)."""
+        node = self.get_node(node_type, node_id)
+        if node is None:
+            self.update_node_status(node_type, node_id,
+                                    NodeStatus.RUNNING)
+            node = self.get_node(node_type, node_id)
+        if node is None:
+            return
+        node.relaunchable = False
+        if host and not node.host_name:
+            node.host_name = host
+        logger.warning(
+            "Quarantine on %s (host %s): node will not be relaunched",
+            node.name, host or node.host_name,
         )
 
     def request_node_drain(self, node_type: str, node_id: int,

@@ -59,6 +59,12 @@ class Scaler(ABC):
 
         return node_type == NodeType.WORKER
 
+    def add_avoid_hosts(self, hosts: List[str]) -> None:
+        """MERGE ``hosts`` into the platform's placement blacklist
+        (quarantined repeat offenders join the Brain's list, never
+        replace it). Default: no placement control — platforms that
+        allocate fresh machines from a fleet API have nothing to avoid."""
+
     def start(self) -> None:
         pass
 

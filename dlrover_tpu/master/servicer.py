@@ -6,6 +6,8 @@ envelope (common/grpc_utils.py); each public ``rpc_*`` method here is one
 RPC from the reference service (elastic_training.proto:243-299).
 """
 
+import json
+import os
 import time
 from typing import Optional
 
@@ -64,6 +66,22 @@ class MasterServicer:
         # ranks with an announced preemption in flight: their next
         # RUNNING report closes the goodput fault window
         self._preempted_ranks = set()
+        # silent-failure sentinel coordination (sentinel.py): the
+        # quarantine manager rides in on the error monitor so one
+        # object serves the servicer AND the job manager's relaunch
+        # placement
+        self._quarantine = getattr(error_monitor, "quarantine", None)
+        self._rollback_ranks = set()
+        #: the in-flight rollback order, if any: duplicate anomaly
+        #: reports ride it instead of burning budget on one incident
+        self._active_rollback: Optional[dict] = None
+        self._rollback_id = 0
+        self._rollbacks_done = 0
+        # bounded rollback budget: a job that keeps rolling back is
+        # livelocked — convert it into a diagnosed failure
+        self._max_rollbacks = int(
+            os.environ.get("DLROVER_TPU_MAX_ROLLBACKS", "3")
+        )
 
     def _running_nodes(self):
         """Deferred node-list snapshot for the stats collector: only
@@ -353,6 +371,19 @@ class MasterServicer:
                 "preempt.recovered", node_type=req.node_type,
                 node_id=req.node_id, rank=rank,
             )
+        if req.status == "running" and rank in self._rollback_ranks:
+            # the detecting rank restored the last-good step and is
+            # training again: the rollback window closes, and a LATER
+            # anomaly starts a fresh (budget-counted) rollback
+            self._rollback_ranks.discard(rank)
+            if not self._rollback_ranks:
+                self._active_rollback = None
+            if self._goodput is not None:
+                self._goodput.mark_recovered("rollback")
+            record(
+                "rollback.recovered", node_type=req.node_type,
+                node_id=req.node_id, rank=rank,
+            )
         return comm.Response(success=True)
 
     def rpc_report_preemption(
@@ -388,6 +419,108 @@ class MasterServicer:
         if self._goodput is not None:
             self._goodput.note_fault(cause="preempt", node_id=req.node_id)
         return comm.Response(success=True)
+
+    def rpc_report_anomaly(
+        self, req: comm.AnomalyReport
+    ) -> comm.AnomalyResponse:
+        """A sentinel trip (fault_tolerance/sentinel.py): attribute the
+        anomaly to its physical host (repeat offenders are
+        quarantined), then coordinate a job-wide rollback to the
+        reporter's last sentinel-clean checkpoint — or fail the job
+        once the rollback budget is exhausted."""
+        record(
+            "anomaly.reported", node_type=req.node_type,
+            node_id=req.node_id, anomaly=req.kind, step=req.step,
+            value=req.value, zscore=req.zscore, host=req.host,
+            last_good_step=req.last_good_step,
+            restart_count=req.restart_count,
+        )
+        counter(
+            "dlrover_anomalies_reported_total",
+            "Anomaly reports received from worker sentinels", ["kind"],
+        ).labels(kind=req.kind or "unknown").inc()
+        rank = self._rank_of(req.node_type, req.node_id)
+        host = req.host or f"node-{req.node_id}"
+        quarantined = False
+        if self._quarantine is not None:
+            quarantined = self._quarantine.note_anomaly(
+                host, kind=req.kind, step=req.step
+            )
+            if quarantined:
+                # surgical removal: the host's rank leaves every
+                # rendezvous NOW (the next round forms without it) and
+                # the job manager stops relaunching onto the host
+                for mgr in self._rdzv_managers.values():
+                    mgr.remove_alive_node(rank)
+                if self._job_manager is not None:
+                    handle = getattr(
+                        self._job_manager, "handle_quarantine", None
+                    )
+                    if handle is not None:
+                        handle(req.node_type, req.node_id, host)
+        if self._active_rollback is not None:
+            # one incident, many reporters: every rank that trips on
+            # the same corrupted state rides the in-flight order
+            self._rollback_ranks.add(rank)
+            return comm.AnomalyResponse(
+                action="rollback",
+                rollback_id=self._active_rollback["id"],
+                rollback_step=self._active_rollback["step"],
+                quarantined=quarantined,
+            )
+        if req.last_good_step < 0:
+            # no sentinel-clean checkpoint exists yet: nothing to roll
+            # back to — the reporter restarts from scratch on its own
+            return comm.AnomalyResponse(
+                action="none", quarantined=quarantined
+            )
+        if self._rollbacks_done >= self._max_rollbacks:
+            record(
+                "rollback.budget_exhausted",
+                rollbacks=self._rollbacks_done,
+                budget=self._max_rollbacks, anomaly=req.kind,
+                node_id=req.node_id, host=host,
+            )
+            if self._job_manager is not None:
+                self._job_manager.mark_job_failed(
+                    f"rollback budget exhausted "
+                    f"({self._rollbacks_done}/{self._max_rollbacks}): "
+                    f"recurring {req.kind} anomaly"
+                )
+            return comm.AnomalyResponse(
+                action="job_failed", quarantined=quarantined
+            )
+        self._rollbacks_done += 1
+        self._rollback_id += 1
+        order = {
+            "id": self._rollback_id, "step": int(req.last_good_step),
+        }
+        self._active_rollback = order
+        self._rollback_ranks.add(rank)
+        # KV broadcast: ranks that did NOT trip learn the order from
+        # their sentinel's step-cadence poll and converge on the same
+        # restore step
+        self._kv_store.set(
+            "sentinel/rollback_order", json.dumps(order).encode()
+        )
+        record(
+            "rollback.initiated", rollback_id=order["id"],
+            step=order["step"], anomaly=req.kind, node_id=req.node_id,
+            host=host, rollbacks=self._rollbacks_done,
+            budget=self._max_rollbacks,
+        )
+        counter(
+            "dlrover_rollbacks_initiated_total",
+            "Coordinated last-good rollbacks ordered by the master",
+        ).inc()
+        if self._goodput is not None:
+            self._goodput.note_fault(
+                cause="rollback", node_id=req.node_id
+            )
+        return comm.AnomalyResponse(
+            action="rollback", rollback_id=order["id"],
+            rollback_step=order["step"], quarantined=quarantined,
+        )
 
     def rpc_relinquish_shards(
         self, req: comm.RelinquishShardsRequest

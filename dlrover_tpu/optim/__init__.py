@@ -12,7 +12,10 @@
 
 from dlrover_tpu.optim.bf16 import (  # noqa: F401
     MasterWeightsState,
+    NonfiniteGuardState,
     bf16_adamw,
+    guard_stats,
     master_weights,
+    nonfinite_guard,
 )
 from dlrover_tpu.optim.wsam import wsam_value_and_grad  # noqa: F401

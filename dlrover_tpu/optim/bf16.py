@@ -15,7 +15,8 @@ params are exactly ``round_bf16(master_new)`` — no drift between master
 and working copies.
 """
 
-from typing import Any, NamedTuple, Optional
+import threading
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +65,107 @@ def master_weights(
     return optax.GradientTransformation(init, update)
 
 
+class NonfiniteGuardState(NamedTuple):
+    inner_state: Any
+    #: cumulative updates skipped for a non-finite global grad norm
+    nonfinite_count: Any
+    #: the global grad norm of the most recent update() call — the
+    #: host-side sentinel's SDC signal, read via :func:`guard_stats`
+    last_grad_norm: Any
+
+
+def nonfinite_guard(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Skip the whole update when the global grad norm is non-finite.
+
+    One corrupted microbatch (bf16 overflow, a bit-flipped gradient)
+    must not poison the master weights AND the optimizer moments — the
+    moments outlive the step that corrupted them, so a single NaN
+    would otherwise propagate through every later update. The select
+    is a ``jnp.where`` on both the deltas and the inner state, so the
+    guard stays inside the fused XLA program: no host sync, no
+    conditional dispatch. The skip count and the measured norm live in
+    the optimizer state; the step loop reads them off-device with
+    :func:`guard_stats` (which also publishes the skip counter) and
+    feeds the norm to the training sentinel.
+    """
+
+    def init(params):
+        return NonfiniteGuardState(
+            inner.init(params),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.float32),
+        )
+
+    def update(grads, state, params=None):
+        norm = optax.global_norm(grads)
+        finite = jnp.isfinite(norm)
+        updates, inner_state = inner.update(
+            grads, state.inner_state, params
+        )
+        # a NaN grad NaNs the inner update AND its new moments: select
+        # zero deltas and the PREVIOUS inner state when tripped
+        updates = jax.tree.map(
+            lambda u: jnp.where(finite, u, jnp.zeros_like(u)), updates
+        )
+        inner_state = jax.tree.map(
+            lambda new, old: jnp.where(finite, new, old),
+            inner_state, state.inner_state,
+        )
+        return updates, NonfiniteGuardState(
+            inner_state,
+            state.nonfinite_count + jnp.where(finite, 0, 1).astype(
+                jnp.int32
+            ),
+            norm.astype(jnp.float32),
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+def guard_stats(opt_state) -> Optional[Tuple[int, float]]:
+    """Host-side read of the guard counters anywhere in ``opt_state``:
+    ``(skipped_updates, last_global_grad_norm)``, or None when no
+    :func:`nonfinite_guard` is in the chain. Publishes newly observed
+    skips to ``dlrover_optim_nonfinite_skips_total``."""
+    guards = [
+        leaf for leaf in jax.tree.leaves(
+            opt_state,
+            is_leaf=lambda x: isinstance(x, NonfiniteGuardState),
+        )
+        if isinstance(leaf, NonfiniteGuardState)
+    ]
+    if not guards:
+        return None
+    g = guards[0]
+    skips = int(jax.device_get(g.nonfinite_count))
+    norm = float(jax.device_get(g.last_grad_norm))
+    _publish_skips(skips)
+    return skips, norm
+
+
+#: monotone watermark so the cumulative device count maps onto the
+#: monotone process counter without double-counting repeated reads
+_skips_published = 0
+_skips_lock = threading.Lock()
+
+
+def _publish_skips(total: int) -> None:
+    global _skips_published
+    from dlrover_tpu.telemetry import counter
+
+    with _skips_lock:
+        delta = total - _skips_published
+        if delta <= 0:
+            return
+        _skips_published = total
+    counter(
+        "dlrover_optim_nonfinite_skips_total",
+        "Optimizer updates skipped for a non-finite global grad norm",
+    ).inc(delta)
+
+
 def bf16_adamw(
     learning_rate,
     b1: float = 0.9,
@@ -71,14 +173,19 @@ def bf16_adamw(
     eps: float = 1e-8,
     weight_decay: float = 0.0,
     mu_dtype: Optional[jnp.dtype] = jnp.bfloat16,
+    guard_nonfinite: bool = False,
 ) -> optax.GradientTransformation:
     """AdamW over fp32 masters with bf16 first moment (HBM saver).
 
     State per param: fp32 master + bf16 mu + fp32 nu = 10 bytes/param,
     vs 12 for full-fp32 adamw-with-masters and 6 for all-bf16 adamw.
+    ``guard_nonfinite=True`` wraps the whole chain in
+    :func:`nonfinite_guard` (opt-in: it changes the opt-state pytree
+    structure, so existing checkpoints keep restoring unguarded).
     """
     inner = optax.adamw(
         learning_rate, b1=b1, b2=b2, eps=eps,
         weight_decay=weight_decay, mu_dtype=mu_dtype,
     )
-    return master_weights(inner)
+    opt = master_weights(inner)
+    return nonfinite_guard(opt) if guard_nonfinite else opt

@@ -499,6 +499,13 @@ class GkePodScaler(Scaler):
     def stop(self):
         self._stopped.set()
 
+    def add_avoid_hosts(self, hosts):
+        """Quarantined hosts (master/node/quarantine.py) join the
+        Brain-blacklisted ones in the pod anti-affinity — merged, so a
+        quarantine verdict never erases the cluster blacklist."""
+        merged = sorted(set(self._api.avoid_hosts) | set(hosts))
+        self._api.set_avoid_hosts(merged)
+
     def scale(self, plan: ScalePlan):
         for node in plan.launch_nodes:
             self._launch(node)

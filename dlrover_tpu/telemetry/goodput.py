@@ -78,19 +78,21 @@ class Phase:
     HANG = "hang"              # stall window flagged by the detector
     RESTART = "restart"        # fault-to-recovery (incl. master loss)
     PREEMPT = "preempt"        # reclaim notice -> drain -> relaunch
+    ROLLBACK = "rollback"      # sentinel trip -> last-good restore
     IDLE = "idle"              # unattributed
 
 
 PHASES: Tuple[str, ...] = (
     Phase.INIT, Phase.RENDEZVOUS, Phase.TRAINING, Phase.CKPT_STALL,
-    Phase.HANG, Phase.RESTART, Phase.PREEMPT, Phase.IDLE,
+    Phase.HANG, Phase.RESTART, Phase.PREEMPT, Phase.ROLLBACK,
+    Phase.IDLE,
 )
 
 #: badput breakdown keys: every phase that is neither useful training
 #: nor unattributed
 BADPUT_CAUSES: Tuple[str, ...] = (
     Phase.INIT, Phase.RENDEZVOUS, Phase.CKPT_STALL, Phase.HANG,
-    Phase.RESTART, Phase.PREEMPT,
+    Phase.RESTART, Phase.PREEMPT, Phase.ROLLBACK,
 )
 
 
@@ -129,7 +131,8 @@ class PhaseLedger:
             ts = self._now(ts)
             self._totals[self._phase] += max(0.0, ts - self._mark)
             prev = self._phase
-            if prev not in (Phase.HANG, Phase.RESTART, Phase.PREEMPT):
+            if prev not in (Phase.HANG, Phase.RESTART, Phase.PREEMPT,
+                            Phase.ROLLBACK):
                 # a fault phase ends by returning to what it interrupted
                 self._resume_phase = prev
             self._phase = phase
@@ -286,6 +289,13 @@ EVENT_RULES: Dict[str, Callable[[PhaseLedger, float, Dict], None]] = {
     # it, and the master books the relaunch gap under the same cause
     "preempt.notice":
         lambda led, ts, data: led.transition(Phase.PREEMPT, ts=ts),
+    # a sentinel trip (or an adopted rollback order from another
+    # rank's trip) opens the rollback window; the first post-restore
+    # step closes it via on_step, like hang windows
+    "anomaly.detected":
+        lambda led, ts, data: led.transition(Phase.ROLLBACK, ts=ts),
+    "rollback.ordered":
+        lambda led, ts, data: led.transition(Phase.ROLLBACK, ts=ts),
 }
 
 
@@ -551,11 +561,18 @@ def summarize(procs: Dict[str, Dict[str, Any]],
         for ph in PHASES:
             node["phases"][ph] += p["phases"].get(ph, 0.0)
 
-    # nodes with an announced preemption: their un-ledgered relaunch
-    # gap is preempt badput, not a generic restart
+    # nodes with an announced preemption (or an ordered rollback):
+    # their un-ledgered relaunch gap carries that cause, not a generic
+    # restart. Preempt wins over rollback when a node saw both — the
+    # reclaim is what actually took the machine away.
     preempted_nodes = {
         f.get("node_id") for f in faults
         if f.get("cause") == Phase.PREEMPT and f.get("node_id") is not None
+    }
+    rollback_nodes = {
+        f.get("node_id") for f in faults
+        if f.get("cause") == Phase.ROLLBACK
+        and f.get("node_id") is not None
     }
 
     phases = {ph: 0.0 for ph in PHASES}
@@ -566,8 +583,12 @@ def summarize(procs: Dict[str, Dict[str, Any]],
         # to attribute it, and the only way to be dead mid-job is a
         # restart (or announced preemption) in flight
         gap = max(0.0, node_wall - node["covered_s"])
-        gap_cause = (Phase.PREEMPT if node_id in preempted_nodes
-                     else Phase.RESTART)
+        if node_id in preempted_nodes:
+            gap_cause = Phase.PREEMPT
+        elif node_id in rollback_nodes:
+            gap_cause = Phase.ROLLBACK
+        else:
+            gap_cause = Phase.RESTART
         node["phases"][gap_cause] += gap
         node["wall_s"] = round(node_wall, 6)
         node["restart_gap_s"] = round(gap, 6)
@@ -831,6 +852,18 @@ def _fault_windows(events: List[Dict]) -> List[Dict[str, Any]]:
                 "cause": "hang", "node_id": e.get("proc"),
                 "ts": ts, "recovered_ts": None,
             })
+        elif kind == "anomaly.detected":
+            faults.append({
+                "cause": Phase.ROLLBACK, "node_id": e.get("proc"),
+                "ts": ts, "recovered_ts": None,
+            })
+        elif kind in ("rollback.restored", "rollback.recovered"):
+            # closes every rollback window still open at this point:
+            # one incident's order covers all ranks that adopted it
+            for f in faults:
+                if (f["cause"] == Phase.ROLLBACK
+                        and f["recovered_ts"] is None):
+                    f["recovered_ts"] = ts
     # an injected master crash recovers at master.restored; an injected
     # worker crash recovers when ANY later event from its node appears
     restored = [float(e.get("ts", 0.0)) for e in events

@@ -252,6 +252,10 @@ class _SaveJob:
     staged: Any
     persist_due: bool
     force: bool
+    #: sentinel verdict at save() time — True means no anomaly window
+    #: was open, False taints the step against rollback restores, None
+    #: means no sentinel is armed (legacy archives stay untagged)
+    last_good: Optional[bool] = None
     #: set once the staged snapshot is fully materialized on the host —
     #: after this, the source device buffers may be donated/deleted
     staged_evt: threading.Event = field(default_factory=threading.Event)
@@ -274,6 +278,7 @@ class _PersistJob:
     step: int
     payload: Tuple[str, Any]
     force: bool
+    last_good: Optional[bool] = None
     abandon: Callable[[], None] = lambda: None
 
 
@@ -521,6 +526,10 @@ class FlashCheckpointer:
         self._persistq: Optional[_PersistQueue] = None
         self._last_save: Optional[_SaveJob] = None
         self._closed = False
+        # sentinel hook: () -> bool, True while no anomaly window is
+        # open; archives saved under an open window are tagged
+        # last_good=False and skipped by the restore walk-down
+        self._clean_fn: Optional[Callable[[], bool]] = None
         # RAM-tier files referenced by queued/running persist jobs must
         # survive _gc_ram until the upload finished
         self._pin_lock = threading.Lock()
@@ -548,6 +557,13 @@ class FlashCheckpointer:
         if self._manager is None:
             self._store = ckpt_store.get_store(self.persist_dir)
 
+    def set_clean_fn(self, fn: Optional[Callable[[], bool]]) -> None:
+        """Install the sentinel's clean-verdict callback. Called on the
+        train thread at save() time; its answer tags the archive
+        (``last_good``) so a coordinated rollback never restores a step
+        saved while an anomaly window was open."""
+        self._clean_fn = fn
+
     # ------------------------------------------------------------------ save
 
     def save(self, step: int, state: Any,
@@ -570,6 +586,15 @@ class FlashCheckpointer:
         t0 = time.perf_counter()
         ts_wall = time.time()
         staged = _stage_local_shards(state, sync=self._stage_sync)
+        # verdict captured on the train thread, at save() time: the
+        # background lanes must tag the archive with what the sentinel
+        # knew when the state was snapshotted, not when it lands
+        last_good = None
+        if self._clean_fn is not None:
+            try:
+                last_good = bool(self._clean_fn())
+            except Exception:
+                last_good = None
         job = _SaveJob(
             step=step,
             staged=staged,
@@ -578,6 +603,7 @@ class FlashCheckpointer:
                 and step % self.persist_interval == 0
             ),
             force=force_persist,
+            last_good=last_good,
         )
         if self._stage_sync:
             job.staged_evt.set()  # host copies already owned
@@ -678,7 +704,7 @@ class FlashCheckpointer:
             return
         ram_ok = True
         try:
-            nbytes = self._write_ram(job.step, snapshot)
+            nbytes = self._write_ram(job.step, snapshot, job.last_good)
             dt = time.perf_counter() - t0
             logger.info(
                 "Flash save step %d: RAM tier in %.0f ms (pipelined)",
@@ -699,7 +725,8 @@ class FlashCheckpointer:
             )
         if job.persist_due:
             self._enqueue_persist(
-                job.step, snapshot, job.force, ram_ok=ram_ok
+                job.step, snapshot, job.force, ram_ok=ram_ok,
+                last_good=job.last_good,
             )
 
     def _ram_path(self, step: int) -> str:
@@ -707,11 +734,14 @@ class FlashCheckpointer:
             self.ram_dir, f"step-{step}-proc-{self._process_index}"
         )
 
-    def _write_ram(self, step: int, snapshot: Any) -> int:
+    def _write_ram(self, step: int, snapshot: Any,
+                   last_good: Optional[bool] = None) -> int:
         path = self._ram_path(step)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            nbytes = ckpt_store.snapshot_to_file(snapshot, step, f)
+            nbytes = ckpt_store.snapshot_to_file(
+                snapshot, step, f, last_good=last_good
+            )
         os.replace(tmp, path)
         return nbytes
 
@@ -761,7 +791,8 @@ class FlashCheckpointer:
         return sorted(records)
 
     def _enqueue_persist(self, step: int, snapshot: Any,
-                         force: bool, ram_ok: bool = True) -> None:
+                         force: bool, ram_ok: bool = True,
+                         last_good: Optional[bool] = None) -> None:
         """Serializer lane -> persist queue handoff. The store branch
         references the RAM-tier file (pinned against gc) so a queued
         persist costs a tmpfs path, not an in-memory archive; the
@@ -773,12 +804,14 @@ class FlashCheckpointer:
         in memory — the only persist path paying a full in-memory
         copy, and still bounded by the queue like any other job."""
         if self._manager is not None:
-            job = _PersistJob(step, ("orbax", snapshot), force)
+            job = _PersistJob(
+                step, ("orbax", snapshot), force, last_good=last_good
+            )
         elif ram_ok:
             path = self._ram_path(step)
             self._pin(path)
             job = _PersistJob(
-                step, ("store", path), force,
+                step, ("store", path), force, last_good=last_good,
                 abandon=lambda: self._unpin(path),
             )
         else:
@@ -786,7 +819,9 @@ class FlashCheckpointer:
                 "RAM tier for step %d unavailable; persisting from "
                 "the in-memory snapshot", step,
             )
-            job = _PersistJob(step, ("snapshot", snapshot), force)
+            job = _PersistJob(
+                step, ("snapshot", snapshot), force, last_good=last_good
+            )
         self._persistq.submit(job)
 
     def _skip_persist(self, job: _PersistJob, reason: str) -> None:
@@ -846,7 +881,9 @@ class FlashCheckpointer:
                     job.abandon()  # upload done/failed: unpin RAM file
             else:  # "snapshot": RAM tier failed — archive from memory
                 buf = io.BytesIO()
-                size = ckpt_store.snapshot_to_file(payload, step, buf)
+                size = ckpt_store.snapshot_to_file(
+                    payload, step, buf, last_good=job.last_good
+                )
                 buf.seek(0)
                 ckpt_store.put_shard_stream(
                     self._store, step, self._process_index, buf,
@@ -866,6 +903,7 @@ class FlashCheckpointer:
                 self._store, step, self._n_processes,
                 attempt=self._attempt,
                 timeout=self.commit_timeout,
+                last_good=job.last_good,
             )
             if committed:
                 ckpt_store.gc_steps(self._store, self.max_persist_keep)
@@ -1078,20 +1116,33 @@ class FlashCheckpointer:
         if step is None:
             return None, None
         if step in ram:
+            tainted = False
             try:
                 with open(ram[step], "rb") as f:
-                    snapshot, _ = ckpt_store.snapshot_from_file(
-                        f, target
-                    )
-                state = _restore_shards(snapshot, target)
-                logger.info("Restored step %d from RAM tier", step)
-                _observe_ckpt(
-                    "restore", "ram", step, time.time() - t0,
-                )
-                return state, step
+                    # an auto-selected step saved inside an anomaly
+                    # window must not be restored — the corruption the
+                    # sentinel tripped on may already be in it. An
+                    # explicit step is the caller's (master's) choice.
+                    if (auto_step and
+                            ckpt_store.archive_last_good(f) is False):
+                        tainted = True
+                    else:
+                        snapshot, _ = ckpt_store.snapshot_from_file(
+                            f, target
+                        )
+                        state = _restore_shards(snapshot, target)
+                        logger.info(
+                            "Restored step %d from RAM tier", step
+                        )
+                        _observe_ckpt(
+                            "restore", "ram", step, time.time() - t0,
+                        )
+                        return state, step
             except Exception as e:
                 logger.warning("RAM restore failed (%s); trying persistent",
                                e)
+            if tainted:
+                self._note_tainted(step, step, tier="ram")
         if self._manager is not None:
             import orbax.checkpoint as ocp
 
@@ -1132,6 +1183,11 @@ class FlashCheckpointer:
                 s for s in reversed(avail or []) if s < step
             ]
         for cand in candidates:
+            if (auto_step and
+                    ckpt_store.step_last_good(self._store, cand)
+                    is False):
+                self._note_tainted(cand, step, tier="persistent")
+                continue
             try:
                 with ckpt_store.open_step(
                     self._store, cand, self._process_index
@@ -1173,6 +1229,26 @@ class FlashCheckpointer:
             )
             return _restore_shards(snapshot, target), cand
         return None, None
+
+    def _note_tainted(self, cand: int, requested: int,
+                      tier: str) -> None:
+        """Journal an auto-restore candidate rejected for carrying the
+        ``last_good=False`` tag (saved inside a sentinel anomaly
+        window) — same vocabulary as every other walk-down rejection."""
+        record(
+            "checkpoint.restore_fallback", step=cand,
+            requested_step=requested, reason="anomaly_window",
+            tier=tier,
+        )
+        counter(
+            "dlrover_ckpt_restore_fallbacks_total",
+            "Persist-tier restore candidates rejected during "
+            "the walk-down", ["reason"],
+        ).labels(reason="anomaly_window").inc()
+        logger.warning(
+            "Step %d (%s tier) was saved inside an anomaly window; "
+            "skipping it for restore", cand, tier,
+        )
 
     def _agree_restored(self, ok: bool) -> bool:
         """All-process agreement on a restore outcome (auto mode): True

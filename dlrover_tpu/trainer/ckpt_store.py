@@ -139,7 +139,8 @@ def _is_snap(x) -> bool:
     return isinstance(x, dict) and x.get("__jax_shards__") is True
 
 
-def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO) -> int:
+def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO,
+                     last_good: Optional[bool] = None) -> int:
     """Stream a local-shard snapshot pytree to ``fileobj`` as a safe
     archive; returns the bytes written (-1 if the file can't tell()).
 
@@ -170,6 +171,12 @@ def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO) -> int:
         # field existed simply skip verification)
         "digests": {},
     }
+    if last_good is not None:
+        # sentinel verdict at save time (fault_tolerance/sentinel.py):
+        # False = this save happened inside an anomaly window and the
+        # restore walk-down must skip it. Absent (older archives, or no
+        # sentinel armed) is treated as clean.
+        manifest["last_good"] = bool(last_good)
     counter = [0]
 
     with zipfile.ZipFile(
@@ -351,6 +358,23 @@ def snapshot_from_bytes(data: bytes, target: Any = None):
     like the evaluator that read params by name.
     """
     return snapshot_from_file(io.BytesIO(data), target)
+
+
+def archive_last_good(fileobj: BinaryIO) -> Optional[bool]:
+    """Peek the sentinel verdict out of an archive's manifest WITHOUT
+    loading (or digest-verifying) the arrays — the RAM-tier restore
+    path must be able to reject a tainted archive for pennies. Returns
+    None for untagged (pre-sentinel) or unreadable archives: both are
+    treated as clean, matching :func:`step_last_good`."""
+    try:
+        pos = fileobj.tell()
+        with zipfile.ZipFile(fileobj) as zf:
+            manifest = json.loads(zf.read(_MANIFEST).decode("utf-8"))
+        fileobj.seek(pos)
+        v = manifest.get("last_good")
+    except Exception:
+        return None
+    return None if v is None else bool(v)
 
 
 def snapshot_from_file(fileobj: BinaryIO, target: Any = None):
@@ -663,18 +687,37 @@ def open_step(store: ObjectStore, step: int,
 
 
 def commit_step(store: ObjectStore, step: int, n_processes: int,
-                attempt: str = "0", timeout: float = 600.0) -> bool:
+                attempt: str = "0", timeout: float = 600.0,
+                last_good: Optional[bool] = None) -> bool:
     """The slow half: wait for peers' same-attempt shards, publish
     COMMIT. Split from put_shard so callers can drop locks (and the
-    archive bytes) before a potentially long barrier wait."""
+    archive bytes) before a potentially long barrier wait.
+    ``last_good`` (tri-state) carries the saver's sentinel verdict into
+    the COMMIT doc so ``step_last_good`` can read it without opening an
+    archive."""
     if n_processes > 1 and not _await_shards(
         store, step, n_processes, timeout, attempt
     ):
         return False
-    store.put(commit_key(step), json.dumps({
+    doc = {
         "step": step, "n_processes": n_processes, "attempt": attempt,
-    }).encode("utf-8"))
+    }
+    if last_good is not None:
+        doc["last_good"] = bool(last_good)
+    store.put(commit_key(step), json.dumps(doc).encode("utf-8"))
     return True
+
+
+def step_last_good(store: ObjectStore, step: int) -> Optional[bool]:
+    """The sentinel verdict recorded at commit time: False = saved
+    inside an anomaly window, True = sentinel-clean, None = no verdict
+    (pre-sentinel archive, or unreadable COMMIT — treated as clean by
+    callers, matching pre-tag behavior)."""
+    try:
+        v = _commit_manifest(store, step).get("last_good")
+    except KeyError:
+        return None
+    return None if v is None else bool(v)
 
 
 def _await_shards(store: ObjectStore, step: int, n_processes: int,

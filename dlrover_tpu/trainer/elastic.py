@@ -135,6 +135,12 @@ class ElasticTrainer:
         )
 
         self._fault_injector = FaultInjector.from_env(self._master_client)
+        # silent-failure sentinel (fault_tolerance/sentinel.py): NaN /
+        # SDC detection on the loss scalar the loop already reports;
+        # DLROVER_TPU_SENTINEL=0 disables
+        from dlrover_tpu.fault_tolerance.sentinel import TrainingSentinel
+
+        self._sentinel = TrainingSentinel.from_env(self._master_client)
         # zero-code timeline capture (DLROVER_TRACE_DIR): see
         # trainer/profiler.py TraceCapture
         from dlrover_tpu.trainer.profiler import TraceCapture
@@ -296,7 +302,14 @@ class ElasticTrainer:
         )
         return prof
 
-    def report_step(self, step: Optional[int] = None):
+    def report_step(self, step: Optional[int] = None,
+                    loss=None, grad_norm=None):
+        """Advance the trainer's step bookkeeping. When the loop passes
+        its ``loss`` scalar (and optionally the optimizer's global
+        ``grad_norm``), the silent-failure sentinel inspects them for
+        NaN/SDC anomalies; the (possibly injection-corrupted) effective
+        loss is returned so drills observe the same value the sentinel
+        saw."""
         self._global_step = step if step is not None else (
             self._global_step + 1
         )
@@ -325,6 +338,23 @@ class ElasticTrainer:
             self._trace_capture.step(self._global_step)
         if self._fault_injector is not None:
             self._fault_injector.maybe_inject(self._global_step)
+        if loss is not None:
+            loss = float(loss)
+            if self._fault_injector is not None:
+                # corruption drills (nan@N / sdc@N) poison the scalar
+                # here so the sentinel sees exactly what a corrupting
+                # host would produce
+                loss = self._fault_injector.corrupt_loss(
+                    self._global_step, loss
+                )
+            if self._sentinel is not None:
+                self._sentinel.check(
+                    self._global_step, loss, grad_norm
+                )
+        elif self._sentinel is not None:
+            # no scalar this step: still poll for rollback orders
+            # issued on another rank's anomaly
+            self._sentinel.poll_rollback_order()
         if (
             self._master_client is not None
             and self._global_step % self._report_interval == 0
@@ -335,6 +365,7 @@ class ElasticTrainer:
                 )
             except Exception as e:
                 logger.warning("report_global_step failed: %s", e)
+        return loss
 
     # ---------------------------------------------------------- checkpoint
 
@@ -356,6 +387,12 @@ class ElasticTrainer:
         docs/CHECKPOINT.md."""
         self._checkpointer = checkpointer
         self._ckpt_interval = max(0, int(save_interval))
+        if self._sentinel is not None and hasattr(
+            checkpointer, "set_clean_fn"
+        ):
+            # archives saved inside an anomaly window get tagged
+            # last_good=False and are skipped by the restore walk-down
+            checkpointer.set_clean_fn(self._sentinel.is_clean)
 
     def maybe_checkpoint(self, state, step: Optional[int] = None,
                          force: bool = False) -> Optional[float]:
@@ -381,6 +418,8 @@ class ElasticTrainer:
             stall_ms = self._checkpointer.save(
                 step, state, force_persist=force
             )
+            if self._sentinel is not None:
+                self._sentinel.note_checkpoint(step)
             if stall_ms:
                 # the measured train-thread stall re-labels the tail
                 # of the current training interval as ckpt_stall
@@ -395,6 +434,12 @@ class ElasticTrainer:
     @property
     def global_step(self) -> int:
         return self._global_step
+
+    @property
+    def sentinel(self):
+        """The armed :class:`~dlrover_tpu.fault_tolerance.sentinel.
+        TrainingSentinel` (None when DLROVER_TPU_SENTINEL=0)."""
+        return self._sentinel
 
     @property
     def drain(self):

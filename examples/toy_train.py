@@ -92,6 +92,7 @@ def main():
     # to the last cadenced save
     cur = {"step": step, "state": state}
     trainer.attach_checkpointer(ckpt)
+    sent = trainer.sentinel
     trainer.drain.set_state_provider(
         lambda: (cur["step"], cur["state"])
     )
@@ -112,7 +113,23 @@ def main():
         )
         sharding.report_batch_done()
         step += 1
-        trainer.report_step(step)
+        # the sentinel inspects the loss scalar (and a corruption
+        # drill poisons it on the way in)
+        trainer.report_step(step, loss=loss)
+        if sent is not None and sent.pending_rollback() is not None:
+            # coordinated rollback: restore the master-ordered last
+            # sentinel-clean step and replay from there — the poisoned
+            # window never reaches the final state
+            order = sent.pending_rollback()
+            rolled, got = ckpt.restore(
+                target=cur["state"], step=order["step"]
+            )
+            if rolled is not None:
+                state = rolled
+                params, opt_state = state["params"], state["opt_state"]
+                step = int(state["step"])
+                sent.note_restored(step, order["id"])
+                print(f"ROLLBACK to step {step}", flush=True)
         # host copies: train_step donates (params, opt_state), so the
         # signal-time emergency save must not read device buffers the
         # next dispatch may have invalidated
@@ -130,6 +147,10 @@ def main():
                 # already be on tmpfs, not in the async serializer
                 durable=True,
             )
+            if sent is not None:
+                # ignored inside an anomaly window: a tainted save is
+                # never a rollback target
+                sent.note_checkpoint(step)
 
     # loss stays None when the loop body never ran (e.g. restored checkpoint
     # already at/after --steps, or the dataset was exhausted immediately)

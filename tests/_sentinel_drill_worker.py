@@ -1,0 +1,225 @@
+"""Drill worker for the silent-failure sentinel chaos test (not a
+test module).
+
+Speaks the real agent protocol against a live master: joins the
+training rendezvous, consumes data shards with a live
+:class:`TrainingSentinel` inspecting the per-step loss, saves a
+RAM-tier checkpoint (tagged with the sentinel's clean verdict) plus
+the matching shard-ledger snapshot every step, and reports the global
+step.
+
+Fault surface: ``DLROVER_FAULT_INJECT=nan@N:host=H`` (or
+``sdc@N:flip=K:host=H``) poisons host H's step-N loss scalar through
+the injector's ``corrupt_loss`` path. The sentinel must trip, report
+the anomaly over the supervised RPC, receive the coordinated rollback
+order, and every OTHER rank must learn the same order from the master
+KV broadcast.
+
+On an adopted order each rank restores the ordered last-good step from
+its RAM tier (``ROLLED <step> ok``); the DETECTING rank additionally
+rewinds the global shard ledger to the snapshot taken with that
+checkpoint, voiding every shard consumed after it. ``SHARD`` lines are
+emitted only for completions the master ACCEPTED, so the test's
+exactly-once arithmetic (effective = accepted − voided) is exact.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _state_for(step: int):
+    # step-stamped payload: the rollback can verify the restored arrays
+    # really belong to the step the order named
+    return {"w": np.full((8,), float(step)), "bias": np.arange(4.0) + step}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master_addr", required=True)
+    p.add_argument("--node_id", type=int, required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--ckpt_dir", required=True)
+    p.add_argument("--ram_dir", required=True)
+    p.add_argument("--dataset_size", type=int, default=96)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--shard_secs", type=float, default=0.05,
+                   help="simulated train time per shard")
+    p.add_argument("--fetch_batch", type=int, default=2)
+    p.add_argument("--lookahead", type=int, default=2,
+                   help="0 = no prefetch thread, so a quarantined "
+                        "worker leaves no in-flight shards behind")
+    args = p.parse_args()
+
+    from dlrover_tpu.common.log import set_process_index
+
+    set_process_index(args.node_id)
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.sharding.client import ShardingClient
+    from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+    from dlrover_tpu.fault_tolerance.injection import FaultInjector
+    from dlrover_tpu.fault_tolerance.sentinel import TrainingSentinel
+    from dlrover_tpu.telemetry import goodput, record
+    from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+    led = goodput.install()
+    restart_count = int(os.environ.get(NodeEnv.RESTART_COUNT, "0") or 0)
+
+    out = open(args.out, "a", buffering=1)
+
+    def emit(line: str):
+        out.write(line + "\n")
+        print(f"[worker {args.node_id}] {line}", flush=True)
+
+    client = MasterClient(
+        args.master_addr, node_id=args.node_id, node_type="worker",
+    )
+    client.update_node_status("running", "", restart_count)
+    injector = FaultInjector.from_env(role="worker")
+    sentinel = TrainingSentinel.from_env(client)
+    assert sentinel is not None, "drill needs the sentinel armed"
+
+    # max_ram_keep covers the whole run: the rollback restores an
+    # EXPLICIT step, so its archive must survive the RAM-tier gc
+    ckpt = FlashCheckpointer(
+        args.ckpt_dir,
+        ram_dir=args.ram_dir,
+        persist_interval=0,
+        max_ram_keep=64,
+        use_orbax=False,
+        stage="sync",
+    )
+    ckpt.set_clean_fn(sentinel.is_clean)
+
+    def rendezvous(tag: str) -> int:
+        client.join_rendezvous(args.node_id, 1)
+        deadline = time.monotonic() + 60
+        while True:
+            rdzv_round, _, world = client.get_comm_world(
+                RendezvousName.TRAINING, args.node_id
+            )
+            if world and args.node_id in world:
+                record("rendezvous.joined", round=rdzv_round,
+                       node=args.node_id)
+                emit(f"{tag} {rdzv_round}")
+                return rdzv_round
+            if time.monotonic() > deadline:
+                emit(f"ERROR {tag} timeout")
+                raise TimeoutError(tag)
+            time.sleep(0.2)
+
+    client.report_rdzv_params(
+        min_nodes=1, max_nodes=2, waiting_timeout=0.5, node_unit=1,
+    )
+    rendezvous("ROUND")
+
+    sharding = ShardingClient(
+        dataset_name="sentinel-drill",
+        batch_size=args.batch_size,
+        num_epochs=1,
+        dataset_size=args.dataset_size,
+        shuffle=False,
+        num_minibatches_per_shard=1,
+        master_client=client,
+        fetch_batch=args.fetch_batch,
+        lookahead=args.lookahead,
+    )
+
+    step = 0
+    last_saved = 0
+    cur = _state_for(0)
+    #: per-save shard-ledger snapshots keyed by step — in a production
+    #: loop this JSON rides inside the model checkpoint payload
+    ledgers = {}
+
+    def do_rollback(order) -> None:
+        nonlocal step, cur
+        emit(f"ROLLBACK {order['step']} {step} {order['id']}")
+        # the order names the DETECTOR's last-good step; each rank's
+        # step counter is local in this drill (no shared global step),
+        # so a slightly-behind rank restores its newest save at or
+        # below the ordered step. The detector always has the exact
+        # ordered step — that is where its last_good came from.
+        target = min(int(order["step"]), last_saved)
+        assert target > 0, (order, last_saved)
+        state, got = ckpt.restore(step=target)
+        assert got == target, (got, target, order)
+        ok = int(state["w"][0]) == int(target)
+        cur, step = state, int(got)
+        # only the DETECTING rank rewinds the (global) shard ledger:
+        # one incident, one rewind
+        if sentinel.anomaly_count > 0 and order["step"] in ledgers:
+            sharding.restore_shard_from_checkpoint(ledgers[order["step"]])
+            emit(f"LEDGER_RESTORED {order['step']} {time.time():.6f}")
+        sentinel.note_restored(target, order["id"])
+        # the RUNNING re-report closes the rollback window on the
+        # master (servicer _rollback_ranks -> rollback.recovered)
+        client.update_node_status("running", "", restart_count)
+        emit(f"ROLLED {int(got)} {'ok' if ok else 'STATE_MISMATCH'}")
+
+    while True:
+        order = sentinel.pending_rollback()
+        if order is not None:
+            do_rollback(order)
+        if sentinel.job_failed:
+            emit("JOB_FAILED")
+            return 5
+        if sentinel.quarantined:
+            # the master evicted this host as a repeat offender; the
+            # pending rollback was honored above (its ledger rewind
+            # requeued this rank's voided work), so stand down and let
+            # the remaining nodes finish the epoch
+            emit("QUARANTINED")
+            break
+        shard = sharding.fetch_shard(poll_interval=0.2, max_wait=120.0)
+        if shard is None:
+            break
+        time.sleep(args.shard_secs)
+        step += 1
+        cur = _state_for(step)
+        # deterministic finite loss stream; the injector poisons it on
+        # the configured host/step and the sentinel sees the result
+        loss = 1.0 + 0.1 * np.sin(step)
+        if injector is not None:
+            loss = injector.corrupt_loss(step, loss)
+        anomaly = sentinel.check(step, loss)
+        if anomaly is not None:
+            emit(f"TRIP {anomaly['kind']} {step}")
+        led.on_step()
+        if sentinel.is_clean():
+            ckpt.save(step, cur, durable=True)
+            sentinel.note_checkpoint(step)
+            last_saved = step
+            ledgers[step] = sharding.get_shard_checkpoint()
+            emit(f"SAVED {step} {time.time():.6f}")
+        assert sharding._current_task is not None
+        task_id = sharding._current_task.task_id
+        if sharding.report_task_done(task_id):
+            # only master-ACCEPTED completions count: a rejected report
+            # means the shard was requeued by the ledger rewind and
+            # will be consumed again
+            emit(f"SHARD {shard.start} {shard.end} {time.time():.6f}")
+        client.report_global_step(step)
+
+    # a rollback ordered while this rank was draining its last shard
+    order = sentinel.poll_rollback_order()
+    if order is not None:
+        do_rollback(order)
+
+    emit(f"STEPS {step}")
+    emit(f"ANOMALIES {sentinel.anomaly_count}")
+    snap = led.close()
+    client.report_goodput(final=True)
+    emit(f"ELAPSED {snap['elapsed_s']:.3f}")
+    emit("DONE")
+    ckpt.close()
+    client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,7 @@ DRILL_MODULES = {
     "test_four_node_drill",
     "test_goodput_drill",
     "test_preemption_drill",
+    "test_sentinel_drill",
     "test_slice_soak_drill",
     "test_scale_up_drill",
     "test_streaming_e2e",
@@ -105,6 +106,7 @@ MODULE_BUDGET_OVERRIDES = {
     "test_four_node_drill": 240.0,
     "test_goodput_drill": 180.0,
     "test_preemption_drill": 120.0,
+    "test_sentinel_drill": 120.0,
     "test_master_failover": 180.0,
     "test_two_node_failover": 180.0,
     "test_e2e_elastic_run": 180.0,

@@ -204,10 +204,10 @@ def test_save_returns_before_serialization_completes(tmp_path,
     release = threading.Event()
     real = ckpt_store.snapshot_to_file
 
-    def gated(snapshot, step, fileobj):
+    def gated(snapshot, step, fileobj, **kw):
         serialize_started.set()
         assert release.wait(10.0), "test deadlock"
-        return real(snapshot, step, fileobj)
+        return real(snapshot, step, fileobj, **kw)
 
     monkeypatch.setattr(ckpt_store, "snapshot_to_file", gated)
     ckpt = _ckpt(tmp_path, persist_interval=0)
@@ -241,9 +241,9 @@ def test_wait_staged_marks_donation_safe_point(tmp_path, monkeypatch):
     gate = threading.Event()
     real = ckpt_store.snapshot_to_file
 
-    def slow(snapshot, step, fileobj):
+    def slow(snapshot, step, fileobj, **kw):
         assert gate.wait(10.0)
-        return real(snapshot, step, fileobj)
+        return real(snapshot, step, fileobj, **kw)
 
     monkeypatch.setattr(ckpt_store, "snapshot_to_file", slow)
     ckpt = _ckpt(tmp_path, persist_interval=0)
@@ -279,9 +279,9 @@ def test_durable_drain_excluded_from_stall_histogram(tmp_path,
     The return value still reports the full train-thread cost."""
     real = ckpt_store.snapshot_to_file
 
-    def slow(snapshot, step, fileobj):
+    def slow(snapshot, step, fileobj, **kw):
         time.sleep(0.3)
-        return real(snapshot, step, fileobj)
+        return real(snapshot, step, fileobj, **kw)
 
     monkeypatch.setattr(ckpt_store, "snapshot_to_file", slow)
     ckpt = _ckpt(tmp_path, persist_interval=0)

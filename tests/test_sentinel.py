@@ -1,0 +1,742 @@
+"""Silent-failure sentinel unit tests (drill coverage lives in
+test_sentinel_drill.py).
+
+Covers each layer in isolation: the worker-side TrainingSentinel
+(non-finite trips, median+MAD spike detection, anomaly-window
+bookkeeping, exactly-once KV order adoption), the master-side
+report_anomaly protocol (rollback orders, duplicate-report riding,
+budget exhaustion, quarantine eviction), the QuarantineManager strike
+counting, the ErrorMonitor dedup, the ``last_good`` checkpoint tag
+end-to-end (archive manifest, COMMIT doc, restore walk-down skip), the
+nan/sdc injection grammar, the optimizer non-finite guard, and the
+rollback-rewind exactly-once semantics of the sampler and the
+sharding client.
+"""
+
+import json
+import logging
+import math
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu import telemetry as T
+from dlrover_tpu.agent.master_client import LocalMasterClient
+from dlrover_tpu.agent.sharding.client import ShardingClient
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import TrainingExceptionLevel
+from dlrover_tpu.fault_tolerance import injection
+from dlrover_tpu.fault_tolerance.sentinel import (
+    ROLLBACK_ORDER_KEY,
+    TrainingSentinel,
+)
+from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
+from dlrover_tpu.master.node.quarantine import QuarantineManager
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.optim import bf16 as bf16_mod
+from dlrover_tpu.telemetry.journal import EventJournal
+from dlrover_tpu.trainer import ckpt_store
+from dlrover_tpu.trainer.checkpoint import FlashCheckpointer, _local_shards
+from dlrover_tpu.trainer.sampler import ElasticDistributedSampler
+
+
+@pytest.fixture(autouse=True)
+def fresh_defaults():
+    T.set_default_registry(None)
+    T.set_default_journal(EventJournal(None))
+    yield
+    T.set_default_registry(None)
+    T.set_default_journal(EventJournal(None))
+
+
+def events(kind):
+    return T.default_journal().events(kind)
+
+
+# ---------------------------------------------------------------- detection
+
+
+def test_nonfinite_loss_trips():
+    s = TrainingSentinel(node_rank=3, host="host-a")
+    s.note_checkpoint(5)
+    r = s.check(6, float("nan"))
+    assert r["kind"] == "nonfinite_loss"
+    assert r["action"] == "none"  # no master client
+    assert r["value"] is None  # NaN is not journal/RPC-safe
+    assert not s.is_clean()
+    assert s.anomaly_count == 1
+    (ev,) = events("anomaly.detected")
+    assert ev["data"]["anomaly"] == "nonfinite_loss"
+    assert ev["data"]["step"] == 6
+    assert ev["data"]["last_good_step"] == 5
+    assert ev["data"]["host"] == "host-a"
+    assert ev["data"]["node_rank"] == 3
+
+
+def test_nonfinite_grad_trips_before_loss():
+    s = TrainingSentinel()
+    r = s.check(3, 1.0, grad_norm=float("inf"))
+    assert r["kind"] == "nonfinite_grad"
+    assert events("anomaly.detected")[0]["data"]["anomaly"] == (
+        "nonfinite_grad"
+    )
+
+
+def test_spike_trips_after_warmup():
+    s = TrainingSentinel(window=32, zmax=6.0, min_steps=8)
+    # before warm-up the spike detector is disarmed: a wild value is
+    # absorbed into the window, not tripped on
+    assert s.check(0, 50.0) is None
+    s2 = TrainingSentinel(window=32, zmax=6.0, min_steps=8)
+    for i in range(8):
+        assert s2.check(i, 1.0 + 0.02 * (-1) ** i) is None
+    r = s2.check(8, 100.0)
+    assert r["kind"] == "loss_spike"
+    assert r["zscore"] > 6.0
+    assert r["value"] == 100.0
+    # an ordinary sample does not trip
+    s3 = TrainingSentinel(window=32, zmax=6.0, min_steps=8)
+    for i in range(8):
+        assert s3.check(i, 1.0 + 0.02 * (-1) ** i) is None
+    assert s3.check(8, 1.03) is None
+
+
+def test_degenerate_constant_window():
+    s = TrainingSentinel(min_steps=4)
+    for i in range(6):
+        assert s.check(i, 2.0) is None
+    # MAD == 0: only a departure beyond max(1.0, |median|) trips
+    assert s.check(6, 3.5) is None  # |3.5-2| = 1.5 <= 2.0
+    r = s.check(7, 5.0)  # |5-2| = 3 > 2
+    assert r is not None and r["kind"] == "loss_spike"
+    # inf z-score is sanitized for the journal/RPC
+    assert r["zscore"] is None
+
+
+def test_anomaly_window_gates_note_checkpoint():
+    s = TrainingSentinel()
+    s.note_checkpoint(4)
+    s.check(5, float("nan"))
+    s.note_checkpoint(6)  # inside the window: must NOT become last-good
+    assert s.last_good_step == 4
+    s.note_restored(4, rollback_id=1)
+    assert s.is_clean()
+    assert s.last_good_step == 4
+    (ev,) = events("rollback.restored")
+    assert ev["data"]["step"] == 4 and ev["data"]["rollback_id"] == 1
+    s.note_checkpoint(8)
+    assert s.last_good_step == 8
+
+
+def test_note_restored_resets_spike_baseline():
+    s = TrainingSentinel(min_steps=4)
+    for i in range(6):
+        s.check(i, 1.0 + 0.02 * (-1) ** i)
+    assert s.check(6, 100.0) is not None
+    s.note_restored(3)
+    # the window was cleared: the detector re-arms only after min_steps
+    # fresh samples, so the first post-restore loss cannot trip
+    assert s.check(7, 100.0) is None
+
+
+# --------------------------------------------------- rollback-order adoption
+
+
+def test_adopt_order_from_kv_exactly_once():
+    client = LocalMasterClient()
+    s = TrainingSentinel(master_client=client)
+    client.kv_store_set(
+        ROLLBACK_ORDER_KEY, json.dumps({"id": 1, "step": 5}).encode()
+    )
+    assert s.poll_rollback_order() == {"id": 1, "step": 5}
+    assert len(events("rollback.ordered")) == 1
+    # re-broadcasts of the same order are adopted once
+    s.poll_rollback_order()
+    assert len(events("rollback.ordered")) == 1
+    s.note_restored(5, rollback_id=1)
+    assert s.pending_rollback() is None
+    # the stale KV content must not re-open the completed rollback
+    assert s.poll_rollback_order() is None
+    # a NEW order (higher id) is adopted
+    client.kv_store_set(
+        ROLLBACK_ORDER_KEY, json.dumps({"id": 2, "step": 9}).encode()
+    )
+    assert s.poll_rollback_order() == {"id": 2, "step": 9}
+
+
+def test_bad_order_json_is_ignored():
+    client = LocalMasterClient()
+    s = TrainingSentinel(master_client=client)
+    client.kv_store_set(ROLLBACK_ORDER_KEY, b"not json")
+    assert s.poll_rollback_order() is None
+
+
+def test_check_polls_order_on_step_cadence():
+    client = LocalMasterClient()
+    s = TrainingSentinel(master_client=client)
+    client.kv_store_set(
+        ROLLBACK_ORDER_KEY, json.dumps({"id": 7, "step": 3}).encode()
+    )
+    assert s.check(10, 1.0) is None
+    assert s.pending_rollback() == {"id": 7, "step": 3}
+
+
+class _FakeClient:
+    """Captures report_anomaly calls and answers a canned response."""
+
+    def __init__(self, resp):
+        self.resp = resp
+        self.calls = []
+
+    def report_anomaly(self, **kw):
+        self.calls.append(kw)
+        return self.resp
+
+    def kv_store_get(self, key):
+        return b""
+
+
+def test_report_adopts_master_rollback_order():
+    client = _FakeClient(comm.AnomalyResponse(
+        action="rollback", rollback_id=3, rollback_step=11,
+    ))
+    s = TrainingSentinel(master_client=client, host="h0")
+    s.note_checkpoint(11)
+    r = s.check(12, float("nan"))
+    assert r["action"] == "rollback"
+    assert s.pending_rollback() == {"id": 3, "step": 11}
+    assert client.calls[0]["last_good_step"] == 11
+    assert client.calls[0]["host"] == "h0"
+    # NaN value travels as 0.0 (JSON/RPC-safe), the kind carries meaning
+    assert client.calls[0]["value"] == 0.0
+
+
+def test_report_job_failed_verdict():
+    s = TrainingSentinel(master_client=_FakeClient(
+        comm.AnomalyResponse(action="job_failed")
+    ))
+    r = s.check(2, float("nan"))
+    assert r["action"] == "job_failed"
+    assert s.job_failed
+
+
+def test_report_quarantined_verdict_rides_the_rollback():
+    # the repeat-offender verdict arrives ON the rollback response: the
+    # sentinel must latch it AND still adopt the order, so the host
+    # honors the rewind before standing down
+    client = _FakeClient(comm.AnomalyResponse(
+        action="rollback", rollback_id=2, rollback_step=9,
+        quarantined=True,
+    ))
+    s = TrainingSentinel(master_client=client, host="h0")
+    assert not s.quarantined
+    s.note_checkpoint(9)
+    r = s.check(10, float("nan"))
+    assert r["action"] == "rollback"
+    assert s.quarantined
+    assert s.pending_rollback() == {"id": 2, "step": 9}
+    # the flag survives the restore — quarantine is not an incident
+    # that recovery clears
+    s.note_restored(9, 2)
+    assert s.quarantined
+
+
+def test_report_masterless_fallback():
+    s = TrainingSentinel(master_client=LocalMasterClient())
+    r = s.check(2, float("nan"))
+    # LocalMasterClient has no one to coordinate with: local window only
+    assert r["action"] == "none"
+    assert not s.job_failed and s.pending_rollback() is None
+
+
+def test_from_env_knobs(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_SENTINEL", "0")
+    assert TrainingSentinel.from_env() is None
+    monkeypatch.setenv("DLROVER_TPU_SENTINEL", "1")
+    monkeypatch.setenv("DLROVER_TPU_SENTINEL_WINDOW", "8")
+    monkeypatch.setenv("DLROVER_TPU_SENTINEL_ZMAX", "3.5")
+    monkeypatch.setenv("DLROVER_TPU_SENTINEL_MIN_STEPS", "4")
+    monkeypatch.setenv("DLROVER_TPU_NODE_RANK", "2")
+    s = TrainingSentinel.from_env()
+    assert s is not None
+    assert s._window.maxlen == 8
+    assert s._zmax == 3.5
+    assert s._min_steps == 4
+    assert s._node_rank == 2
+
+
+# ----------------------------------------------------- master-side protocol
+
+
+class _Rdzv:
+    def __init__(self):
+        self.removed = []
+
+    def remove_alive_node(self, rank):
+        self.removed.append(rank)
+
+    def mark_node_succeeded(self, rank):
+        pass
+
+
+class _JobManager:
+    def __init__(self):
+        self.failed = []
+        self.quarantined = []
+
+    def get_node(self, node_type, node_id):
+        return None
+
+    def update_node_status(self, *a, **kw):
+        pass
+
+    def mark_job_failed(self, reason):
+        self.failed.append(reason)
+
+    def handle_quarantine(self, node_type, node_id, host):
+        self.quarantined.append((node_type, node_id, host))
+
+
+def _report(node_id, host, last_good=5, kind="nonfinite_loss"):
+    return comm.AnomalyReport(
+        node_type="worker", node_id=node_id, kind=kind, step=6,
+        host=host, last_good_step=last_good,
+    )
+
+
+def _running(node_id):
+    return comm.NodeStatusRequest(
+        node_type="worker", node_id=node_id, status="running",
+    )
+
+
+def test_servicer_orders_rollback_and_recovers(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_MAX_ROLLBACKS", "3")
+    sv = MasterServicer(error_monitor=ErrorMonitor(
+        quarantine=QuarantineManager(threshold=10)
+    ))
+    resp = sv.handle("report_anomaly", _report(0, "host-a", last_good=5))
+    assert resp.action == "rollback"
+    assert resp.rollback_id == 1 and resp.rollback_step == 5
+    assert not resp.quarantined
+    order = json.loads(sv._kv_store.get(ROLLBACK_ORDER_KEY).decode())
+    assert order == {"id": 1, "step": 5}
+    # a second rank tripping on the SAME corrupted state rides the
+    # in-flight order instead of burning budget
+    resp2 = sv.handle("report_anomaly", _report(1, "host-b", last_good=4))
+    assert resp2.action == "rollback"
+    assert resp2.rollback_id == 1 and resp2.rollback_step == 5
+    assert sv._rollbacks_done == 1
+    (ev,) = events("rollback.initiated")
+    assert ev["data"]["anomaly"] == "nonfinite_loss"
+    assert ev["data"]["rollbacks"] == 1 and ev["data"]["budget"] == 3
+    # both ranks report RUNNING post-restore: the incident closes and
+    # rollback.recovered fires per rank
+    sv.handle("update_node_status", _running(0))
+    assert sv._active_rollback is not None  # rank 1 still restoring
+    sv.handle("update_node_status", _running(1))
+    assert sv._active_rollback is None
+    assert len(events("rollback.recovered")) == 2
+    # a LATER anomaly is a fresh (budget-counted) incident
+    resp3 = sv.handle("report_anomaly", _report(0, "host-a", last_good=9))
+    assert resp3.rollback_id == 2 and resp3.rollback_step == 9
+    assert sv._rollbacks_done == 2
+
+
+def test_servicer_no_clean_checkpoint_means_no_rollback():
+    sv = MasterServicer()
+    resp = sv.handle("report_anomaly", _report(0, "h", last_good=-1))
+    assert resp.action == "none"
+    assert not events("rollback.initiated")
+
+
+def test_servicer_rollback_budget_exhausts_to_job_failed(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_MAX_ROLLBACKS", "1")
+    jm = _JobManager()
+    sv = MasterServicer(job_manager=jm)
+    assert sv.handle(
+        "report_anomaly", _report(0, "h", last_good=5)
+    ).action == "rollback"
+    sv.handle("update_node_status", _running(0))
+    resp = sv.handle("report_anomaly", _report(0, "h", last_good=7))
+    assert resp.action == "job_failed"
+    assert jm.failed and "rollback budget exhausted" in jm.failed[0]
+    (ev,) = events("rollback.budget_exhausted")
+    assert ev["data"]["rollbacks"] == 1 and ev["data"]["budget"] == 1
+
+
+def test_servicer_quarantines_repeat_offender_host():
+    jm = _JobManager()
+    rdzv = _Rdzv()
+    sv = MasterServicer(
+        job_manager=jm, rdzv_managers={"elastic-training": rdzv},
+        error_monitor=ErrorMonitor(
+            quarantine=QuarantineManager(threshold=2)
+        ),
+    )
+    r1 = sv.handle(
+        "report_anomaly", _report(2, "bad-host", last_good=-1)
+    )
+    assert not r1.quarantined
+    r2 = sv.handle(
+        "report_anomaly", _report(2, "bad-host", last_good=-1)
+    )
+    assert r2.quarantined
+    # surgical eviction: the host's rank leaves rendezvous NOW and the
+    # job manager stops relaunching onto the host
+    assert 2 in rdzv.removed
+    assert jm.quarantined == [("worker", 2, "bad-host")]
+    (ev,) = events("quarantine.imposed")
+    assert ev["data"]["host"] == "bad-host"
+    assert ev["data"]["anomalies"] == 2
+
+
+# ------------------------------------------------------- quarantine manager
+
+
+def test_quarantine_threshold_strikes_and_sink():
+    seen = []
+    qm = QuarantineManager(threshold=2, placement_sink=seen.append)
+    assert qm.note_anomaly("h1", kind="loss_spike", step=4) is False
+    assert qm.note_anomaly("h1", kind="loss_spike", step=9) is True
+    # already quarantined: further strikes count but do not re-impose
+    assert qm.note_anomaly("h1") is False
+    assert qm.is_quarantined("h1")
+    assert qm.anomaly_count("h1") == 3
+    assert qm.quarantined_hosts() == ["h1"]
+    qm.note_anomaly("h0")
+    qm.note_anomaly("h0")
+    assert seen == [["h1"], ["h0", "h1"]]  # sorted full list each time
+
+
+def test_quarantine_disabled_and_anonymous():
+    qm = QuarantineManager(threshold=0)
+    assert qm.note_anomaly("h1") is False
+    assert qm.note_anomaly("h1") is False
+    assert not qm.is_quarantined("h1")
+    qm2 = QuarantineManager(threshold=1)
+    assert qm2.note_anomaly("") is False  # unattributable report
+
+
+def test_quarantine_threshold_from_env(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_QUARANTINE_THRESHOLD", "3")
+    qm = QuarantineManager()
+    assert qm.note_anomaly("h") is False
+    assert qm.note_anomaly("h") is False
+    assert qm.note_anomaly("h") is True
+
+
+# ----------------------------------------------------------- error monitor
+
+
+def test_error_monitor_dedups_identical_reports():
+    captured = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: captured.append(rec.getMessage())
+    logging.getLogger("dlrover_tpu").addHandler(handler)
+    try:
+        em = ErrorMonitor()
+        node = SimpleNamespace(id=1, name="worker-1")
+        lvl = TrainingExceptionLevel.PROCESS_ERROR
+        assert em.process_error(node, 0, "OOM at step 3", lvl) is False
+        # byte-identical re-report of the same incident: suppressed
+        em.process_error(node, 0, "OOM at step 3", lvl)
+        logged = [m for m in captured if "Process error" in m]
+        assert len(logged) == 1
+        # a DIFFERENT error in the same restart is new information
+        em.process_error(node, 0, "bus error", lvl)
+        logged = [m for m in captured if "Process error" in m]
+        assert len(logged) == 2
+    finally:
+        logging.getLogger("dlrover_tpu").removeHandler(handler)
+    # every report reaches the journal timeline, deduped or not
+    evs = events("node.error")
+    assert len(evs) == 3
+    assert evs[0]["data"]["error"] == "OOM at step 3"
+    assert evs[0]["data"]["restart_count"] == 0
+
+
+def test_error_monitor_node_error_is_critical():
+    em = ErrorMonitor()
+    assert em.process_error(
+        "w-2", 1, "device lost", TrainingExceptionLevel.NODE_ERROR
+    ) is True
+    (ev,) = events("node.error")
+    assert ev["data"]["level"] == TrainingExceptionLevel.NODE_ERROR
+
+
+# -------------------------------------------------------- last_good tagging
+
+
+def _toy_state(v):
+    return {"w": jnp.full((4,), float(v), jnp.float32)}
+
+
+def test_archive_last_good_roundtrip(tmp_path):
+    for tag in (True, False, None):
+        path = tmp_path / f"a-{tag}"
+        with open(path, "wb") as f:
+            ckpt_store.snapshot_to_file(
+                _local_shards(_toy_state(1)), 3, f, last_good=tag
+            )
+        with open(path, "rb") as f:
+            f.seek(0)
+            assert ckpt_store.archive_last_good(f) is tag
+            # the peek must not move the cursor: a full restore still
+            # works on the same fileobj
+            snap, step = ckpt_store.snapshot_from_file(f)
+            assert step == 3
+
+
+def test_commit_doc_carries_last_good(tmp_path):
+    store = ckpt_store.LocalFsStore(str(tmp_path))
+    for step, tag in ((2, True), (4, False), (6, None)):
+        store.put(ckpt_store.step_key(step, 0), b"shard")
+        assert ckpt_store.commit_step(
+            store, step, n_processes=1, last_good=tag
+        )
+        assert ckpt_store.step_last_good(store, step) is tag
+    # a step with no COMMIT at all reads as "no verdict"
+    assert ckpt_store.step_last_good(store, 99) is None
+
+
+def test_restore_walkdown_skips_anomaly_window_saves(tmp_path):
+    clean = [True]
+    writer = FlashCheckpointer(
+        persist_dir=str(tmp_path / "bucket"),
+        ram_dir=str(tmp_path / "ram_a"),
+        persist_interval=1, use_orbax=False, stage="sync",
+    )
+    writer.set_clean_fn(lambda: clean[0])
+    writer.save(2, _toy_state(2))
+    clean[0] = False  # anomaly window opens
+    writer.save(4, _toy_state(4))
+    writer.wait()
+
+    # spare reader (empty RAM tier): auto-restore walks down past the
+    # tainted newest step to the sentinel-clean one
+    reader = FlashCheckpointer(
+        persist_dir=str(tmp_path / "bucket"),
+        ram_dir=str(tmp_path / "ram_b"),
+        persist_interval=0, use_orbax=False,
+    )
+    state, step = reader.restore()
+    assert step == 2
+    evs = events("checkpoint.restore_fallback")
+    assert any(
+        e["data"]["reason"] == "anomaly_window"
+        and e["data"]["step"] == 4 for e in evs
+    )
+    fb = T.default_registry().get("dlrover_ckpt_restore_fallbacks_total")
+    assert fb.labels(reason="anomaly_window").value >= 1
+
+    # the writer's own RAM tier holds the tainted archive too: the
+    # RAM-tier peek rejects it for pennies before the persist walk-down
+    T.set_default_journal(EventJournal(None))
+    state, step = writer.restore()
+    assert step == 2
+    tiers = {
+        e["data"]["tier"] for e in events("checkpoint.restore_fallback")
+        if e["data"]["reason"] == "anomaly_window"
+    }
+    assert tiers == {"ram", "persistent"}
+
+    # an EXPLICITLY requested step is the caller's choice: the master's
+    # rollback order may legitimately target any committed step
+    state, step = reader.restore(step=4)
+    assert step == 4
+    writer.close()
+    reader.close()
+
+
+# ------------------------------------------------------- injection grammar
+
+
+def test_parse_spec_corruption_kinds():
+    faults = injection.parse_spec("nan@6:host=0,sdc@5:flip=2!")
+    assert [(f.kind, f.step, f.arg) for f in faults] == [
+        ("nan", 6, "host=0"), ("sdc", 5, "flip=2"),
+    ]
+    assert [f.every_incarnation for f in faults] == [False, True]
+    with pytest.raises(ValueError):
+        injection.parse_spec("flip@3")
+    with pytest.raises(ValueError):
+        injection.parse_spec("nan6")
+
+
+def test_parse_spec_kv_continuation_extends_previous_fault():
+    # the spec splits on commas, but so do kv args: a "k=v" chunk
+    # without "@" extends the fault before it, making the documented
+    # sdc@STEP:flip=K,host=H form parseable
+    (f,) = injection.parse_spec("sdc@5:flip=2,host=1")
+    assert (f.kind, f.step, f.arg) == ("sdc", 5, "flip=2,host=1")
+    faults = injection.parse_spec("sdc@5:flip=2,host=1!,nan@9")
+    assert [(f.kind, f.arg, f.every_incarnation) for f in faults] == [
+        ("sdc", "flip=2,host=1", True), ("nan", "", False),
+    ]
+    # the combined arg feeds both the host filter and the flip width
+    other = injection.FaultInjector(
+        spec="sdc@5:flip=2,host=1", node_rank=0
+    )
+    assert other.corrupt_loss(5, 1.25) == 1.25
+    target = injection.FaultInjector(
+        spec="sdc@5:flip=2,host=1", node_rank=1
+    )
+    out = target.corrupt_loss(5, 1.25)
+    assert math.isfinite(out) and out != 1.25
+    # a leading continuation has nothing to extend
+    with pytest.raises(ValueError):
+        injection.parse_spec("host=1,nan@3")
+
+
+def test_host_filter_scopes_corruption_to_one_rank():
+    other = injection.FaultInjector(spec="nan@6:host=1", node_rank=0)
+    assert other.corrupt_loss(6, 1.25) == 1.25
+    target = injection.FaultInjector(spec="nan@6:host=1", node_rank=1)
+    assert math.isnan(target.corrupt_loss(6, 1.25))
+
+
+def test_corrupt_loss_fires_once_outside_maybe_inject():
+    inj = injection.FaultInjector(spec="nan@3")
+    assert inj.corrupt_loss(2, 1.0) == 1.0  # not due yet
+    inj.maybe_inject(3)  # corruption kinds do NOT execute here
+    assert math.isnan(inj.corrupt_loss(3, 1.0))
+    assert inj.corrupt_loss(4, 1.0) == 1.0  # fired once
+    (ev,) = events("fault.injected")
+    assert ev["data"]["fault"] == "nan" and ev["data"]["step"] == 3
+
+
+def test_sdc_flip_is_finite_but_wrong():
+    inj = injection.FaultInjector(spec="sdc@5:flip=2")
+    out = inj.corrupt_loss(5, 1.234)
+    assert math.isfinite(out) and out != 1.234
+    # nbits clamps to [1, 10] and never produces inf/nan
+    for nbits in (0, 1, 10, 99):
+        y = injection._flip_bits(1.234, nbits)
+        assert math.isfinite(y) and y != 1.234
+    assert injection._flip_bits(1.234, 0) == injection._flip_bits(1.234, 1)
+    assert injection._flip_bits(1.234, 99) == injection._flip_bits(
+        1.234, 10
+    )
+
+
+def test_restart_count_gates_corruption_faults():
+    relaunched = injection.FaultInjector(spec="nan@3", restart_count=1)
+    assert relaunched.corrupt_loss(3, 1.0) == 1.0
+    persistent = injection.FaultInjector(spec="nan@3!", restart_count=1)
+    assert math.isnan(persistent.corrupt_loss(3, 1.0))
+
+
+def test_from_env_none_without_spec(monkeypatch):
+    monkeypatch.delenv(injection.ENV_SPEC, raising=False)
+    assert injection.FaultInjector.from_env() is None
+
+
+# --------------------------------------------------- optimizer guard (bf16)
+
+
+def test_nonfinite_guard_skips_poisoned_update(monkeypatch):
+    monkeypatch.setattr(bf16_mod, "_skips_published", 0)
+    opt = bf16_mod.nonfinite_guard(optax.sgd(0.1, momentum=0.9))
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    state = opt.init(params)
+    good = {"w": jnp.full((4,), 0.5, jnp.float32)}
+    updates, state = opt.update(good, state, params)
+    params = optax.apply_updates(params, updates)
+    skips, norm = bf16_mod.guard_stats(state)
+    assert skips == 0 and norm == pytest.approx(1.0)
+
+    trace_before = state.inner_state
+    bad = {"w": jnp.array([np.nan, 0.5, 0.5, 0.5], jnp.float32)}
+    updates, state = opt.update(bad, state, params)
+    # the whole update is selected to zero — params unchanged
+    np.testing.assert_array_equal(
+        np.asarray(updates["w"]), np.zeros(4, np.float32)
+    )
+    # the momentum trace kept its PREVIOUS (finite) value: a NaN must
+    # not outlive the step that produced it
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(state.inner_state)[0]),
+        np.asarray(jax.tree.leaves(trace_before)[0]),
+    )
+    skips, norm = bf16_mod.guard_stats(state)
+    assert skips == 1 and math.isnan(norm)
+    c = T.default_registry().get("dlrover_optim_nonfinite_skips_total")
+    assert c.value == 1
+
+
+# ------------------------------------------- rollback rewind (exactly-once)
+
+
+def test_sampler_rewind_replays_voided_work_exactly_once():
+    s = ElasticDistributedSampler(dataset_size=12, shuffle=False)
+    it = s.iter_batches(2)
+    kept = [next(it) for _ in range(2)]  # indices 0..3, then snapshot
+    snap = s.state_dict()
+    assert snap == {"epoch": 0, "completed_num": 4}
+    voided = [next(it) for _ in range(2)]  # 4..7: rolled back
+    assert [int(i) for b in voided for i in b] == [4, 5, 6, 7]
+
+    s2 = ElasticDistributedSampler(dataset_size=12, shuffle=False)
+    s2.load_state_dict(snap)
+    replay = [b for b in s2.iter_batches(2)]
+    consumed = [int(i) for b in kept + replay for i in b]
+    # the voided indices come back exactly once; nothing is skipped or
+    # double-counted
+    assert consumed == list(range(12))
+
+
+def test_sampler_rewind_into_resized_world():
+    s = ElasticDistributedSampler(dataset_size=12, shuffle=False)
+    it = s.iter_batches(2)
+    next(it), next(it)  # 0..3 consumed
+    snap = s.state_dict()
+    seen = []
+    for rank in (0, 1):
+        r = ElasticDistributedSampler(dataset_size=12, shuffle=False)
+        r.load_state_dict(snap, num_replicas=2, rank=rank)
+        seen += [int(i) for b in r.iter_batches(2) for i in b]
+    # remaining 8 samples split cleanly across the new world: union
+    # covers the tail exactly once
+    assert sorted(seen) == list(range(4, 12))
+
+
+def test_sampler_state_clamps_overrun():
+    s = ElasticDistributedSampler(dataset_size=10, shuffle=False)
+    s.completed_num = 14  # padded epoch overran the dataset size
+    assert s.state_dict()["completed_num"] == 10
+
+
+def test_shard_ledger_rewind_voids_stale_completions():
+    client = LocalMasterClient()
+    sc = ShardingClient(
+        dataset_name="ds", batch_size=4, num_epochs=1,
+        dataset_size=24, shuffle=False, num_minibatches_per_shard=1,
+        master_client=client, fetch_batch=1, lookahead=0,
+    )
+    done = []
+    for _ in range(2):
+        shard = sc.fetch_shard(max_wait=10)
+        task_id = sc._current_task.task_id
+        assert sc.report_task_done(task_id) is True
+        done.append((shard.start, shard.end))
+    ledger = sc.get_shard_checkpoint()  # the rollback target's ledger
+    sc.fetch_shard(max_wait=10)  # in flight past the snapshot
+    stale_id = sc._current_task.task_id
+    sc.restore_shard_from_checkpoint(ledger)
+    # the rewound master requeued that range under a FRESH id: the
+    # stale completion must be rejected, not double-counted
+    assert sc.report_task_done(stale_id) is False
+    while True:
+        shard = sc.fetch_shard(max_wait=10)
+        if shard is None:
+            break
+        task_id = sc._current_task.task_id
+        assert sc.report_task_done(task_id) is True
+        done.append((shard.start, shard.end))
+    # accepted completions partition the dataset exactly once
+    assert sorted(done) == [(i, i + 4) for i in range(0, 24, 4)]

@@ -1,0 +1,528 @@
+"""Silent-failure sentinel chaos drill: NaN loss -> coordinated
+last-good rollback -> finite completion, exactly once.
+
+A real master serves two protocol-speaking workers
+(``_sentinel_drill_worker.py``), each with a live goodput ledger, an
+armed :class:`TrainingSentinel` and a real FlashCheckpointer whose
+saves carry the sentinel's clean verdict.
+``DLROVER_FAULT_INJECT=nan@6:host=0`` poisons worker 0's step-6 loss:
+the sentinel must trip (``nonfinite_loss``), report over the
+supervised RPC, and receive a rollback order naming its last
+sentinel-clean save (step 5). Worker 1 — which saw nothing wrong —
+must learn the SAME order from the master KV broadcast and restore in
+concert.
+
+Asserted: worker 0 restores exactly the ordered step with matching
+arrays; both ranks adopt the same order id; the detecting rank (and
+only it) rewinds the global shard ledger, so consumption voided by the
+rollback is re-dispatched and the dataset is still consumed exactly
+once; the journal tells the full story (anomaly.detected ->
+anomaly.reported -> rollback.initiated -> rollback.ordered x2 ->
+rollback.restored x2 -> rollback.recovered); a single strike stays
+below the quarantine threshold and inside the rollback budget; and the
+goodput account — live ``/goodput``, the master's job summary, and the
+offline journal reconstruction — books the incident under the
+``rollback`` badput cause.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_goodput_drill import (  # noqa: E402
+    _drill_env,
+    _free_port,
+    _killpg,
+    _master_port,
+    _poll_goodput,
+    _tail,
+    _wait,
+)
+
+from dlrover_tpu.telemetry import goodput
+from dlrover_tpu.telemetry.goodput import Phase
+from dlrover_tpu.telemetry.journal import read_journal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DATASET_SIZE = 96
+BATCH_SIZE = 4
+SHARD_SECS = 0.1
+#: the injected NaN lands on worker 0's step 6, so its last clean save
+#: (and therefore the ordered rollback step) is deterministically 5
+TRIP_STEP = 6
+LAST_GOOD = TRIP_STEP - 1
+
+
+def _spawn_master(tmp, env, state_dir, port, tag):
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.master.main",
+        "--platform", "process", "--node_num", "0",
+        "--job_name", "sentinel-drill", "--port", str(port),
+        "--state_dir", state_dir,
+        "--autoscale_interval", "600", "--check_interval", "0.2",
+    ]
+    return subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=open(os.path.join(tmp, f"master-{tag}.out"), "w"),
+        stderr=open(os.path.join(tmp, f"master-{tag}.err"), "w"),
+        start_new_session=True,
+    )
+
+
+def _spawn_worker(tmp, env, port, node_id, tag, ckpt_dir, ram_dir,
+                  dataset_size=DATASET_SIZE, fetch_batch=2,
+                  lookahead=2):
+    return subprocess.Popen(
+        [sys.executable,
+         os.path.join(REPO, "tests", "_sentinel_drill_worker.py"),
+         "--master_addr", f"localhost:{port}",
+         "--node_id", str(node_id),
+         "--out", os.path.join(tmp, f"worker-{tag}.txt"),
+         "--ckpt_dir", ckpt_dir,
+         "--ram_dir", ram_dir,
+         "--dataset_size", str(dataset_size),
+         "--batch_size", str(BATCH_SIZE),
+         "--shard_secs", str(SHARD_SECS),
+         "--fetch_batch", str(fetch_batch),
+         "--lookahead", str(lookahead)],
+        cwd=REPO, env=env,
+        stdout=open(os.path.join(tmp, f"worker-{tag}.out"), "w"),
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+
+
+def _worker_lines(tmp, tag, token):
+    path = os.path.join(tmp, f"worker-{tag}.txt")
+    try:
+        lines = open(path).read().splitlines()
+    except OSError:
+        return []
+    return [l.split() for l in lines if l.startswith(token)]
+
+
+def _await_live_rollback(port, workers, tmp):
+    """Poll /goodput until the rollback fault window shows up (open or
+    already recovered) while the run is still in flight."""
+    deadline = time.time() + 120
+    last = None
+    while time.time() < deadline:
+        try:
+            last = _poll_goodput(port, timeout=5)
+        except AssertionError:
+            last = None
+        if last is not None and any(
+            f.get("cause") == Phase.ROLLBACK
+            for f in last.get("faults", ())
+        ):
+            return last
+        if all(w.poll() is not None for w in workers):
+            # both workers already exited: one more poll below, then
+            # fail fast instead of burning the whole deadline
+            try:
+                last = _poll_goodput(port, timeout=5)
+            except AssertionError:
+                last = None
+            break
+        time.sleep(0.3)
+    assert last is not None and any(
+        f.get("cause") == Phase.ROLLBACK for f in last.get("faults", ())
+    ), (
+        f"/goodput never showed a rollback fault: {last}; "
+        + _tail(tmp, "worker-0.out") + " | " + _tail(tmp, "master-1.err")
+    )
+    return last
+
+
+def test_sentinel_nan_rollback_drill(tmp_path):
+    tmp = str(tmp_path)
+    state_dir = os.path.join(tmp, "state")
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    ckpt_dir = {i: os.path.join(tmp, f"ckpt-{i}") for i in (0, 1)}
+    ram_dir = {i: os.path.join(tmp, f"ram-{i}") for i in (0, 1)}
+    env = _drill_env(journal_path)
+    metrics_port = _free_port()
+    master_env = dict(
+        env,
+        DLROVER_TPU_METRICS_PORT=str(metrics_port),
+        # one strike must NOT quarantine (threshold is the SECOND
+        # strike) and must stay far inside the rollback budget
+        DLROVER_TPU_QUARANTINE_THRESHOLD="2",
+        DLROVER_TPU_MAX_ROLLBACKS="3",
+        # a generous watchdog so the only shard requeue in this drill
+        # is the ledger rewind, keeping the exactly-once arithmetic
+        # attributable to the rollback alone
+        DLROVER_TPU_CTX_TASK_PROCESS_TIMEOUT="60",
+    )
+
+    procs = []
+    try:
+        m = _spawn_master(tmp, master_env, state_dir, 0, "1")
+        procs.append(m)
+        port = _master_port(tmp, "1", m)
+
+        w0 = _spawn_worker(
+            tmp, dict(env,
+                      DLROVER_FAULT_INJECT=f"nan@{TRIP_STEP}:host=0",
+                      DLROVER_TPU_NODE_RANK="0"),
+            port, 0, "0", ckpt_dir[0], ram_dir[0],
+        )
+        w1 = _spawn_worker(
+            tmp, dict(env, DLROVER_TPU_NODE_RANK="1"),
+            port, 1, "1", ckpt_dir[1], ram_dir[1],
+        )
+        procs += [w0, w1]
+
+        # live /goodput mid-run: the ordered rollback is a fault
+        # window on the aggregator while the workers are still going
+        live = _await_live_rollback(metrics_port, [w0, w1], tmp)
+
+        for tag, w in (("0", w0), ("1", w1)):
+            rc = _wait(w, 180, f"worker {tag}", tmp,
+                       ["worker-0.out", "worker-1.out", "master-1.err"])
+            assert rc == 0, (
+                f"worker {tag} exited rc={rc}; "
+                + _tail(tmp, f"worker-{tag}.out")
+            )
+        rc_m = _wait(m, 60, "master", tmp, ["master-1.err"])
+        assert rc_m == 0, _tail(tmp, "master-1.err")
+    finally:
+        for p in procs:
+            _killpg(p, signal.SIGTERM)
+        time.sleep(0.5)
+        for p in procs:
+            _killpg(p)
+
+    # ---- the trip, the order, the restore ----------------------------
+    trips = _worker_lines(tmp, "0", "TRIP")
+    assert trips == [["TRIP", "nonfinite_loss", str(TRIP_STEP)]], trips
+    assert not _worker_lines(tmp, "1", "TRIP")
+
+    rb0 = _worker_lines(tmp, "0", "ROLLBACK")
+    rb1 = _worker_lines(tmp, "1", "ROLLBACK")
+    assert len(rb0) == 1 and len(rb1) == 1, (rb0, rb1)
+    # both ranks adopted the SAME order: same id, same ordered step
+    assert rb0[0][1] == rb1[0][1] == str(LAST_GOOD), (rb0, rb1)
+    assert rb0[0][3] == rb1[0][3], (rb0, rb1)
+
+    # the detector restored EXACTLY the ordered last-good step, and the
+    # restored arrays carry that step's stamp; the peer restored its
+    # newest save at or below the order with matching arrays too
+    rolled0 = _worker_lines(tmp, "0", "ROLLED")
+    assert rolled0 == [["ROLLED", str(LAST_GOOD), "ok"]], rolled0
+    rolled1 = _worker_lines(tmp, "1", "ROLLED")
+    assert len(rolled1) == 1 and rolled1[0][2] == "ok", rolled1
+    assert 0 < int(rolled1[0][1]) <= LAST_GOOD, rolled1
+
+    # only the DETECTING rank rewound the global shard ledger
+    restored = _worker_lines(tmp, "0", "LEDGER_RESTORED")
+    assert len(restored) == 1 and restored[0][1] == str(LAST_GOOD), restored
+    assert not _worker_lines(tmp, "1", "LEDGER_RESTORED")
+
+    # the run finished FINITE after the rollback: no budget exhaustion,
+    # exactly one anomaly job-wide, both ranks completed the epoch
+    for tag in ("0", "1"):
+        assert _worker_lines(tmp, tag, "DONE"), _tail(
+            tmp, f"worker-{tag}.txt"
+        )
+        assert not _worker_lines(tmp, tag, "JOB_FAILED")
+    assert _worker_lines(tmp, "0", "ANOMALIES") == [["ANOMALIES", "1"]]
+    assert _worker_lines(tmp, "1", "ANOMALIES") == [["ANOMALIES", "0"]]
+
+    # ---- exactly-once across the rollback ----------------------------
+    # SHARD lines are emitted only for master-ACCEPTED completions. The
+    # ledger rewind requeues (with fresh task ids) everything consumed
+    # after the last-good save, so exactly those ranges are consumed a
+    # second time: effective = accepted - voided.
+    t_rewind = float(restored[0][2])
+    by_range = {}
+    for tag in ("0", "1"):
+        for parts in _worker_lines(tmp, tag, "SHARD"):
+            rng = (int(parts[1]), int(parts[2]))
+            by_range.setdefault(rng, []).append(float(parts[3]))
+
+    ranges = sorted(by_range)
+    assert ranges[0][0] == 0 and ranges[-1][1] == DATASET_SIZE, ranges
+    for (_, end), (start, _) in zip(ranges, ranges[1:]):
+        assert end == start, f"shard gap/overlap at {start}: {ranges}"
+
+    dupes = {r: ts for r, ts in by_range.items() if len(ts) > 1}
+    # the detector consumed (and the master accepted) its trip-step
+    # shard before the rewind voided it, so at least one range repeats
+    assert dupes, by_range
+    for rng, ts in dupes.items():
+        # a range repeats for exactly one reason — the rewind: once
+        # voided before it, once effective after it
+        assert len(ts) == 2, (rng, ts)
+        assert min(ts) < t_rewind < max(ts), (rng, ts, t_rewind)
+
+    # ---- journal: the incident, step by step -------------------------
+    events = read_journal(journal_path)
+    kinds = [e.get("kind") for e in events]
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e.get("kind"), []).append(e)
+
+    injected = [e for e in by_kind.get("fault.injected", ())
+                if e["data"]["fault"] == "nan"]
+    assert len(injected) == 1, by_kind.get("fault.injected")
+
+    det = by_kind["anomaly.detected"]
+    assert len(det) == 1, det
+    assert det[0]["data"]["anomaly"] == "nonfinite_loss", det
+    assert det[0]["data"]["step"] == TRIP_STEP, det
+    assert det[0]["data"]["value"] is None, det  # NaN is not JSON
+    assert det[0]["data"]["last_good_step"] == LAST_GOOD, det
+
+    rep = by_kind["anomaly.reported"]
+    assert len(rep) == 1, rep
+    assert rep[0]["data"]["anomaly"] == "nonfinite_loss", rep
+    assert rep[0]["data"]["last_good_step"] == LAST_GOOD, rep
+
+    init = by_kind["rollback.initiated"]
+    assert len(init) == 1, init
+    assert init[0]["data"]["step"] == LAST_GOOD, init
+    assert init[0]["data"]["rollbacks"] == 1, init
+    order_id = init[0]["data"]["rollback_id"]
+    assert order_id == int(rb0[0][3]), (init, rb0)
+
+    # both ranks journaled the adoption and the restore of one order
+    ordered = by_kind["rollback.ordered"]
+    assert len(ordered) == 2, ordered
+    assert {e["data"]["node_rank"] for e in ordered} == {0, 1}, ordered
+    assert all(
+        e["data"]["rollback_id"] == order_id for e in ordered
+    ), ordered
+    rest = by_kind["rollback.restored"]
+    assert len(rest) == 2, rest
+    assert {e["data"]["node_rank"] for e in rest} == {0, 1}, rest
+
+    # the detector's RUNNING re-report closed the window ONCE — the
+    # peer rode the same order and never burned a second window
+    rec = by_kind["rollback.recovered"]
+    assert len(rec) == 1 and rec[0]["data"]["rank"] == 0, rec
+
+    # one strike: below the quarantine threshold, inside the budget
+    assert "quarantine.imposed" not in kinds, kinds
+    assert "rollback.budget_exhausted" not in kinds, kinds
+
+    # ---- goodput: the incident books as rollback badput --------------
+    win = next(
+        f for f in live["faults"] if f["cause"] == Phase.ROLLBACK
+    )
+    assert win.get("node_id") == 0, win
+
+    summaries = by_kind.get("goodput.job_summary", [])
+    assert len(summaries) == 1, summaries
+    live_job = summaries[0]["data"]
+    assert live_job["badput_s"][Phase.ROLLBACK] > 0.0, live_job
+
+    # offline replay tells the same story: a recovered rollback window
+    # attributed to the detecting node, with rollback badput booked
+    report = goodput.reconstruct(events)
+    off = next(
+        f for f in report["faults"] if f["cause"] == Phase.ROLLBACK
+    )
+    assert off["recovered_ts"] and off["recovered_ts"] >= off["ts"], off
+    assert report["job"]["badput_s"][Phase.ROLLBACK] > 0.0, report["job"]
+    assert report["job"]["procs"] == 2, report["job"]
+
+
+#: the sdc drill needs worker 0 to reach its local step 14 (second
+#: strike) while worker 1 still has shards left to drain afterwards —
+#: 40 shards across two workers leaves a wide margin on both sides
+SDC_DATASET = 160
+SDC_TRIPS = (8, 14)  # worker-0 local steps the two sdc faults land on
+
+
+def test_sdc_repeat_offender_quarantine_drill(tmp_path):
+    """Repeated SDC attributed to ONE host: two loss-spike strikes on
+    worker 0 order two coordinated rollbacks (inside the budget), the
+    second strike imposes the quarantine — rendezvous eviction + no
+    relaunch onto the host — and worker 0 honors its last rewind, then
+    stands down while worker 1 finishes the epoch. The dataset is
+    still consumed exactly once, and one shared injection spec (the
+    documented ``sdc@STEP:flip=K,host=H`` grammar) runs on BOTH
+    workers with only host 0 poisoned."""
+    tmp = str(tmp_path)
+    state_dir = os.path.join(tmp, "state")
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    ckpt_dir = {i: os.path.join(tmp, f"ckpt-{i}") for i in (0, 1)}
+    ram_dir = {i: os.path.join(tmp, f"ram-{i}") for i in (0, 1)}
+    env = _drill_env(journal_path)
+    master_env = dict(
+        env,
+        DLROVER_TPU_QUARANTINE_THRESHOLD="2",
+        DLROVER_TPU_MAX_ROLLBACKS="3",
+        DLROVER_TPU_CTX_TASK_PROCESS_TIMEOUT="60",
+    )
+    # one spec for the whole fleet: the host filter scopes both faults
+    # to node rank 0, and MIN_STEPS=4 arms the MAD spike detector
+    # before the first strike lands at local step 8
+    worker_env = dict(
+        env,
+        DLROVER_FAULT_INJECT=(
+            f"sdc@{SDC_TRIPS[0]}:flip=6,host=0,"
+            f"sdc@{SDC_TRIPS[1]}:flip=6,host=0"
+        ),
+        DLROVER_TPU_SENTINEL_MIN_STEPS="4",
+    )
+
+    procs = []
+    try:
+        m = _spawn_master(tmp, master_env, state_dir, 0, "2")
+        procs.append(m)
+        port = _master_port(tmp, "2", m)
+
+        workers = {}
+        for i in (0, 1):
+            workers[i] = _spawn_worker(
+                tmp, dict(worker_env,
+                          DLROVER_TPU_NODE_RANK=str(i),
+                          HOSTNAME=f"sdc-host-{i}"),
+                port, i, str(i), ckpt_dir[i], ram_dir[i],
+                dataset_size=SDC_DATASET,
+                # no prefetch: a quarantined worker must leave no
+                # in-flight shards behind for the 60 s watchdog
+                fetch_batch=1, lookahead=0,
+            )
+        procs += list(workers.values())
+
+        for tag, w in sorted(workers.items()):
+            rc = _wait(w, 180, f"worker {tag}", tmp,
+                       ["worker-0.out", "worker-1.out", "master-2.err"])
+            assert rc == 0, (
+                f"worker {tag} exited rc={rc}; "
+                + _tail(tmp, f"worker-{tag}.out")
+            )
+        rc_m = _wait(m, 60, "master", tmp, ["master-2.err"])
+        assert rc_m == 0, _tail(tmp, "master-2.err")
+    finally:
+        for p in procs:
+            _killpg(p, signal.SIGTERM)
+        time.sleep(0.5)
+        for p in procs:
+            _killpg(p)
+
+    # ---- two strikes on worker 0, none on worker 1 -------------------
+    trips = _worker_lines(tmp, "0", "TRIP")
+    assert trips == [
+        ["TRIP", "loss_spike", str(s)] for s in SDC_TRIPS
+    ], trips
+    assert not _worker_lines(tmp, "1", "TRIP")
+
+    # both rollbacks honored on BOTH ranks before worker 0 stood down
+    rb0 = _worker_lines(tmp, "0", "ROLLBACK")
+    rb1 = _worker_lines(tmp, "1", "ROLLBACK")
+    assert [r[1] for r in rb0] == [
+        str(s - 1) for s in SDC_TRIPS
+    ], rb0
+    assert len(rb1) == 2, rb1
+    assert [r[3] for r in rb0] == [r[3] for r in rb1], (rb0, rb1)
+    rolled0 = _worker_lines(tmp, "0", "ROLLED")
+    assert rolled0 == [
+        ["ROLLED", str(s - 1), "ok"] for s in SDC_TRIPS
+    ], rolled0
+    for parts in _worker_lines(tmp, "1", "ROLLED"):
+        assert parts[2] == "ok", parts
+    # the DETECTING rank rewound the ledger once per incident
+    assert [r[1] for r in _worker_lines(tmp, "0", "LEDGER_RESTORED")] \
+        == [str(s - 1) for s in SDC_TRIPS]
+    assert not _worker_lines(tmp, "1", "LEDGER_RESTORED")
+
+    # worker 0 stood down on the quarantine verdict; worker 1 carried
+    # the job to completion — no budget exhaustion, no job failure
+    assert _worker_lines(tmp, "0", "QUARANTINED"), _tail(
+        tmp, "worker-0.txt"
+    )
+    assert not _worker_lines(tmp, "1", "QUARANTINED")
+    for tag in ("0", "1"):
+        assert _worker_lines(tmp, tag, "DONE"), _tail(
+            tmp, f"worker-{tag}.txt"
+        )
+        assert not _worker_lines(tmp, tag, "JOB_FAILED")
+    assert _worker_lines(tmp, "0", "ANOMALIES") == [["ANOMALIES", "2"]]
+    assert _worker_lines(tmp, "1", "ANOMALIES") == [["ANOMALIES", "0"]]
+
+    # ---- exactly-once across BOTH rewinds and the stand-down ---------
+    by_range = {}
+    for tag in ("0", "1"):
+        for parts in _worker_lines(tmp, tag, "SHARD"):
+            rng = (int(parts[1]), int(parts[2]))
+            by_range.setdefault(rng, []).append(float(parts[3]))
+    ranges = sorted(by_range)
+    assert ranges[0][0] == 0 and ranges[-1][1] == SDC_DATASET, ranges
+    for (_, end), (start, _) in zip(ranges, ranges[1:]):
+        assert end == start, f"shard gap/overlap at {start}: {ranges}"
+    # a shard voided by one rewind repeats once; a shard unlucky enough
+    # to be voided by both repeats twice — never more
+    dupes = {r: ts for r, ts in by_range.items() if len(ts) > 1}
+    assert dupes, by_range
+    for rng, ts in dupes.items():
+        assert len(ts) <= 3, (rng, ts)
+
+    # ---- journal: two incidents, one quarantine ----------------------
+    events = read_journal(journal_path)
+    kinds = [e.get("kind") for e in events]
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e.get("kind"), []).append(e)
+
+    injected = [e for e in by_kind.get("fault.injected", ())
+                if e["data"]["fault"] == "sdc"]
+    assert len(injected) == 2, by_kind.get("fault.injected")
+    assert all(e["data"]["node_rank"] == 0 for e in injected), injected
+
+    det = by_kind["anomaly.detected"]
+    assert [
+        (e["data"]["anomaly"], e["data"]["step"]) for e in det
+    ] == [("loss_spike", s) for s in SDC_TRIPS], det
+    # SDC is finite-but-wrong: the spike detector carries the evidence
+    assert all(e["data"]["zscore"] > 6.0 for e in det), det
+    assert all(e["data"]["host"] == "sdc-host-0" for e in det), det
+
+    init = by_kind["rollback.initiated"]
+    assert [e["data"]["step"] for e in init] == [
+        s - 1 for s in SDC_TRIPS
+    ], init
+    assert [e["data"]["rollbacks"] for e in init] == [1, 2], init
+    assert all(e["data"]["host"] == "sdc-host-0" for e in init), init
+    assert "rollback.budget_exhausted" not in kinds, kinds
+
+    # both ranks adopted and restored both orders
+    ids = sorted(e["data"]["rollback_id"] for e in init)
+    ordered = by_kind["rollback.ordered"]
+    assert len(ordered) == 4, ordered
+    for rank in (0, 1):
+        assert sorted(
+            e["data"]["rollback_id"] for e in ordered
+            if e["data"]["node_rank"] == rank
+        ) == ids, ordered
+    assert len(by_kind["rollback.restored"]) == 4
+
+    # the SECOND strike imposed the quarantine on exactly host 0
+    (q,) = by_kind["quarantine.imposed"]
+    assert q["data"]["host"] == "sdc-host-0", q
+    assert q["data"]["anomalies"] == 2, q
+    assert q["data"]["threshold"] == 2, q
+    assert q["data"]["anomaly"] == "loss_spike", q
+    assert q["data"]["step"] == SDC_TRIPS[1], q
+
+    # rendezvous eviction + relaunch exclusion landed on the master
+    master_err = open(os.path.join(tmp, "master-2.err")).read()
+    assert "QUARANTINE: host sdc-host-0" in master_err, master_err[-2000:]
+    assert "Quarantine on" in master_err, master_err[-2000:]
+
+    # offline goodput replay books BOTH incidents as recovered
+    # rollback badput on the detecting node
+    report = goodput.reconstruct(events)
+    offs = [f for f in report["faults"] if f["cause"] == Phase.ROLLBACK]
+    assert len(offs) == 2, report["faults"]
+    for off in offs:
+        assert off["recovered_ts"] and off["recovered_ts"] >= off["ts"], off
+    assert report["job"]["badput_s"][Phase.ROLLBACK] > 0.0, report["job"]

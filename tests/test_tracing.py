@@ -703,6 +703,43 @@ def test_preempt_event_names_are_the_canonical_set():
     )
 
 
+#: the full vocabulary of the silent-failure sentinel (PR 10):
+#: detection on the worker, attribution + rollback coordination on the
+#: master. goodput's EVENT_RULES, the sentinel drill's journal asserts
+#: and docs/TELEMETRY.md all match these names literally — an addition
+#: or rename must land everywhere in the same PR. NOTE the anomaly
+#: kind rides in a data field named "anomaly" (record()'s first
+#: parameter owns "kind", same convention as fault.injected's "fault").
+_SENTINEL_EVENTS = {
+    "anomaly.detected",
+    "anomaly.reported",
+    "anomaly.rpc_fallback",
+    "rollback.ordered",
+    "rollback.initiated",
+    "rollback.restored",
+    "rollback.recovered",
+    "rollback.budget_exhausted",
+    "quarantine.imposed",
+}
+
+
+def test_sentinel_event_names_are_the_canonical_set():
+    """The anomaly.* / rollback.* / quarantine.* journal vocabulary is
+    closed: every record() in those namespaces uses exactly one of the
+    documented names, and every documented name has a live emitter."""
+    found = {
+        value
+        for _, _, value, kind in _record_call_literals()
+        if kind == "literal" and value.split(".", 1)[0] in (
+            "anomaly", "rollback", "quarantine"
+        )
+    }
+    assert found == _SENTINEL_EVENTS, (
+        f"unexpected: {sorted(found - _SENTINEL_EVENTS)}, "
+        f"missing emitters for: {sorted(_SENTINEL_EVENTS - found)}"
+    )
+
+
 #: span names allow a single undotted segment ("data", "dispatch" —
 #: the bench's train-thread phases predate the dotted convention);
 #: anything dotted must be fully snake-case dotted like event names
