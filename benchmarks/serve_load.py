@@ -1,0 +1,155 @@
+"""Serving request-plane load bench: throughput + latency percentiles.
+
+ISSUE 11 acceptance evidence: N ServingWorker replicas pull
+continuous-batching leases from a REAL gRPC master (LocalJobMaster +
+RequestRouter) while a load generator submits ``--requests`` requests
+and polls every response back. The number measures the full
+submit -> lease -> model -> complete -> poll loop, i.e. exactly the
+path an inference client sits on.
+
+Prints ONE JSON line (BENCH conventions, docs/SERVING.md):
+
+  value            end-to-end request throughput (requests/s)
+  requests_per_s   same value, explicit field name
+  serve_p50_ms     router-measured submit-to-response p50
+  serve_p99_ms     router-measured submit-to-response p99
+  exactly_once     every request answered exactly once
+  workers/batch/requests  run shape
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/serve_load.py \
+          [--workers 2] [--batch 8] [--requests 512] [--model_ms 0]
+      --smoke shrinks the run for the tier-1 suite.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _run(num_requests: int, workers: int, batch: int,
+         model_ms: float) -> dict:
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.local_master import LocalJobMaster
+    from dlrover_tpu.serving.worker import ServingWorker
+
+    master = LocalJobMaster(port=0)
+    master.prepare()
+
+    def model_fn(payloads, state):
+        if model_ms > 0:
+            time.sleep(model_ms / 1000.0)
+        return [p.upper() for p in payloads]
+
+    clients = [
+        MasterClient(master.addr, node_id=i, node_type="worker")
+        for i in range(workers)
+    ]
+    replicas = [
+        ServingWorker(c, model_fn, node_id=i, batch_size=batch,
+                      poll_interval=0.002, incarnation=0)
+        for i, c in enumerate(clients)
+    ]
+    threads = [
+        threading.Thread(target=r.serve, daemon=True) for r in replicas
+    ]
+    lb = MasterClient(master.addr, node_id=workers, node_type="worker")
+
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    req_ids = []
+    for i in range(num_requests):
+        ok, rid, reason = lb.serve_submit(b"p%d" % i)
+        if not ok and reason == "backpressure":
+            # bounded queue doing its job: wait out the burst
+            while not ok:
+                time.sleep(0.002)
+                ok, rid, reason = lb.serve_submit(b"p%d" % i)
+        req_ids.append(rid)
+    lb.serve_seal()
+
+    responses = {}
+    for rid in req_ids:
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            done, payload, worker_id, _ = lb.serve_poll(rid)
+            if done:
+                responses[rid] = (payload, worker_id)
+                break
+            time.sleep(0.001)
+    elapsed = time.perf_counter() - t0
+
+    for t in threads:
+        t.join(timeout=30.0)
+    stats = lb.serve_stats() or {}
+    for c in clients + [lb]:
+        c.close()
+    master.stop()
+
+    answered = sum(
+        1 for i, rid in enumerate(req_ids)
+        if responses.get(rid, (b"",))[0] == (b"p%d" % i).upper()
+    )
+    return {
+        "requests_per_s": (
+            num_requests / elapsed if elapsed > 0 else 0.0
+        ),
+        "elapsed_s": elapsed,
+        "answered": answered,
+        "served_by": sorted({w for _, w in responses.values()}),
+        "stats": stats,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--requests", type=int, default=512)
+    p.add_argument("--model_ms", type=float, default=0.0,
+                   help="simulated model time per micro-batch")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny run for the tier-1 suite")
+    args = p.parse_args()
+
+    if args.smoke:
+        args.workers = 2
+        args.requests = 64
+        args.batch = min(args.batch, 4)
+
+    os.environ.setdefault("DLROVER_TPU_METRICS_PORT", "off")
+
+    run = _run(args.requests, args.workers, args.batch, args.model_ms)
+    stats = run["stats"]
+    ok = (
+        run["answered"] == args.requests
+        and stats.get("completed") == args.requests
+    )
+    result = {
+        "metric": "serve_throughput",
+        "value": round(run["requests_per_s"], 1),
+        "unit": "requests/s",
+        "requests_per_s": round(run["requests_per_s"], 1),
+        "serve_p50_ms": stats.get("p50_ms", 0.0),
+        "serve_p99_ms": stats.get("p99_ms", 0.0),
+        "redelivered": stats.get("redelivered", 0),
+        "duplicates": stats.get("duplicates", 0),
+        "elapsed_s": round(run["elapsed_s"], 3),
+        "workers": args.workers,
+        "batch": args.batch,
+        "requests": args.requests,
+        "smoke": bool(args.smoke),
+        "exactly_once": ok,
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

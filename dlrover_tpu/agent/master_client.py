@@ -562,6 +562,104 @@ class MasterClient:
         resp = self._call("request_scale", req)
         return bool(getattr(resp, "success", False))
 
+    # -------------------------------------------------------------- serving
+
+    @supervised_rpc
+    def serve_submit(self, payload: bytes, req_id: str = ""):
+        """Admit one inference request; returns (accepted, req_id,
+        reason). Reasons are explicit backpressure — the caller owns
+        the retry policy."""
+        req = self._fill(comm.ServeSubmit(req_id=req_id, payload=payload))
+        res = self._call("serve_submit", req)
+        return bool(res.accepted), res.req_id, res.reason
+
+    @supervised_rpc
+    def serve_poll(self, req_id: str):
+        """Fetch the stored response for a request id; returns
+        (done, payload, worker_id, latency_s)."""
+        res = self._call(
+            "serve_poll", self._fill(comm.ServePoll(req_id=req_id))
+        )
+        return bool(res.done), res.payload, res.worker_id, res.latency_s
+
+    @supervised_rpc
+    def serve_lease(self, max_requests: int = 1, incarnation: int = -1):
+        """Pull the next micro-batch of requests; returns
+        ([(req_id, payload), ...], sealed). Empty + sealed=True is the
+        end-of-stream signal."""
+        req = self._fill(comm.ServeLeaseRequest(
+            max_requests=max_requests, incarnation=incarnation,
+        ))
+        res = self._call("serve_lease", req)
+        return (
+            [(r.req_id, r.payload) for r in res.requests],
+            bool(res.sealed),
+        )
+
+    @supervised_rpc
+    def serve_complete(self, req_id: str, payload: bytes) -> bool:
+        """Report one response; False when the master rejected it
+        (duplicate, or the request was redelivered after this worker's
+        lease timed out) — the worker must NOT count it as its own."""
+        req = self._fill(comm.ServeComplete(req_id=req_id, payload=payload))
+        res = self._call("serve_complete", req)
+        return bool(getattr(res, "success", False))
+
+    @supervised_rpc
+    def serve_relinquish(self) -> int:
+        """Replica rotation: return this worker's unprocessed leases to
+        the queue immediately. Returns the number requeued, or -1 when
+        the master predates the serving RPCs — the lease-timeout
+        watchdog covers that case, just slower."""
+        req = self._fill(comm.ServeRelinquishRequest())
+        try:
+            return int(self._call("serve_relinquish", req).requeued)
+        except Exception as e:
+            if is_connection_error(e):
+                raise
+            logger.warning("serve_relinquish unsupported: %s", e)
+            record("serve.rpc_fallback", rpc="serve_relinquish",
+                   error=str(e)[:200])
+            return -1
+
+    @supervised_rpc
+    def serve_seal(self):
+        """Declare end-of-stream: no more submissions; workers exit
+        once the queue drains."""
+        return self._call(
+            "serve_seal", self._fill(comm.ServeSealRequest())
+        )
+
+    @supervised_rpc
+    def serve_stats(self) -> Optional[Dict]:
+        """Router stats (queue depth, p50/p99 latency, counters) for
+        autoscaling and load generators; None when the master has no
+        serving tier."""
+        req = self._fill(comm.ServeStatsRequest())
+        try:
+            res = self._call("serve_stats", req)
+        except Exception as e:
+            if is_connection_error(e):
+                raise
+            logger.warning("serve_stats unsupported: %s", e)
+            record("serve.rpc_fallback", rpc="serve_stats",
+                   error=str(e)[:200])
+            return None
+        return {
+            "queue_depth": res.queue_depth,
+            "in_flight": res.in_flight,
+            "submitted": res.submitted,
+            "completed": res.completed,
+            "rejected": res.rejected,
+            "duplicates": res.duplicates,
+            "redelivered": res.redelivered,
+            "workers": res.workers,
+            "p50_ms": res.p50_ms,
+            "p99_ms": res.p99_ms,
+            "sealed": res.sealed,
+            "drained": res.drained,
+        }
+
     # -------------------------------------------------------------- metrics
 
     @supervised_rpc
@@ -658,6 +756,7 @@ class LocalMasterClient:
         self._node_type = node_type
         self._task_manager = TaskManager()
         self._kv: Dict[str, bytes] = {}
+        self._router = None
 
     def report_dataset_shard_params(self, batch_size, num_epochs,
                                     dataset_size, shuffle,
@@ -758,6 +857,44 @@ class LocalMasterClient:
 
     def report_heartbeat(self):
         return ""
+
+    # masterless serving: the request plane lives in-process, so a
+    # single-host ``examples/serve.py`` run needs no master at all
+    def _serve_router(self):
+        if self._router is None:
+            from dlrover_tpu.serving.router import RequestRouter
+
+            self._router = RequestRouter()
+            self._router.start()
+        return self._router
+
+    def serve_submit(self, payload: bytes, req_id: str = ""):
+        return self._serve_router().submit(payload, req_id=req_id)
+
+    def serve_poll(self, req_id: str):
+        return self._serve_router().poll(req_id)
+
+    def serve_lease(self, max_requests: int = 1, incarnation: int = -1):
+        return self._serve_router().lease(
+            self._node_type, self._node_id,
+            max_requests=max_requests, incarnation=incarnation,
+        )
+
+    def serve_complete(self, req_id: str, payload: bytes) -> bool:
+        return self._serve_router().complete(
+            self._node_type, self._node_id, req_id, payload
+        )
+
+    def serve_relinquish(self) -> int:
+        return self._serve_router().relinquish(
+            self._node_type, self._node_id
+        )
+
+    def serve_seal(self):
+        self._serve_router().seal()
+
+    def serve_stats(self):
+        return self._serve_router().stats()
 
 
 _master_client = None

@@ -557,3 +557,109 @@ class ElasticRunConfigRequest(BaseRequest):
 @dataclass
 class ElasticRunConfig(BaseMessage):
     configs: Dict[str, str] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------- serving
+# The inference request plane (serving/router.py): requests are leased
+# to serving workers exactly like data shards, with redelivery on
+# worker death and exactly-once responses keyed by request id.
+
+
+@dataclass
+class ServeSubmit(BaseRequest):
+    """Admit one inference request. Empty ``req_id`` lets the router
+    assign one; a client-chosen id makes retries idempotent."""
+
+    req_id: str = ""
+    payload: bytes = b""
+
+
+@dataclass
+class ServeSubmitResult(BaseMessage):
+    accepted: bool = True
+    req_id: str = ""
+    reason: str = ""  # "backpressure" | "sealed" | "duplicate"
+
+
+@dataclass
+class ServePoll(BaseRequest):
+    req_id: str = ""
+
+
+@dataclass
+class ServeResponse(BaseMessage):
+    done: bool = False
+    req_id: str = ""
+    payload: bytes = b""
+    worker_id: int = -1
+    latency_s: float = 0.0
+
+
+@dataclass
+class ServeLeaseRequest(BaseRequest):
+    """Pull up to ``max_requests`` queued requests. ``incarnation``
+    carries the worker's restart count: a lease from a newer
+    incarnation reclaims the dead predecessor's in-flight requests
+    immediately (same contract as TaskBatchRequest)."""
+
+    max_requests: int = 1
+    incarnation: int = -1
+
+
+@dataclass
+class ServeWireRequest(BaseMessage):
+    req_id: str = ""
+    payload: bytes = b""
+
+
+@dataclass
+class ServeLease(BaseMessage):
+    """A micro-batch of leased requests. ``sealed=True`` with an empty
+    batch is the worker's end-of-stream signal."""
+
+    requests: List[ServeWireRequest] = field(default_factory=list)
+    sealed: bool = False
+
+
+@dataclass
+class ServeComplete(BaseRequest):
+    req_id: str = ""
+    payload: bytes = b""
+
+
+@dataclass
+class ServeRelinquishRequest(BaseRequest):
+    """Replica rotation: return this worker's unprocessed leases to
+    the queue NOW instead of waiting out the lease-timeout watchdog
+    (the serving analog of RelinquishShardsRequest)."""
+
+
+@dataclass
+class ServeRelinquishResponse(BaseMessage):
+    requeued: int = 0
+
+
+@dataclass
+class ServeSealRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class ServeStatsRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class ServeStats(BaseMessage):
+    queue_depth: int = 0
+    in_flight: int = 0
+    submitted: int = 0
+    completed: int = 0
+    rejected: int = 0
+    duplicates: int = 0
+    redelivered: int = 0
+    workers: int = 0
+    p50_ms: float = 0.0
+    p99_ms: float = 0.0
+    sealed: bool = False
+    drained: bool = False

@@ -38,6 +38,17 @@ Two triggers:
                                     (``sdc@5:flip=2,host=1``) extends
                                     the previous fault's kv arg rather
                                     than starting a new fault.
+  - ``serve_kill@6`` / ``serve_kill@6:host=1``  SIGKILL a SERVING
+                                    worker once it has served 6
+                                    requests (the serving loop feeds
+                                    its responses-served count through
+                                    ``maybe_inject``, so the kill lands
+                                    mid-stream with leases outstanding
+                                    — the router's redelivery path).
+                                    Serving-side only: injectors built
+                                    with other roles drop the kind, and
+                                    ``host=H`` restricts it to node
+                                    rank H like the corruption kinds.
   - ``master_crash@5`` / ``master_crash@5:2``  kill the JOB MASTER
                                     (rc 28) once the reported global
                                     step reaches 5, after an optional
@@ -76,7 +87,7 @@ KV_PREFIX = "fault_inject"
 
 KINDS = (
     "crash", "hang", "oom", "error", "preempt", "master_crash",
-    "nan", "sdc",
+    "nan", "sdc", "serve_kill",
 )
 
 #: silent-corruption kinds: they do not kill the process — the trainer
@@ -86,6 +97,11 @@ CORRUPTION_KINDS = frozenset({"nan", "sdc"})
 
 #: kinds executed by the MASTER's run loop, not a worker training loop
 MASTER_KINDS = frozenset({"master_crash"})
+
+#: kinds executed by a SERVING worker's request loop (serving/worker.py
+#: counts responses served, not training steps) — other roles drop them
+#: so one shared spec can chaos a mixed train+serve job
+SERVING_KINDS = frozenset({"serve_kill"})
 
 #: distinct from a worker crash (17) and a deliberate job failure
 #: (main.JOB_FAILED_EXIT_CODE=3): the operator should see a master
@@ -213,14 +229,17 @@ class FaultInjector:
     def _role_filter(self, faults: List[Fault]) -> List[Fault]:
         """One spec may target both sides: each injector keeps only the
         kinds its role executes (a worker must not die on a
-        master_crash, nor the master on a worker crash). Corruption
-        kinds additionally honor ``host=H`` so one shared spec poisons
+        master_crash, nor the master on a worker crash; serving kinds
+        only fire in a serving worker). Corruption and serving kinds
+        additionally honor ``host=H`` so one shared spec poisons
         exactly one node rank."""
         kept = []
         for f in faults:
             if (f.kind in MASTER_KINDS) != (self._role == "master"):
                 continue
-            if f.kind in CORRUPTION_KINDS:
+            if f.kind in SERVING_KINDS and self._role != "serving":
+                continue
+            if f.kind in CORRUPTION_KINDS or f.kind in SERVING_KINDS:
                 host = _arg_kv(f.arg, "host")
                 if host is not None and int(host) != self._node_rank:
                     continue
@@ -348,6 +367,15 @@ class FaultInjector:
             raise RuntimeError(
                 fault.arg or f"injected error at step {step}"
             )
+        elif fault.kind == "serve_kill":
+            # SIGKILL, not SIGTERM: no drain, no goodbye — the router's
+            # lease-timeout watchdog must notice and redeliver
+            print(
+                f"INJECTED SERVE KILL after {step} requests served",
+                flush=True,
+            )
+            _signal_own_group(signal.SIGKILL)
+            time.sleep(30)  # await delivery; SIGKILL cannot be handled
         elif fault.kind == "preempt":
             # arg ``notice=N``: the platform's termination-notice
             # window — SIGTERM now, hard SIGKILL reclaim N seconds

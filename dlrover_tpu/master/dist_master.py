@@ -6,6 +6,7 @@ SpeedMonitor/JobAutoScaler, prepare:129, 30s run loop:165 with
 exit-reason logic).
 """
 
+import os
 import time
 from typing import Optional
 
@@ -31,6 +32,8 @@ from dlrover_tpu.master.node.job_auto_scaler import new_job_auto_scaler
 from dlrover_tpu.master.node.quarantine import QuarantineManager
 from dlrover_tpu.master.resource.local_optimizer import TPULocalOptimizer
 from dlrover_tpu.master.servicer import create_master_service
+from dlrover_tpu.serving.autoscaler import ServingAutoScaler
+from dlrover_tpu.serving.router import RequestRouter
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
 from dlrover_tpu.master.shard.task_manager import TaskManager
 from dlrover_tpu.master.state_journal import build_master_state_journal
@@ -173,6 +176,29 @@ class DistributedJobMaster:
                 getattr(job_args, "node_num", 0) or 0,
             ),
         )
+        # the serving request plane: inference requests lease with the
+        # same exactly-once/redelivery discipline as data shards, and
+        # the pool scales through the SAME scale-plan machinery as
+        # training nodes (serving/router.py, serving/autoscaler.py)
+        self.request_router = RequestRouter()
+        # opt-in: the serving autoscaler issues REAL worker scale plans
+        # (manual_scale -> platform scaler), which only makes sense on
+        # a job whose workers are serving replicas — a training job
+        # must never have its world resized by inference queue depth
+        self.serve_autoscaler = None
+        if os.environ.get(
+            "DLROVER_TPU_SERVE_AUTOSCALE", ""
+        ).lower() not in ("", "0", "off", "false"):
+            self.serve_autoscaler = ServingAutoScaler(
+                stats_fn=self.request_router.stats,
+                scale_fn=self.auto_scaler.manual_scale,
+                min_replicas=getattr(job_args, "min_node_num", 0) or 1,
+                max_replicas=max(
+                    getattr(job_args, "max_node_num", 0) or 0,
+                    getattr(job_args, "node_num", 0) or 0,
+                    1,
+                ),
+            )
         self._server, self.servicer = create_master_service(
             port,
             task_manager=self.task_manager,
@@ -185,6 +211,7 @@ class DistributedJobMaster:
             auto_scaler=self.auto_scaler,
             kv_store=self.kv_store,
             goodput_aggregator=self.goodput_aggregator,
+            request_router=self.request_router,
         )
         self.port = self._server.port
         self._exit_code = 0
@@ -323,6 +350,9 @@ class DistributedJobMaster:
         self.job_manager.start()
         self.task_manager.start()
         self.auto_scaler.start_auto_scaling()
+        self.request_router.start()
+        if self.serve_autoscaler is not None:
+            self.serve_autoscaler.start()
         self._server.start()
         # /goodput on this master serves the job-level aggregation
         # (and refreshes the goodput gauges on every read)
@@ -361,6 +391,16 @@ class DistributedJobMaster:
                     # for every poller to observe the drained dataset
                     # ([] response) — a socket that dies first costs
                     # them the full reconnect-supervisor timeout
+                    self._broadcast_stop(
+                        max(check_interval, _COMPLETION_GRACE)
+                    )
+                    break
+                if self.request_router.finished():
+                    # serving job: the stream sealed, every response
+                    # was completed AND delivered to its poller — same
+                    # drain-don't-slam discipline as data tasks
+                    logger.info("Serving stream drained; stopping")
+                    self._exit_reason = JobExitReason.SUCCEEDED
                     self._broadcast_stop(
                         max(check_interval, _COMPLETION_GRACE)
                     )
@@ -411,6 +451,9 @@ class DistributedJobMaster:
         return summary
 
     def stop(self):
+        if self.serve_autoscaler is not None:
+            self.serve_autoscaler.stop()
+        self.request_router.stop()
         self.auto_scaler.stop()
         self.task_manager.stop()
         self.job_manager.stop()

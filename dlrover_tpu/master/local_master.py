@@ -22,6 +22,7 @@ from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.node.local_job_manager import LocalJobManager
 from dlrover_tpu.master.servicer import create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.serving.router import RequestRouter
 from dlrover_tpu.telemetry.http import start_metrics_server
 
 
@@ -38,6 +39,9 @@ class LocalJobMaster:
         }
         self.sync_service = SyncService(self.job_manager)
         self.error_monitor = ErrorMonitor()
+        # serving request plane (standalone/bench wiring): same router
+        # the distributed master runs, minus the scale-plan autoscaler
+        self.request_router = RequestRouter()
         self._server, self.servicer = create_master_service(
             port,
             task_manager=self.task_manager,
@@ -46,6 +50,7 @@ class LocalJobMaster:
             rdzv_managers=self.rdzv_managers,
             sync_service=self.sync_service,
             error_monitor=self.error_monitor,
+            request_router=self.request_router,
         )
         self.port = self._server.port
         self._exit_code = 0
@@ -63,6 +68,7 @@ class LocalJobMaster:
     def prepare(self):
         self.job_manager.start()
         self.task_manager.start()
+        self.request_router.start()
         self._server.start()
         # Prometheus /metrics + /journal (telemetry/http.py);
         # DLROVER_TPU_METRICS_PORT pins the port, "off" disables
@@ -102,6 +108,7 @@ class LocalJobMaster:
         return self._exit_code
 
     def stop(self):
+        self.request_router.stop()
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop(grace=1.0)

@@ -48,6 +48,7 @@ class MasterServicer:
         auto_scaler=None,
         kv_store=None,
         goodput_aggregator=None,
+        request_router=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -58,6 +59,10 @@ class MasterServicer:
         self._job_metric_collector = job_metric_collector
         self._auto_scaler = auto_scaler
         self._goodput = goodput_aggregator
+        # inference request plane (serving/router.py); None on masters
+        # without a serving tier — serve RPCs then raise an application
+        # error the client's rpc_fallback path reports
+        self._request_router = request_router
         # injectable so the master can wire a journal-backed store that
         # survives a master restart (master/state_journal.py)
         self._kv_store = kv_store or KVStoreService()
@@ -670,6 +675,70 @@ class MasterServicer:
             success=self._sync_service.barrier(req.barrier_name)
         )
 
+    # -------------------------------------------------------------- serving
+
+    def _router(self):
+        if self._request_router is None:
+            raise ValueError("no request router (serving not enabled)")
+        return self._request_router
+
+    def rpc_serve_submit(self, req: comm.ServeSubmit) -> comm.ServeSubmitResult:
+        accepted, req_id, reason = self._router().submit(
+            req.payload, req_id=req.req_id
+        )
+        return comm.ServeSubmitResult(
+            accepted=accepted, req_id=req_id, reason=reason
+        )
+
+    def rpc_serve_poll(self, req: comm.ServePoll) -> comm.ServeResponse:
+        done, payload, worker_id, latency_s = self._router().poll(
+            req.req_id
+        )
+        return comm.ServeResponse(
+            done=done, req_id=req.req_id, payload=payload,
+            worker_id=worker_id, latency_s=latency_s,
+        )
+
+    def rpc_serve_lease(self, req: comm.ServeLeaseRequest) -> comm.ServeLease:
+        batch, sealed = self._router().lease(
+            req.node_type, req.node_id, max_requests=req.max_requests,
+            incarnation=req.incarnation,
+        )
+        return comm.ServeLease(
+            requests=[
+                comm.ServeWireRequest(req_id=rid, payload=payload)
+                for rid, payload in batch
+            ],
+            sealed=sealed,
+        )
+
+    def rpc_serve_complete(self, req: comm.ServeComplete) -> comm.Response:
+        accepted = self._router().complete(
+            req.node_type, req.node_id, req.req_id, req.payload
+        )
+        # same shape as a rejected shard report: the worker must not
+        # count a rejected (duplicate / redelivered) completion as its
+        # own response
+        if not accepted:
+            return comm.Response(
+                success=False, reason="completion not accepted"
+            )
+        return comm.Response(success=True)
+
+    def rpc_serve_relinquish(
+        self, req: comm.ServeRelinquishRequest
+    ) -> comm.ServeRelinquishResponse:
+        requeued = self._router().relinquish(req.node_type, req.node_id)
+        return comm.ServeRelinquishResponse(requeued=requeued)
+
+    def rpc_serve_seal(self, req: comm.ServeSealRequest) -> comm.Response:
+        self._router().seal()
+        return comm.Response(success=True)
+
+    def rpc_serve_stats(self, req: comm.ServeStatsRequest) -> comm.ServeStats:
+        stats = self._router().stats()
+        return comm.ServeStats(**stats)
+
     # ---------------------------------------------------------------- misc
 
     def rpc_get_elastic_run_config(
@@ -693,6 +762,7 @@ def create_master_service(
     auto_scaler=None,
     kv_store=None,
     goodput_aggregator=None,
+    request_router=None,
 ):
     """Build the gRPC server around a MasterServicer
     (parity: servicer.py:478)."""
@@ -707,6 +777,7 @@ def create_master_service(
         auto_scaler=auto_scaler,
         kv_store=kv_store,
         goodput_aggregator=goodput_aggregator,
+        request_router=request_router,
     )
     server = GenericRpcServer(servicer.handle, port=port)
     return server, servicer

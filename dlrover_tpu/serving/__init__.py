@@ -1,0 +1,24 @@
+"""Elastic inference tier over the training control plane.
+
+A second workload class on the same master: requests lease like data
+shards (exactly-once, redelivery on worker death), replicas are
+ordinary elastic nodes (rendezvous registration, scale plans, drain
+rotation), weights load from the flash-checkpoint RAM tier. See
+docs/SERVING.md.
+"""
+
+from dlrover_tpu.serving.autoscaler import ServingAutoScaler
+from dlrover_tpu.serving.router import RequestRouter
+from dlrover_tpu.serving.worker import (
+    DRAIN_EXIT_CODE,
+    ReplicaRotation,
+    ServingWorker,
+)
+
+__all__ = [
+    "RequestRouter",
+    "ServingAutoScaler",
+    "ServingWorker",
+    "ReplicaRotation",
+    "DRAIN_EXIT_CODE",
+]

@@ -1,0 +1,155 @@
+"""Serving autoscaler: scale the replica pool on queue depth + p99.
+
+One component, two wirings:
+
+* in the distributed master (master/dist_master.py) it reads the
+  in-process :class:`~dlrover_tpu.serving.router.RequestRouter` and
+  scales through the SAME scale-plan machinery training uses
+  (``JobAutoScaler.manual_scale`` -> ScalePlan -> platform scaler), so
+  a serving job's replicas are ordinary elastic nodes;
+* in drills / examples it reads ``serve_stats`` over RPC and the
+  ``scale_fn`` spawns worker processes directly.
+
+Decisions are deliberately simple and hysteretic: scale UP one replica
+when the queue is deeper than ``queue_high`` or p99 exceeds
+``p99_high_ms`` (and the cooldown has elapsed), scale DOWN one when the
+queue has been empty and latency low. The point is the wiring — queue
+depth and measured latency driving the training stack's scale plans —
+not a clever controller.
+"""
+
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, record
+
+ENV_QUEUE_HIGH = "DLROVER_TPU_SERVE_QUEUE_HIGH"
+DEFAULT_QUEUE_HIGH = 16
+
+ENV_P99_HIGH_MS = "DLROVER_TPU_SERVE_P99_HIGH_MS"
+DEFAULT_P99_HIGH_MS = 2000.0
+
+ENV_COOLDOWN = "DLROVER_TPU_SERVE_SCALE_COOLDOWN"
+DEFAULT_COOLDOWN = 5.0
+
+
+class ServingAutoScaler:
+    """Scales a serving pool on router stats.
+
+    ``stats_fn``   -> the router's ``stats()`` dict (in-process or RPC)
+    ``scale_fn``   -> callable(target_replicas) executing the change
+                      (JobAutoScaler.manual_scale in the master wiring)
+    ``replicas_fn``-> current replica count (defaults to the router's
+                      ``workers`` stat)
+    """
+
+    def __init__(
+        self,
+        stats_fn: Callable[[], Optional[Dict]],
+        scale_fn: Callable[[int], object],
+        replicas_fn: Optional[Callable[[], int]] = None,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        queue_high: Optional[int] = None,
+        p99_high_ms: Optional[float] = None,
+        interval: float = 1.0,
+        cooldown: Optional[float] = None,
+    ):
+        self._stats_fn = stats_fn
+        self._scale_fn = scale_fn
+        self._replicas_fn = replicas_fn
+        self._min = max(0, min_replicas)
+        self._max = max(self._min, max_replicas)
+        self._queue_high = int(
+            queue_high if queue_high is not None
+            else os.getenv(ENV_QUEUE_HIGH, "") or DEFAULT_QUEUE_HIGH
+        )
+        self._p99_high_ms = float(
+            p99_high_ms if p99_high_ms is not None
+            else os.getenv(ENV_P99_HIGH_MS, "") or DEFAULT_P99_HIGH_MS
+        )
+        self._interval = max(0.1, interval)
+        self._cooldown = float(
+            cooldown if cooldown is not None
+            else os.getenv(ENV_COOLDOWN, "") or DEFAULT_COOLDOWN
+        )
+        self._last_scale: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-autoscaler", daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self):
+        import time
+
+        while not self._stop.wait(self._interval):
+            try:
+                now = time.monotonic()
+                if (self._last_scale is not None
+                        and now - self._last_scale < self._cooldown):
+                    continue
+                if self.evaluate() is not None:
+                    self._last_scale = time.monotonic()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("serving autoscale tick failed: %s", e)
+
+    # -------------------------------------------------------------- descision
+
+    def evaluate(self) -> Optional[int]:
+        """One decision tick: returns the new target replica count when
+        a scale was issued, None when the pool is left alone. Exposed
+        for unit tests (no thread, no clock)."""
+        stats = self._stats_fn()
+        if not stats or not stats.get("submitted"):
+            return None  # inert until the request plane sees traffic
+        current = (
+            self._replicas_fn() if self._replicas_fn is not None
+            else int(stats.get("workers", 0))
+        )
+        queue_depth = int(stats.get("queue_depth", 0))
+        p99_ms = float(stats.get("p99_ms", 0.0))
+        target = current
+        reason = ""
+        if stats.get("sealed") and not queue_depth:
+            return None  # stream ending: let workers drain out
+        if queue_depth > self._queue_high and current < self._max:
+            target, reason = current + 1, "queue_depth"
+        elif p99_ms > self._p99_high_ms and current < self._max:
+            target, reason = current + 1, "p99_latency"
+        elif (queue_depth == 0 and p99_ms < self._p99_high_ms / 4
+              and current > self._min and not stats.get("in_flight")):
+            target, reason = current - 1, "idle"
+        if target == current:
+            return None
+        record(
+            "serve.autoscale", reason=reason, replicas=current,
+            target=target, queue_depth=queue_depth,
+            p99_ms=round(p99_ms, 3),
+        )
+        counter(
+            "dlrover_serve_autoscale_total",
+            "Serving pool scale decisions", ["reason"],
+        ).labels(reason=reason).inc()
+        try:
+            self._scale_fn(target)
+        except Exception as e:
+            logger.warning("serving scale to %d failed: %s", target, e)
+            return None
+        return target

@@ -1,0 +1,429 @@
+"""Master-side request router: the serving twin of the shard ledger.
+
+The inference tier reuses the training control plane wholesale: requests
+are leased to workers exactly like data shards (master/shard/
+task_manager.py), with the same exactly-once discipline —
+
+* a bounded pending queue (backpressure instead of collapse: a submit
+  past ``max_queue`` is REJECTED with a reason the client can retry on,
+  mirroring ROADMAP item 3's "backpressure instead of collapse");
+* continuous batching: ``lease`` hands out whatever is queued RIGHT NOW
+  (up to ``max_requests``) without waiting for a full batch — new
+  submissions land in the pending queue at any moment and ride the next
+  micro-batch, they never wait behind the in-flight one;
+* leases carry the worker's identity + incarnation: a lease from a
+  newer incarnation of the same worker reclaims the older one's
+  in-flight requests immediately (the older process is provably dead),
+  and a watchdog requeues any lease older than
+  ``DLROVER_TPU_SERVE_LEASE_TIMEOUT`` — redelivery on worker death
+  without the client ever seeing a dropped request;
+* completions are exactly-once: the first ``complete`` for a request id
+  wins and stores the response; a duplicate (late ghost after a
+  redelivery, double-ack after a retry) is rejected and counted, never
+  delivered.
+
+The router lives in the master process, is served over the same
+proto-less gRPC envelope (servicer ``rpc_serve_*`` methods), and drives
+the serving autoscaler (serving/autoscaler.py) off its ``stats()``.
+"""
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import counter, gauge, histogram, record
+
+#: redelivery watchdog: a leased-but-unacked request older than this is
+#: requeued (its worker is presumed dead). Serving leases are seconds,
+#: not the minutes of a training shard — default accordingly.
+ENV_LEASE_TIMEOUT = "DLROVER_TPU_SERVE_LEASE_TIMEOUT"
+DEFAULT_LEASE_TIMEOUT = 5.0
+
+#: bounded admission queue: submits past this depth are rejected
+ENV_MAX_QUEUE = "DLROVER_TPU_SERVE_MAX_QUEUE"
+DEFAULT_MAX_QUEUE = 1024
+
+#: sub-ms cache hits up to multi-second cold batches
+_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+#: recent completed-request latencies kept for p50/p99 (stats RPC)
+_LATENCY_WINDOW = 4096
+
+
+class _Pending:
+    """One in-flight request record."""
+
+    __slots__ = ("req_id", "payload", "submit_ts", "worker",
+                 "incarnation", "lease_ts", "redeliveries")
+
+    def __init__(self, req_id: str, payload: bytes):
+        self.req_id = req_id
+        self.payload = payload
+        self.submit_ts = time.time()
+        self.worker: Optional[Tuple[str, int]] = None
+        self.incarnation = -1
+        self.lease_ts = 0.0
+        self.redeliveries = 0
+
+
+class _Done:
+    """A completed request: the stored exactly-once response."""
+
+    __slots__ = ("payload", "worker", "latency_s", "delivered")
+
+    def __init__(self, payload: bytes, worker: Tuple[str, int],
+                 latency_s: float):
+        self.payload = payload
+        self.worker = worker
+        self.latency_s = latency_s
+        self.delivered = False
+
+
+class RequestRouter:
+    """Bounded-queue, lease-with-redelivery request plane."""
+
+    def __init__(self, max_queue: Optional[int] = None,
+                 lease_timeout: Optional[float] = None):
+        if max_queue is None:
+            max_queue = int(
+                os.getenv(ENV_MAX_QUEUE, "") or DEFAULT_MAX_QUEUE
+            )
+        if lease_timeout is None:
+            lease_timeout = float(
+                os.getenv(ENV_LEASE_TIMEOUT, "") or DEFAULT_LEASE_TIMEOUT
+            )
+        self._max_queue = max(1, max_queue)
+        self._lease_timeout = max(0.1, lease_timeout)
+        self._lock = threading.Lock()
+        #: req ids awaiting a lease, FIFO
+        self._queue: deque = deque()
+        #: req_id -> _Pending, for every submitted-but-not-done request
+        self._pending: Dict[str, _Pending] = {}
+        #: req_id -> _Done, exactly-once response store
+        self._done: Dict[str, _Done] = {}
+        #: (node_type, node_id) -> newest incarnation seen leasing
+        self._incarnations: Dict[Tuple[str, int], int] = {}
+        self._latencies: deque = deque(maxlen=_LATENCY_WINDOW)
+        self._submitted = 0
+        self._rejected = 0
+        self._duplicates = 0
+        self._redelivered = 0
+        self._sealed = False
+        self._drained_recorded = False
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        if self._watchdog is not None:
+            return
+        self._stop.clear()
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, name="serve-lease-watchdog",
+            daemon=True,
+        )
+        self._watchdog.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+            self._watchdog = None
+
+    def _watchdog_loop(self):
+        while not self._stop.wait(0.5):
+            try:
+                self.check_timeouts()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("serve lease watchdog failed: %s", e)
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, payload: bytes,
+               req_id: str = "") -> Tuple[bool, str, str]:
+        """Admit one request; returns (accepted, req_id, reason).
+
+        Rejections are explicit backpressure (reason "backpressure" /
+        "sealed") or an id collision (reason "duplicate") — the caller
+        decides whether to retry, never the router."""
+        with self._lock:
+            if self._sealed:
+                return False, req_id, "sealed"
+            if req_id and (req_id in self._pending or req_id in self._done):
+                self._duplicates += 1
+                return False, req_id, "duplicate"
+            if len(self._queue) >= self._max_queue:
+                self._rejected += 1
+                counter(
+                    "dlrover_serve_rejected_total",
+                    "Serve requests rejected by queue backpressure",
+                ).inc()
+                return False, req_id, "backpressure"
+            if not req_id:
+                self._submitted += 1
+                req_id = f"req-{self._submitted}"
+            else:
+                self._submitted += 1
+            self._pending[req_id] = _Pending(req_id, payload)
+            self._queue.append(req_id)
+            depth = len(self._queue)
+        counter(
+            "dlrover_serve_requests_total",
+            "Serve requests admitted by the router",
+        ).inc()
+        gauge(
+            "dlrover_serve_queue_depth",
+            "Serve requests queued awaiting a worker lease",
+        ).set(depth)
+        return True, req_id, ""
+
+    def seal(self):
+        """No more submissions: the stream is ending. Workers observe
+        the seal on their next lease and exit once the queue drains."""
+        with self._lock:
+            if self._sealed:
+                return
+            self._sealed = True
+            queued = len(self._queue)
+        record("serve.sealed", queued=queued)
+        # a seal AFTER the last response was delivered is what drains
+        # an idle stream — check here too, not just on complete/poll
+        self._maybe_drained()
+
+    # --------------------------------------------------------------- leases
+
+    def lease(self, node_type: str, node_id: int, max_requests: int = 1,
+              incarnation: int = -1) -> Tuple[List[Tuple[str, bytes]], bool]:
+        """Hand out up to ``max_requests`` queued requests to a worker.
+
+        Continuous batching: returns whatever is queued NOW (possibly
+        empty) — the worker's lookahead thread polls, so a request
+        submitted mid-batch rides the next micro-batch. Returns
+        ``(batch, sealed)``; an empty batch with sealed=True is the
+        worker's signal to exit."""
+        worker = (node_type, int(node_id))
+        reclaimed: List[str] = []
+        with self._lock:
+            if incarnation >= 0:
+                prev = self._incarnations.get(worker, -1)
+                if incarnation > prev:
+                    self._incarnations[worker] = incarnation
+                    if prev >= 0:
+                        # a newer incarnation proves the older process
+                        # is dead: reclaim its leases immediately
+                        reclaimed = self._requeue_worker_locked(
+                            worker, max_incarnation=incarnation - 1
+                        )
+            batch = []
+            now = time.time()
+            while self._queue and len(batch) < max(1, max_requests):
+                req_id = self._queue.popleft()
+                pending = self._pending.get(req_id)
+                if pending is None:
+                    continue
+                pending.worker = worker
+                pending.incarnation = incarnation
+                pending.lease_ts = now
+                batch.append((req_id, pending.payload))
+            sealed = self._sealed
+            depth = len(self._queue)
+        if reclaimed:
+            self._note_redelivered(reclaimed, cause="incarnation",
+                                   worker=worker)
+        gauge(
+            "dlrover_serve_queue_depth",
+            "Serve requests queued awaiting a worker lease",
+        ).set(depth)
+        return batch, sealed
+
+    def complete(self, node_type: str, node_id: int, req_id: str,
+                 payload: bytes) -> bool:
+        """Store the response for ``req_id``; exactly-once: the first
+        completion wins, duplicates and late ghosts (the request was
+        redelivered to someone else after this worker's lease timed
+        out, then THAT worker completed it) are rejected."""
+        worker = (node_type, int(node_id))
+        with self._lock:
+            if req_id in self._done:
+                self._duplicates += 1
+                counter(
+                    "dlrover_serve_duplicates_total",
+                    "Duplicate serve completions rejected",
+                ).inc()
+                return False
+            pending = self._pending.get(req_id)
+            if pending is None:
+                self._duplicates += 1
+                counter(
+                    "dlrover_serve_duplicates_total",
+                    "Duplicate serve completions rejected",
+                ).inc()
+                return False
+            latency = max(0.0, time.time() - pending.submit_ts)
+            del self._pending[req_id]
+            self._done[req_id] = _Done(payload, worker, latency)
+            self._latencies.append(latency)
+        counter(
+            "dlrover_serve_responses_total",
+            "Serve responses stored (exactly-once completions)",
+        ).inc()
+        histogram(
+            "dlrover_serve_latency_seconds",
+            "Submit-to-response latency per request",
+            buckets=_LATENCY_BUCKETS,
+        ).observe(latency)
+        self._maybe_drained()
+        return True
+
+    def poll(self, req_id: str) -> Tuple[bool, bytes, int, float]:
+        """Response retrieval: (done, payload, worker_id, latency_s)."""
+        with self._lock:
+            done = self._done.get(req_id)
+            if done is None:
+                return False, b"", -1, 0.0
+            done.delivered = True
+            out = (True, done.payload, done.worker[1], done.latency_s)
+        self._maybe_drained()
+        return out
+
+    # ----------------------------------------------------------- redelivery
+
+    def check_timeouts(self) -> int:
+        """Watchdog body: requeue leases older than the timeout (their
+        worker is presumed dead — SIGKILL leaves no goodbye)."""
+        now = time.time()
+        expired: List[str] = []
+        with self._lock:
+            for req_id, pending in self._pending.items():
+                if pending.worker is None:
+                    continue
+                if now - pending.lease_ts > self._lease_timeout:
+                    expired.append(req_id)
+            for req_id in reversed(expired):
+                self._requeue_locked(req_id)
+        if expired:
+            self._note_redelivered(expired, cause="lease_timeout")
+        return len(expired)
+
+    def relinquish(self, node_type: str, node_id: int) -> int:
+        """Drain handoff: a rotating worker returns its unprocessed
+        leases NOW instead of waiting out the watchdog (the serving
+        analog of relinquish_shards)."""
+        worker = (node_type, int(node_id))
+        with self._lock:
+            requeued = self._requeue_worker_locked(worker)
+        record(
+            "serve.relinquished", node_type=node_type, node_id=node_id,
+            requeued=len(requeued),
+        )
+        if requeued:
+            self._note_redelivered(requeued, cause="relinquish",
+                                   worker=worker)
+        return len(requeued)
+
+    def _requeue_worker_locked(self, worker: Tuple[str, int],
+                               max_incarnation: Optional[int] = None
+                               ) -> List[str]:
+        out = []
+        for req_id, pending in self._pending.items():
+            if pending.worker != worker:
+                continue
+            if (max_incarnation is not None
+                    and pending.incarnation > max_incarnation):
+                continue
+            out.append(req_id)
+        # appendleft one by one, newest first, so the batch lands at
+        # the queue front in its original submit order
+        for req_id in reversed(out):
+            self._requeue_locked(req_id)
+        return out
+
+    def _requeue_locked(self, req_id: str):
+        pending = self._pending.get(req_id)
+        if pending is None or pending.worker is None:
+            return
+        pending.worker = None
+        pending.incarnation = -1
+        pending.lease_ts = 0.0
+        pending.redeliveries += 1
+        self._redelivered += 1
+        # front of the queue: a redelivered request is the oldest work
+        # outstanding, and its latency clock has been running all along
+        self._queue.appendleft(req_id)
+
+    def _note_redelivered(self, req_ids: List[str], cause: str,
+                          worker: Optional[Tuple[str, int]] = None):
+        counter(
+            "dlrover_serve_redeliveries_total",
+            "Serve requests requeued after a lease loss", ["cause"],
+        ).labels(cause=cause).inc(len(req_ids))
+        record(
+            "serve.request_redelivered", cause=cause,
+            count=len(req_ids), req_ids=sorted(req_ids)[:16],
+            node_type=worker[0] if worker else "",
+            node_id=worker[1] if worker else -1,
+        )
+
+    # -------------------------------------------------------------- reading
+
+    def _percentile(self, values: List[float], q: float) -> float:
+        if not values:
+            return 0.0
+        values = sorted(values)
+        idx = min(len(values) - 1, int(round(q * (len(values) - 1))))
+        return values[idx]
+
+    def stats(self) -> Dict:
+        with self._lock:
+            lat = list(self._latencies)
+            leased = sum(
+                1 for p in self._pending.values() if p.worker is not None
+            )
+            out = {
+                "queue_depth": len(self._queue),
+                "in_flight": leased,
+                "submitted": self._submitted,
+                "completed": len(self._done),
+                "rejected": self._rejected,
+                "duplicates": self._duplicates,
+                "redelivered": self._redelivered,
+                "workers": len(self._incarnations),
+                "sealed": self._sealed,
+            }
+        out["p50_ms"] = round(self._percentile(lat, 0.50) * 1000.0, 3)
+        out["p99_ms"] = round(self._percentile(lat, 0.99) * 1000.0, 3)
+        out["drained"] = self.finished()
+        return out
+
+    def finished(self) -> bool:
+        """True once the stream is over: sealed, every admitted request
+        answered, and every response delivered to a poller — the master
+        run loop's serving-job termination condition."""
+        with self._lock:
+            return (
+                self._sealed
+                and not self._queue
+                and not self._pending
+                and all(d.delivered for d in self._done.values())
+            )
+
+    def _maybe_drained(self):
+        if self._drained_recorded or not self.finished():
+            return
+        with self._lock:
+            if self._drained_recorded:
+                return
+            self._drained_recorded = True
+            completed = len(self._done)
+            redelivered = self._redelivered
+        record(
+            "serve.drained", completed=completed,
+            redelivered=redelivered,
+        )

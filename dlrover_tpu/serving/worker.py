@@ -1,0 +1,293 @@
+"""Elastic serving worker: continuous-batching replica with graceful
+rotation.
+
+One replica of the inference pool. It registers through the ordinary
+master client (``update_node_status``), loads weights from the
+flash-checkpoint RAM tier (trainer/checkpoint.py — the artifact the
+training job left behind, no object-store round trip), then pulls
+request micro-batches through the router lease
+(serving/router.py) with a one-deep lookahead:
+
+* a background lease thread keeps the NEXT micro-batch buffered while
+  ``model_fn`` runs the current one — new requests are admitted into
+  the next batch, never stuck behind the in-flight one (continuous
+  batching);
+* every response is reported through ``serve_complete``; a rejection
+  (the request was redelivered elsewhere after a lease timeout) is NOT
+  counted as this worker's response — exactly-once is the router's
+  call, the worker just respects the verdict;
+* **rotation**: SIGTERM sets a drain flag; the worker finishes the
+  batch it is processing (completing every response), relinquishes its
+  remaining leases (``serve_relinquish`` — the buffered lookahead batch
+  goes back to the queue for a surviving replica), pushes its final
+  goodput ledger, and exits with :data:`DRAIN_EXIT_CODE` (21) so the
+  agent books a PREEMPTED, budget-free relaunch — zero dropped, zero
+  duplicated responses.
+
+Chaos: the standard injector grammar gains ``serve_kill@N`` —
+``injector.maybe_inject(served)`` runs after every completed response,
+so a SIGKILL after N requests lands mid-stream with leases outstanding,
+driving the router's redelivery path (the drill's assertion).
+"""
+
+import os
+import queue
+import signal
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.fault_tolerance.drain import DRAIN_EXIT_CODE
+from dlrover_tpu.telemetry import record
+
+__all__ = ["ServingWorker", "ReplicaRotation", "DRAIN_EXIT_CODE"]
+
+
+class ReplicaRotation:
+    """SIGTERM handling for a serving replica.
+
+    Unlike the training drain (fault_tolerance/drain.py), the handler
+    does NOT run the sequence in-line: it only sets the drain flag and
+    returns, so the serve loop finishes its in-flight batch first —
+    "no dropped responses" means the batch being processed completes
+    before the relinquish. Prior dispositions are captured (and
+    restored by ``disarm``), composing with the same lint contract as
+    the drain coordinator."""
+
+    def __init__(self):
+        self._prev = {}  # signum -> pre-arm disposition
+        self._draining = threading.Event()
+        self._reason = ""
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def reason(self) -> str:
+        return self._reason
+
+    def arm(self, signums=(signal.SIGTERM,)) -> bool:
+        """Idempotent; returns False off the main thread (CPython
+        restricts signal.signal)."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        armed = False
+        for signum in signums:
+            if signum in self._prev:
+                armed = True
+                continue
+            try:
+                prev = signal.signal(signum, self._on_signal)
+            except (ValueError, OSError) as e:
+                logger.warning(
+                    "rotation handler for signal %s failed: %s",
+                    signum, e,
+                )
+                continue
+            self._prev[signum] = prev
+            armed = True
+        return armed
+
+    def disarm(self) -> None:
+        for signum, prev in list(self._prev.items()):
+            try:
+                signal.signal(
+                    signum, prev if prev is not None else signal.SIG_DFL
+                )
+            except (ValueError, OSError):
+                pass
+            del self._prev[signum]
+
+    def _on_signal(self, signum, frame):
+        try:
+            name = signal.Signals(signum).name
+        except (ValueError, AttributeError):
+            name = str(signum)
+        self._reason = f"signal-{name.lower()}"
+        self._draining.set()
+
+    def trigger(self, reason: str = "rotation") -> None:
+        """Non-signal drain entry (operator-requested rotation)."""
+        self._reason = reason
+        self._draining.set()
+
+
+class ServingWorker:
+    """One replica: weights from the RAM tier, leases from the router.
+
+    ``model_fn(payloads, state) -> responses`` runs one micro-batch
+    (lists of bytes in, list of bytes out, same order/length).
+    """
+
+    def __init__(
+        self,
+        master_client,
+        model_fn: Callable[[List[bytes], Any], List[bytes]],
+        node_id: int = 0,
+        checkpointer=None,
+        init_state_fn: Optional[Callable[[], Any]] = None,
+        batch_size: int = 8,
+        poll_interval: float = 0.05,
+        incarnation: Optional[int] = None,
+        injector=None,
+        rotation: Optional[ReplicaRotation] = None,
+        exit_fn: Callable[[int], None] = os._exit,
+    ):
+        self._client = master_client
+        self._model_fn = model_fn
+        self._node_id = node_id
+        self._checkpointer = checkpointer
+        self._init_state_fn = init_state_fn
+        self._batch_size = max(1, batch_size)
+        self._poll = max(0.005, poll_interval)
+        if incarnation is None:
+            incarnation = int(os.environ.get(NodeEnv.RESTART_COUNT, "0"))
+        self._incarnation = incarnation
+        self._injector = injector
+        self.rotation = rotation or ReplicaRotation()
+        self._exit_fn = exit_fn
+        self.state: Any = None
+        self.step: Optional[int] = None
+        self.served = 0
+        self.rejected = 0
+        #: one-deep lookahead: the lease thread buffers exactly the
+        #: NEXT micro-batch while model_fn runs the current one
+        self._buffer: "queue.Queue" = queue.Queue(maxsize=1)
+        self._sealed_evt = threading.Event()
+        self._stop_evt = threading.Event()
+
+    # ------------------------------------------------------------- weights
+
+    def load_weights(self) -> Optional[int]:
+        """Restore serving weights, RAM tier first (the flash
+        checkpointer prefers it); fall back to ``init_state_fn`` and
+        warm the tier so the NEXT replica restores instantly."""
+        t0 = time.perf_counter()
+        if self._checkpointer is not None:
+            try:
+                self.state, self.step = self._checkpointer.restore()
+            except Exception as e:
+                logger.warning("serving weight restore failed: %s", e)
+                self.state, self.step = None, None
+        if self.state is None and self._init_state_fn is not None:
+            self.state = self._init_state_fn()
+            self.step = 0
+            if self._checkpointer is not None:
+                try:
+                    self._checkpointer.save(0, self.state)
+                except Exception as e:
+                    logger.warning("RAM-tier warm save failed: %s", e)
+        load_ms = round((time.perf_counter() - t0) * 1000.0, 3)
+        record(
+            "serve.worker_ready", node_id=self._node_id,
+            step=-1 if self.step is None else int(self.step),
+            load_ms=load_ms, incarnation=self._incarnation,
+        )
+        return self.step
+
+    # ---------------------------------------------------------------- loop
+
+    def _lease_loop(self):
+        while not self._stop_evt.is_set():
+            if self.rotation.draining:
+                return
+            try:
+                batch, sealed = self._client.serve_lease(
+                    max_requests=self._batch_size,
+                    incarnation=self._incarnation,
+                )
+            except Exception as e:
+                logger.warning("serve_lease failed: %s", e)
+                time.sleep(self._poll)
+                continue
+            if batch:
+                while not self._stop_evt.is_set():
+                    try:
+                        self._buffer.put(batch, timeout=0.2)
+                        break
+                    except queue.Full:
+                        if self.rotation.draining:
+                            # never consumed: relinquish will requeue
+                            return
+            elif sealed:
+                self._sealed_evt.set()
+                return
+            else:
+                time.sleep(self._poll)
+
+    def _process(self, batch) -> None:
+        payloads = [payload for _, payload in batch]
+        responses = self._model_fn(payloads, self.state)
+        for (req_id, _), response in zip(batch, responses):
+            accepted = self._client.serve_complete(req_id, response)
+            if accepted:
+                self.served += 1
+            else:
+                # redelivered elsewhere (our lease timed out) or a
+                # duplicate: the router already has ONE response
+                self.rejected += 1
+            if self._injector is not None:
+                # serve_kill@N and friends count responses, not steps
+                self._injector.maybe_inject(self.served)
+
+    def serve(self) -> int:
+        """Run until the stream seals (returns requests served) or a
+        rotation drains this replica (calls ``exit_fn(21)``)."""
+        self.rotation.arm()
+        self.load_weights()
+        leaser = threading.Thread(
+            target=self._lease_loop, name="serve-lease", daemon=True,
+        )
+        leaser.start()
+        try:
+            while True:
+                if self.rotation.draining:
+                    return self._drain_exit()
+                try:
+                    batch = self._buffer.get(timeout=self._poll)
+                except queue.Empty:
+                    if self._sealed_evt.is_set() and self._buffer.empty():
+                        break
+                    continue
+                self._process(batch)
+                if self.rotation.draining:
+                    return self._drain_exit()
+        finally:
+            self._stop_evt.set()
+        record(
+            "serve.worker_exit", node_id=self._node_id, reason="sealed",
+            served=self.served, rejected=self.rejected, requeued=0,
+        )
+        self._final_goodput()
+        return self.served
+
+    def _drain_exit(self) -> int:
+        """Rotation: in-flight batch already completed — hand the
+        remaining leases back, close the ledger, exit rc 21."""
+        self._stop_evt.set()
+        requeued = -1
+        try:
+            requeued = self._client.serve_relinquish()
+        except Exception as e:
+            logger.warning("serve_relinquish during drain failed: %s", e)
+        record(
+            "serve.worker_exit", node_id=self._node_id,
+            reason=self.rotation.reason or "rotation",
+            served=self.served, rejected=self.rejected,
+            requeued=requeued,
+        )
+        self._final_goodput()
+        self._exit_fn(DRAIN_EXIT_CODE)
+        return self.served  # only reached with a non-exiting exit_fn
+
+    def _final_goodput(self):
+        report = getattr(self._client, "report_goodput", None)
+        if report is None:
+            return
+        try:
+            report(final=True)
+        except Exception as e:
+            logger.warning("final goodput report failed: %s", e)

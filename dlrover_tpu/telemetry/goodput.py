@@ -79,17 +79,19 @@ class Phase:
     RESTART = "restart"        # fault-to-recovery (incl. master loss)
     PREEMPT = "preempt"        # reclaim notice -> drain -> relaunch
     ROLLBACK = "rollback"      # sentinel trip -> last-good restore
+    SERVING = "serving"        # inference replica answering requests
     IDLE = "idle"              # unattributed
 
 
 PHASES: Tuple[str, ...] = (
     Phase.INIT, Phase.RENDEZVOUS, Phase.TRAINING, Phase.CKPT_STALL,
     Phase.HANG, Phase.RESTART, Phase.PREEMPT, Phase.ROLLBACK,
-    Phase.IDLE,
+    Phase.SERVING, Phase.IDLE,
 )
 
-#: badput breakdown keys: every phase that is neither useful training
-#: nor unattributed
+#: badput breakdown keys: every phase that is neither useful work
+#: (training for a trainer, serving for an inference replica) nor
+#: unattributed
 BADPUT_CAUSES: Tuple[str, ...] = (
     Phase.INIT, Phase.RENDEZVOUS, Phase.CKPT_STALL, Phase.HANG,
     Phase.RESTART, Phase.PREEMPT, Phase.ROLLBACK,
@@ -296,6 +298,12 @@ EVENT_RULES: Dict[str, Callable[[PhaseLedger, float, Dict], None]] = {
         lambda led, ts, data: led.transition(Phase.ROLLBACK, ts=ts),
     "rollback.ordered":
         lambda led, ts, data: led.transition(Phase.ROLLBACK, ts=ts),
+    # a serving replica's useful-work phase opens when its weights are
+    # loaded and it starts answering (serving/worker.py) — without this
+    # rule serve time books as idle; same rule drives the offline
+    # heuristic replay, so serving incarnations reconstruct too
+    "serve.worker_ready":
+        lambda led, ts, data: led.transition(Phase.SERVING, ts=ts),
 }
 
 
@@ -616,6 +624,9 @@ def summarize(procs: Dict[str, Dict[str, Any]],
             "nodes": len(nodes),
             "procs": len(procs),
             "training_s": round(phases[Phase.TRAINING], 6),
+            # the serving tier's useful-work total: neither goodput
+            # (training) nor badput — an inference replica's whole point
+            "serving_s": round(phases[Phase.SERVING], 6),
             "goodput_percent": _pct(phases[Phase.TRAINING], wall),
             "attributed_percent": _pct(attributed, wall),
             "badput_s": {

@@ -1,0 +1,113 @@
+"""Drill replica for the serving chaos test (not a test module).
+
+One elastic serving replica speaking the real protocol against a live
+master: it registers through the training rendezvous (the same
+membership path a trainer uses), loads weights from the shared
+flash-checkpoint RAM tier (the first replica warms it from
+``init_state_fn``; later replicas restore the artifact), then runs
+:class:`dlrover_tpu.serving.worker.ServingWorker` — continuous-batching
+leases with a one-deep lookahead, exactly-once completions, SIGTERM
+rotation exiting rc 21.
+
+Fault surface: the real FaultInjector with ``role="serving"``
+(``DLROVER_FAULT_INJECT=serve_kill@N`` in the env) SIGKILLs this
+process after N responses served — mid-stream, with leased requests
+outstanding, driving the router's lease-timeout redelivery.
+"""
+
+import argparse
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master_addr", required=True)
+    p.add_argument("--node_id", type=int, required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--ckpt_dir", required=True,
+                   help="shared flash-checkpoint tree (persist tier)")
+    p.add_argument("--ram_dir", required=True,
+                   help="shared RAM-tier dir (tmpfs in production)")
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--model_ms", type=float, default=30.0)
+    args = p.parse_args()
+
+    # envelope `proc` = node id BEFORE any journal write, so the drill's
+    # journal asserts can attribute events per replica
+    from dlrover_tpu.common.log import set_process_index
+
+    set_process_index(args.node_id)
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.constants import RendezvousName
+    from dlrover_tpu.fault_tolerance.injection import FaultInjector
+    from dlrover_tpu.serving.worker import ServingWorker
+    from dlrover_tpu.telemetry import goodput
+    from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+    # live ledger: the tap turns serve.worker_ready into the `serving`
+    # phase, and the final report books this incarnation's time on the
+    # master's job account instead of `idle`
+    goodput.install()
+
+    out = open(args.out, "a", buffering=1)
+
+    def emit(line: str):
+        out.write(line + "\n")
+        print(f"[replica {args.node_id}] {line}", flush=True)
+
+    client = MasterClient(
+        args.master_addr, node_id=args.node_id, node_type="worker",
+    )
+
+    # ordinary elastic-node registration: serving replicas join the
+    # same rendezvous trainers use (scale plans see one worker pool)
+    client.report_rdzv_params(
+        min_nodes=1, max_nodes=8, waiting_timeout=0.5, node_unit=1,
+    )
+    client.join_rendezvous(args.node_id, 1)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        _, _, world = client.get_comm_world(
+            RendezvousName.TRAINING, args.node_id
+        )
+        if world and args.node_id in world:
+            emit("REGISTERED")
+            break
+        time.sleep(0.2)
+
+    ckpt = FlashCheckpointer(
+        persist_dir=args.ckpt_dir, ram_dir=args.ram_dir,
+        use_orbax=False,
+    )
+
+    def init_state(_shape=64):
+        # the "trained artifact": a deterministic weight vector every
+        # replica must agree on (responses embed its checksum)
+        import numpy as np
+
+        return {"w": np.arange(_shape, dtype=np.float32)}
+
+    def model_fn(payloads, state):
+        if args.model_ms > 0:
+            time.sleep(args.model_ms / 1000.0)
+        tag = b"#%d" % int(state["w"].sum())
+        return [p.upper() + tag for p in payloads]
+
+    injector = FaultInjector.from_env(role="serving")
+    worker = ServingWorker(
+        client, model_fn, node_id=args.node_id,
+        checkpointer=ckpt, init_state_fn=init_state,
+        batch_size=args.batch_size, poll_interval=0.02,
+        injector=injector,
+    )
+    served = worker.serve()  # rotation exits inside with rc 21
+    emit(f"SERVED {served}")
+    emit("DONE")
+    client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

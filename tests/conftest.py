@@ -56,6 +56,7 @@ DRILL_MODULES = {
     "test_operator",
     "test_four_node_drill",
     "test_goodput_drill",
+    "test_serving_drill",
     "test_preemption_drill",
     "test_sentinel_drill",
     "test_slice_soak_drill",
@@ -105,6 +106,7 @@ DEFAULT_MODULE_BUDGET_S = 60.0
 MODULE_BUDGET_OVERRIDES = {
     "test_four_node_drill": 240.0,
     "test_goodput_drill": 180.0,
+    "test_serving_drill": 120.0,
     "test_preemption_drill": 120.0,
     "test_sentinel_drill": 120.0,
     "test_master_failover": 180.0,

@@ -1,0 +1,496 @@
+"""Serving tier unit tests: router exactly-once, redelivery, autoscale,
+worker rotation, wire codec, injection grammar, goodput phase.
+
+Mirrors the shard-ledger exactly-once suite (test_shard_dispatch.py):
+the request plane must survive worker death (lease-timeout redelivery),
+incarnation churn (world resize), and duplicate completions without a
+single dropped or doubled response.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import LocalMasterClient, MasterClient
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.fault_tolerance.injection import (
+    SERVING_KINDS,
+    FaultInjector,
+    parse_spec,
+)
+from dlrover_tpu.master.local_master import LocalJobMaster
+from dlrover_tpu.serving import (
+    DRAIN_EXIT_CODE,
+    ReplicaRotation,
+    RequestRouter,
+    ServingAutoScaler,
+    ServingWorker,
+)
+from dlrover_tpu.telemetry import goodput
+from dlrover_tpu.telemetry.goodput import BADPUT_CAUSES, PHASES, Phase
+
+W = NodeType.WORKER
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ router
+
+
+def test_router_submit_lease_complete_poll():
+    r = RequestRouter()
+    ok, rid, reason = r.submit(b"ping")
+    assert ok and rid and not reason
+    batch, sealed = r.lease(W, 0, max_requests=4, incarnation=0)
+    assert batch == [(rid, b"ping")] and not sealed
+    assert r.complete(W, 0, rid, b"pong")
+    done, payload, worker_id, latency = r.poll(rid)
+    assert done and payload == b"pong" and worker_id == 0
+    assert latency >= 0.0
+
+
+def test_router_continuous_batching_no_waiting():
+    """lease() returns whatever is queued NOW — it never blocks for a
+    full batch, and a mid-flight submit rides the NEXT micro-batch."""
+    r = RequestRouter()
+    r.submit(b"a", req_id="a")
+    batch, _ = r.lease(W, 0, max_requests=8, incarnation=0)
+    assert [i for i, _ in batch] == ["a"]  # partial batch, no wait
+    # submitted while "a" is in flight: lands in the next lease
+    r.submit(b"b", req_id="b")
+    r.submit(b"c", req_id="c")
+    batch2, _ = r.lease(W, 0, max_requests=8, incarnation=0)
+    assert [i for i, _ in batch2] == ["b", "c"]
+
+
+def test_router_backpressure_and_seal_reject():
+    r = RequestRouter(max_queue=2)
+    assert r.submit(b"1")[0] and r.submit(b"2")[0]
+    ok, _, reason = r.submit(b"3")
+    assert not ok and reason == "backpressure"
+    stats = r.stats()
+    assert stats["rejected"] == 1 and stats["queue_depth"] == 2
+    r.seal()
+    ok, _, reason = r.submit(b"4")
+    assert not ok and reason == "sealed"
+    # an explicit req_id colliding with a live request is a duplicate
+    r2 = RequestRouter()
+    assert r2.submit(b"x", req_id="dup")[0]
+    ok, _, reason = r2.submit(b"y", req_id="dup")
+    assert not ok and reason == "duplicate"
+
+
+def test_router_duplicate_completion_rejected():
+    r = RequestRouter()
+    _, rid, _ = r.submit(b"q")
+    r.lease(W, 0, incarnation=0)
+    assert r.complete(W, 0, rid, b"first")
+    assert not r.complete(W, 0, rid, b"second")
+    assert not r.complete(W, 1, rid, b"third")
+    done, payload, _, _ = r.poll(rid)
+    assert done and payload == b"first"  # first completion wins
+    assert r.stats()["duplicates"] == 2
+
+
+def test_router_lease_timeout_redelivery():
+    """The watchdog requeues leased-but-unacked requests: worker death
+    without a goodbye (SIGKILL) never drops a request."""
+    r = RequestRouter(lease_timeout=0.15)
+    _, rid, _ = r.submit(b"q")
+    batch, _ = r.lease(W, 0, incarnation=0)
+    assert batch
+    assert r.check_timeouts() == 0  # lease still fresh
+    time.sleep(0.2)
+    assert r.check_timeouts() == 1
+    # redelivered to the front: another worker picks it up, completes
+    batch2, _ = r.lease(W, 1, incarnation=0)
+    assert batch2 == [(rid, b"q")]
+    assert r.complete(W, 1, rid, b"resp")
+    # the dead worker's late ghost is rejected — exactly one response
+    assert not r.complete(W, 0, rid, b"ghost")
+    done, payload, worker_id, _ = r.poll(rid)
+    assert done and payload == b"resp" and worker_id == 1
+    assert r.stats()["redelivered"] == 1
+
+
+def test_router_redelivered_goes_to_queue_front():
+    r = RequestRouter(lease_timeout=0.1)
+    r.submit(b"old", req_id="old")
+    r.lease(W, 0, incarnation=0)
+    r.submit(b"new", req_id="new")
+    time.sleep(0.15)
+    r.check_timeouts()
+    batch, _ = r.lease(W, 1, max_requests=2, incarnation=0)
+    # the redelivered request is the oldest outstanding work
+    assert [i for i, _ in batch] == ["old", "new"]
+
+
+def test_router_incarnation_reclaims_dead_workers_leases():
+    """A lease from a newer incarnation of the SAME worker proves the
+    older process is dead: its in-flight requests requeue instantly
+    (no watchdog wait) — exactly-once across a world resize."""
+    r = RequestRouter(lease_timeout=60.0)  # watchdog would be too slow
+    r.submit(b"q", req_id="q")
+    batch, _ = r.lease(W, 0, max_requests=1, incarnation=0)
+    assert batch
+    # same node id comes back as incarnation 1: old lease reclaimed and
+    # immediately re-leased to the new process in the same call
+    batch2, _ = r.lease(W, 0, max_requests=1, incarnation=1)
+    assert batch2 == [("q", b"q")]
+    assert r.complete(W, 0, "q", b"resp")
+    done, payload, _, _ = r.poll("q")
+    assert done and payload == b"resp"
+    assert r.stats()["redelivered"] == 1
+    # a DIFFERENT node's incarnation does not touch this worker
+    r.submit(b"q2", req_id="q2")
+    r.lease(W, 0, max_requests=1, incarnation=1)
+    r.lease(W, 3, max_requests=1, incarnation=5)
+    assert r.stats()["redelivered"] == 1
+
+
+def test_router_relinquish_requeues_for_survivors():
+    r = RequestRouter(lease_timeout=60.0)
+    for i in range(3):
+        r.submit(str(i).encode(), req_id=f"r{i}")
+    batch, _ = r.lease(W, 0, max_requests=3, incarnation=0)
+    assert len(batch) == 3
+    assert r.relinquish(W, 0) == 3
+    # a survivor picks up all three, in submit order
+    batch2, _ = r.lease(W, 1, max_requests=3, incarnation=0)
+    assert [i for i, _ in batch2] == ["r0", "r1", "r2"]
+    assert r.relinquish(W, 0) == 0  # idempotent
+
+
+def test_router_finished_requires_delivery_and_seal():
+    r = RequestRouter()
+    _, rid, _ = r.submit(b"q")
+    assert not r.finished()
+    r.lease(W, 0, incarnation=0)
+    r.complete(W, 0, rid, b"resp")
+    r.seal()
+    # completed but the poller has not collected the response yet
+    assert not r.finished() and not r.stats()["drained"]
+    r.poll(rid)
+    assert r.finished() and r.stats()["drained"]
+    batch, sealed = r.lease(W, 0, incarnation=0)
+    assert batch == [] and sealed  # the worker's exit signal
+
+
+def test_router_stats_match_serve_stats_wire_fields():
+    """rpc_serve_stats does ServeStats(**router.stats()): every stats
+    key must be a wire field, or the RPC breaks at runtime."""
+    r = RequestRouter()
+    stats = r.stats()
+    wire = comm.ServeStats(**stats)  # raises on any mismatch
+    assert set(stats) == {
+        f for f in wire.__dataclass_fields__
+    }
+
+
+# -------------------------------------------------------------- autoscaler
+
+
+def _scaler(stats, calls, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("queue_high", 10)
+    kw.setdefault("p99_high_ms", 1000.0)
+    return ServingAutoScaler(
+        stats_fn=lambda: stats, scale_fn=calls.append, **kw
+    )
+
+
+def test_autoscaler_inert_without_traffic():
+    calls = []
+    s = _scaler({"submitted": 0, "queue_depth": 99, "workers": 1}, calls)
+    assert s.evaluate() is None and not calls
+    assert _scaler(None, calls).evaluate() is None
+
+
+def test_autoscaler_scales_up_on_queue_depth_and_p99():
+    calls = []
+    stats = {"submitted": 50, "queue_depth": 11, "p99_ms": 1.0,
+             "workers": 2, "in_flight": 2, "sealed": False}
+    assert _scaler(stats, calls).evaluate() == 3
+    stats = {"submitted": 50, "queue_depth": 0, "p99_ms": 5000.0,
+             "workers": 2, "in_flight": 2, "sealed": False}
+    assert _scaler(stats, calls).evaluate() == 3
+    assert calls == [3, 3]
+
+
+def test_autoscaler_respects_bounds_and_idles_down():
+    calls = []
+    # at max: a hot queue does not scale past the ceiling
+    hot = {"submitted": 9, "queue_depth": 99, "p99_ms": 9e9,
+           "workers": 4, "in_flight": 1, "sealed": False}
+    assert _scaler(hot, calls).evaluate() is None
+    # idle (empty queue, low p99, nothing in flight): shed one replica
+    idle = {"submitted": 9, "queue_depth": 0, "p99_ms": 10.0,
+            "workers": 3, "in_flight": 0, "sealed": False}
+    assert _scaler(idle, calls).evaluate() == 2
+    # but never below min_replicas
+    idle["workers"] = 1
+    assert _scaler(idle, calls).evaluate() is None
+    # a sealed, drained stream is left alone (workers exit on their own)
+    done = {"submitted": 9, "queue_depth": 0, "p99_ms": 10.0,
+            "workers": 3, "in_flight": 0, "sealed": True}
+    assert _scaler(done, calls).evaluate() is None
+    assert calls == [2]
+
+
+# -------------------------------------------------- injection grammar
+
+
+def test_parse_spec_serve_kill():
+    (f,) = parse_spec("serve_kill@6")
+    assert f.kind == "serve_kill" and f.step == 6 and not f.arg
+    (f,) = parse_spec("serve_kill@6:host=1")
+    assert f.kind == "serve_kill" and f.arg == "host=1"
+    assert f.due(6) and not f.due(5)
+    # kv continuation across the comma split, like sdc@5:flip=2,host=1
+    (f,) = parse_spec("serve_kill@3:host=0,delay=1")
+    assert f.arg == "host=0,delay=1"
+    assert "serve_kill" in SERVING_KINDS
+    with pytest.raises(ValueError):
+        parse_spec("serve_murder@6")
+
+
+def test_serve_kill_role_and_host_filter():
+    # only a serving-role injector keeps serve_kill; trainers and the
+    # master drop it, so one shared spec can chaos a mixed job
+    assert not FaultInjector("serve_kill@6", role="worker")._faults
+    assert not FaultInjector("serve_kill@6", role="master")._faults
+    kept = FaultInjector("serve_kill@6", role="serving")._faults
+    assert [f.kind for f in kept] == ["serve_kill"]
+    # host= pins the kill to one node rank
+    assert FaultInjector(
+        "serve_kill@6:host=1", role="serving", node_rank=1
+    )._faults
+    assert not FaultInjector(
+        "serve_kill@6:host=1", role="serving", node_rank=0
+    )._faults
+    # a serving worker still drops master kinds
+    assert not FaultInjector("master_crash@2", role="serving")._faults
+
+
+# ----------------------------------------------------------- wire codec
+
+
+def test_serving_messages_round_trip():
+    lease = comm.ServeLease(
+        requests=[
+            comm.ServeWireRequest(req_id="a", payload=b"\x00\xffraw"),
+            comm.ServeWireRequest(req_id="b", payload=b"y"),
+        ],
+        sealed=True,
+    )
+    got = comm.deserialize(comm.serialize(lease))
+    assert got == lease
+    assert got.requests[0].payload == b"\x00\xffraw"
+    stats = comm.ServeStats(queue_depth=3, p99_ms=12.5, sealed=True)
+    got = comm.deserialize(comm.serialize(stats))
+    assert got.queue_depth == 3 and got.p99_ms == 12.5 and got.sealed
+    resp = comm.ServeResponse(done=True, req_id="r", payload=b"z",
+                              worker_id=2, latency_s=0.25)
+    assert comm.deserialize(comm.serialize(resp)) == resp
+
+
+# -------------------------------------------------------- goodput phase
+
+
+def test_serving_phase_is_goodput_not_badput():
+    assert Phase.SERVING in PHASES
+    assert Phase.SERVING not in BADPUT_CAUSES
+    led = goodput.PhaseLedger(start_ts=1000.0, journal_events=False)
+    goodput.EVENT_RULES["serve.worker_ready"](led, 1002.0, {})
+    assert led.phase == Phase.SERVING
+    totals = led.totals(now=1007.0)
+    assert totals[Phase.SERVING] == pytest.approx(5.0)
+
+
+# ---------------------------------------------------- rotation handler
+
+
+def test_replica_rotation_sets_flag_and_restores():
+    rot = ReplicaRotation()
+    prev = signal.getsignal(signal.SIGUSR2)
+    assert rot.arm(signums=(signal.SIGUSR2,))
+    assert not rot.draining
+    signal.raise_signal(signal.SIGUSR2)
+    # the handler only FLAGS — the serve loop finishes the in-flight
+    # batch before draining, so no response is dropped
+    assert rot.draining and rot.reason == "signal-sigusr2"
+    rot.disarm()
+    assert signal.getsignal(signal.SIGUSR2) == prev
+
+
+# ----------------------------------------- worker over LocalMasterClient
+
+
+def _echo_model(payloads, state):
+    return [p.upper() for p in payloads]
+
+
+def test_serving_worker_end_to_end_local():
+    client = LocalMasterClient()
+    req_ids = []
+    for i in range(20):
+        ok, rid, _ = client.serve_submit(f"msg{i}".encode())
+        assert ok
+        req_ids.append(rid)
+    client.serve_seal()
+    worker = ServingWorker(
+        client, _echo_model, node_id=0, batch_size=4,
+        poll_interval=0.01, incarnation=0,
+    )
+    served = worker.serve()
+    assert served == 20 and worker.rejected == 0
+    for i, rid in enumerate(req_ids):
+        done, payload, worker_id, _ = client.serve_poll(rid)
+        assert done and payload == f"msg{i}".upper().encode()
+        assert worker_id == 0
+    stats = client.serve_stats()
+    assert stats["completed"] == 20 and stats["drained"]
+
+
+def test_serving_worker_drain_rotation_exits_rc21():
+    """trigger() mid-stream: the worker completes its in-flight batch,
+    relinquishes the rest, and exits DRAIN_EXIT_CODE — zero dropped."""
+    client = LocalMasterClient()
+    for i in range(8):
+        client.serve_submit(f"m{i}".encode(), req_id=f"m{i}")
+    exit_codes = []
+    rot = ReplicaRotation()
+
+    def slow_model(payloads, state):
+        rot.trigger("test-rotation")  # drain lands mid-batch
+        return [p.upper() for p in payloads]
+
+    worker = ServingWorker(
+        client, slow_model, node_id=0, batch_size=2,
+        poll_interval=0.01, incarnation=0, rotation=rot,
+        exit_fn=exit_codes.append,
+    )
+    worker.serve()
+    assert exit_codes == [DRAIN_EXIT_CODE]
+    # the in-flight batch was COMPLETED before the drain...
+    assert worker.served == 2
+    done, payload, _, _ = client.serve_poll("m0")
+    assert done and payload == b"M0"
+    # ...and everything else went back to the queue for a survivor
+    stats = client.serve_stats()
+    assert stats["completed"] == 2
+    assert stats["queue_depth"] + stats["in_flight"] == 6
+    batch, _ = client._serve_router().lease(W, 1, max_requests=8,
+                                            incarnation=0)
+    assert len(batch) >= 6 - stats["in_flight"]
+
+
+def test_serving_worker_rejected_completion_not_counted():
+    """A redelivered request's late ghost completion is the ROUTER's
+    rejection; the worker must not count it as served."""
+    client = LocalMasterClient()
+    router = client._serve_router()
+    client.serve_submit(b"q", req_id="q")
+    # worker 1 steals and completes the request first
+    router.lease(W, 1, incarnation=0)
+    router.complete(W, 1, "q", b"theirs")
+    worker = ServingWorker(client, _echo_model, node_id=0,
+                           incarnation=0)
+    worker._process([("q", b"q")])
+    assert worker.served == 0 and worker.rejected == 1
+
+
+# ------------------------------------------------------ grpc round trip
+
+
+def test_serving_rpcs_over_grpc():
+    master = LocalJobMaster(port=0)
+    master.prepare()
+    try:
+        lb = MasterClient(master.addr, node_id=9, node_type=W)
+        wk = MasterClient(master.addr, node_id=0, node_type=W)
+        ok, rid, _ = lb.serve_submit(b"\x01bin")
+        assert ok
+        batch, sealed = wk.serve_lease(max_requests=4, incarnation=0)
+        assert batch == [(rid, b"\x01bin")] and not sealed
+        assert wk.serve_complete(rid, b"\x02out")
+        assert not wk.serve_complete(rid, b"\x02dup")  # exactly-once
+        done, payload, worker_id, latency = lb.serve_poll(rid)
+        assert done and payload == b"\x02out" and worker_id == 0
+        lb.serve_seal()
+        batch, sealed = wk.serve_lease(incarnation=0)
+        assert batch == [] and sealed
+        stats = lb.serve_stats()
+        assert stats["completed"] == 1 and stats["sealed"]
+        assert stats["duplicates"] == 1
+        assert wk.serve_relinquish() == 0
+        assert master.request_router.finished()
+        lb.close()
+        wk.close()
+    finally:
+        master.stop()
+
+
+def test_serving_worker_threads_share_load_exactly_once():
+    """Two worker threads over loopback gRPC: every request answered
+    exactly once regardless of which replica leased it."""
+    master = LocalJobMaster(port=0)
+    master.prepare()
+    try:
+        lb = MasterClient(master.addr, node_id=9, node_type=W)
+        req_ids = [lb.serve_submit(f"p{i}".encode())[1]
+                   for i in range(30)]
+        lb.serve_seal()
+        clients = [
+            MasterClient(master.addr, node_id=i, node_type=W)
+            for i in range(2)
+        ]
+        workers = [
+            ServingWorker(c, _echo_model, node_id=i, batch_size=4,
+                          poll_interval=0.01, incarnation=0)
+            for i, c in enumerate(clients)
+        ]
+        threads = [threading.Thread(target=w.serve) for w in workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        assert sum(w.served for w in workers) == 30
+        for i, rid in enumerate(req_ids):
+            done, payload, _, _ = lb.serve_poll(rid)
+            assert done and payload == f"P{i}".encode()
+        for c in clients + [lb]:
+            c.close()
+    finally:
+        master.stop()
+
+
+# --------------------------------------------------------------- benchmark
+
+
+def test_serve_load_smoke():
+    """The serving benchmark's tier-1 smoke tier: end to end against a
+    real gRPC master, every request answered exactly once, and the
+    BENCH JSON carries the documented throughput/latency fields."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DLROVER_TPU_METRICS_PORT="off")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "serve_load.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["exactly_once"] is True
+    assert result["requests_per_s"] > 0
+    assert result["serve_p99_ms"] >= result["serve_p50_ms"] >= 0
+    assert result["duplicates"] == 0

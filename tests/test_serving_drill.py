@@ -1,0 +1,245 @@
+"""Serving chaos drill: zero-dropped-request worker rotation under load.
+
+A real master (RequestRouter armed) serves three elastic replicas
+(``_serving_drill_worker.py``) while this test plays the load
+generator. Mid-stream chaos, in order:
+
+* ``DLROVER_FAULT_INJECT=serve_kill@25`` SIGKILLs replica 0 after 25
+  responses — it dies holding leased requests plus a buffered lookahead
+  batch, which the router's lease-timeout watchdog redelivers;
+* the ServingAutoScaler (reading ``serve_stats`` over gRPC) sees the
+  queue depth spike and scales the pool up, spawning replica 2 — which
+  restores its weights from the RAM tier replica 0 warmed;
+* replica 1 is rotated with SIGTERM: it finishes its in-flight batch,
+  relinquishes the rest, and exits rc 21 (DRAIN_EXIT_CODE).
+
+Asserted per request id: every request gets EXACTLY one response, with
+the correct payload (so no replica served from wrong weights); p99
+stays bounded; the journal carries the canonical serve.* vocabulary
+(worker_ready x3, request_redelivered, relinquished, sealed, drained,
+both worker_exit reasons); the master exits 0 once the stream drains;
+and the job's goodput account books `serving` time for the replicas.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import dlrover_tpu.telemetry as T
+from dlrover_tpu.serving import DRAIN_EXIT_CODE, ServingAutoScaler
+from dlrover_tpu.telemetry.journal import read_journal
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_goodput_drill import (  # noqa: E402
+    _drill_env,
+    _free_port,
+    _killpg,
+    _master_port,
+    _spawn_master,
+    _tail,
+    _wait,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NUM_REQUESTS = 160
+BATCH_SIZE = 4
+MODEL_MS = 100.0
+KILL_AFTER = 25
+#: sum(arange(64)) — the checksum of the shared weight artifact every
+#: replica's responses must embed
+WEIGHT_TAG = b"#2016"
+
+
+def _spawn_replica(tmp, env, port, node_id, tag, ckpt_dir, ram_dir):
+    return subprocess.Popen(
+        [sys.executable,
+         os.path.join(REPO, "tests", "_serving_drill_worker.py"),
+         "--master_addr", f"localhost:{port}",
+         "--node_id", str(node_id),
+         "--out", os.path.join(tmp, f"replica-{tag}.txt"),
+         "--ckpt_dir", ckpt_dir, "--ram_dir", ram_dir,
+         "--batch_size", str(BATCH_SIZE),
+         "--model_ms", str(MODEL_MS)],
+        cwd=REPO, env=env,
+        stdout=open(os.path.join(tmp, f"replica-{tag}.out"), "w"),
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+
+
+def _wait_stats(client, cond, what, timeout=60):
+    deadline = time.time() + timeout
+    stats = None
+    while time.time() < deadline:
+        stats = client.serve_stats()
+        if stats and cond(stats):
+            return stats
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}: {stats}")
+
+
+def test_serving_chaos_drill(tmp_path):
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    tmp = str(tmp_path)
+    state_dir = os.path.join(tmp, "state")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    ram_dir = os.path.join(tmp, "ram")
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    env = _drill_env(journal_path)
+    master_env = dict(env, DLROVER_TPU_SERVE_LEASE_TIMEOUT="2.5")
+    worker_envs = {
+        0: dict(env, DLROVER_FAULT_INJECT=f"serve_kill@{KILL_AFTER}"),
+        1: dict(env),
+        2: dict(env),
+    }
+
+    procs = []
+    lb = None
+    scaler = None
+    try:
+        master = _spawn_master(tmp, master_env, state_dir,
+                               _free_port(), "serve")
+        procs.append(master)
+        port = _master_port(tmp, "serve", master)
+
+        w0 = _spawn_replica(tmp, worker_envs[0], port, 0, "0",
+                            ckpt_dir, ram_dir)
+        w1 = _spawn_replica(tmp, worker_envs[1], port, 1, "1",
+                            ckpt_dir, ram_dir)
+        procs += [w0, w1]
+
+        lb = MasterClient(f"localhost:{port}", node_id=9,
+                          node_type="worker")
+        # both replicas leasing == their rotation handlers are armed
+        _wait_stats(lb, lambda s: s["workers"] >= 2,
+                    "2 replicas leasing", timeout=90)
+
+        req_ids = []
+        for i in range(NUM_REQUESTS):
+            ok, rid, reason = lb.serve_submit(b"m%d" % i)
+            assert ok, f"submit {i} rejected: {reason}"
+            req_ids.append(rid)
+        assert len(set(req_ids)) == NUM_REQUESTS
+
+        # the autoscaler component under test, wired the drill way:
+        # stats over gRPC, scale_fn spawning a real replica process
+        spawned = []
+
+        def scale_fn(target):
+            if not spawned:
+                w2 = _spawn_replica(tmp, worker_envs[2], port, 2, "2",
+                                    ckpt_dir, ram_dir)
+                spawned.append(w2)
+                procs.append(w2)
+
+        scaler = ServingAutoScaler(
+            stats_fn=lb.serve_stats, scale_fn=scale_fn,
+            replicas_fn=lambda: 2 + len(spawned),
+            min_replicas=2, max_replicas=3, queue_high=8,
+            p99_high_ms=1e9, interval=0.25, cooldown=1e9,
+        )
+        scaler.start()
+
+        # chaos #1: replica 0 SIGKILLs itself (whole group) after
+        # KILL_AFTER responses, leased requests outstanding
+        rc0 = _wait(w0, 90, "serve_kill replica", tmp,
+                    ["replica-0.out"])
+        assert rc0 == -signal.SIGKILL, _tail(tmp, "replica-0.out")
+
+        # the queue spike scaled the pool: replica 2 is live
+        deadline = time.time() + 60
+        while not spawned and time.time() < deadline:
+            time.sleep(0.2)
+        assert spawned, "autoscaler never spawned replica 2"
+        _wait_stats(lb, lambda s: s["workers"] >= 3,
+                    "replica 2 leasing", timeout=90)
+
+        # chaos #2: rotate replica 1 — SIGTERM, finish in-flight,
+        # relinquish, exit DRAIN_EXIT_CODE
+        os.kill(w1.pid, signal.SIGTERM)
+        rc1 = _wait(w1, 60, "rotated replica", tmp, ["replica-1.out"])
+        assert rc1 == DRAIN_EXIT_CODE, _tail(tmp, "replica-1.out")
+
+        # every request id: exactly one response, correct payload
+        responses = {}
+        deadline = time.time() + 90
+        for i, rid in enumerate(req_ids):
+            while rid not in responses:
+                done, payload, worker_id, latency = lb.serve_poll(rid)
+                if done:
+                    responses[rid] = (payload, worker_id, latency)
+                    break
+                assert time.time() < deadline, (
+                    f"request {rid} never answered; "
+                    + _tail(tmp, "replica-2.out")
+                )
+                time.sleep(0.05)
+        for i, rid in enumerate(req_ids):
+            payload, worker_id, _ = responses[rid]
+            assert payload == (b"m%d" % i).upper() + WEIGHT_TAG, (
+                rid, payload,
+            )
+            assert worker_id in (0, 1, 2)
+
+        stats = lb.serve_stats()
+        assert stats["completed"] == NUM_REQUESTS
+        assert stats["redelivered"] >= 1, stats  # the SIGKILL's leases
+        # bounded tail latency: one lease-timeout redelivery window
+        # plus pool-restaffing headroom, nowhere near the 90s poll cap
+        assert 0 < stats["p99_ms"] < 30000, stats
+
+        lb.serve_seal()
+        rc2 = _wait(spawned[0], 60, "surviving replica", tmp,
+                    ["replica-2.out"])
+        assert rc2 == 0, _tail(tmp, "replica-2.out")
+        assert "DONE" in open(
+            os.path.join(tmp, "replica-2.txt")
+        ).read()
+        # the master's serving-termination path: stream drained -> rc 0
+        assert _wait(master, 60, "master", tmp,
+                     ["master-serve.err"]) == 0
+
+        # --- journal: the canonical serve.* story, end to end --------
+        events = read_journal(journal_path)
+        kinds = [e.get("kind") for e in events]
+        ready = [e for e in events if e.get("kind") == "serve.worker_ready"]
+        assert {e["data"]["node_id"] for e in ready} == {0, 1, 2}
+        redelivered = [e for e in events
+                       if e.get("kind") == "serve.request_redelivered"]
+        assert any(e["data"]["cause"] == "lease_timeout"
+                   for e in redelivered)
+        exits = {e["data"]["node_id"]: e["data"]["reason"]
+                 for e in events if e.get("kind") == "serve.worker_exit"}
+        assert exits.get(1) == "signal-sigterm"
+        assert exits.get(2) == "sealed"
+        assert 0 not in exits  # SIGKILL leaves no goodbye — the point
+        assert "serve.relinquished" in kinds
+        assert "serve.sealed" in kinds and "serve.drained" in kinds
+        # replica 2 restored the artifact replica 0/1 warmed into the
+        # RAM tier (step >= 0 == restore, -1 == cold init)
+        by_node = {e["data"]["node_id"]: e["data"] for e in ready}
+        assert by_node[2]["step"] >= 0, by_node
+
+        # the autoscale decision was journaled (in this process: the
+        # drill runs the scaler) with the queue-depth trigger
+        auto = T.default_journal().events("serve.autoscale")
+        assert auto and auto[-1]["data"]["reason"] == "queue_depth"
+        assert auto[-1]["data"]["target"] == 3
+
+        # goodput: serving incarnations book `serving` time on the job
+        # account the master journals at shutdown — not `idle`
+        summaries = [e for e in events
+                     if e.get("kind") == "goodput.job_summary"]
+        assert summaries, "master never journaled the job account"
+        assert summaries[-1]["data"].get("serving_s", 0) > 0
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        if lb is not None:
+            lb.close()
+        for p in procs:
+            _killpg(p)

@@ -740,6 +740,38 @@ def test_sentinel_event_names_are_the_canonical_set():
     )
 
 
+#: the full vocabulary of the serving request plane (ISSUE 11): router
+#: redelivery + drain on the master, replica lifecycle on the worker.
+#: goodput's EVENT_RULES, the serving drill's journal asserts and
+#: docs/SERVING.md / docs/TELEMETRY.md all match these names literally
+#: — an addition or rename must land everywhere in the same PR
+_SERVE_EVENTS = {
+    "serve.sealed",
+    "serve.drained",
+    "serve.request_redelivered",
+    "serve.relinquished",
+    "serve.autoscale",
+    "serve.worker_ready",
+    "serve.worker_exit",
+    "serve.rpc_fallback",
+}
+
+
+def test_serve_event_names_are_the_canonical_set():
+    """The serve.* journal vocabulary is closed: every record() of a
+    serve event uses exactly one of the documented names, and every
+    documented name has a live emitter."""
+    found = {
+        value
+        for _, _, value, kind in _record_call_literals()
+        if kind == "literal" and value.startswith("serve.")
+    }
+    assert found == _SERVE_EVENTS, (
+        f"unexpected: {sorted(found - _SERVE_EVENTS)}, "
+        f"missing emitters for: {sorted(_SERVE_EVENTS - found)}"
+    )
+
+
 #: span names allow a single undotted segment ("data", "dispatch" —
 #: the bench's train-thread phases predate the dotted convention);
 #: anything dotted must be fully snake-case dotted like event names
