@@ -1,0 +1,492 @@
+"""Control-plane fan-in at fleet scale: batched delta reports vs unary.
+
+ISSUE 12 acceptance evidence. A real master runs in a SUBPROCESS (so
+its CPU is measurable in isolation via /proc); the parent simulates a
+swarm of agents from a thread pool and drives one *interval-equivalent*
+of status traffic per agent per cycle, in two wire modes:
+
+  unary    what the pre-ISSUE-12 agent emits per report interval:
+           ``steps_per_interval`` report_global_step RPCs (the trainer
+           reports every training step, each carrying the full goodput
+           ledger piggyback) + 1 report_heartbeat + 1
+           report_used_resource — K+2 RPCs, full payloads every time.
+           Master journal is write-through (window 0) with the 1/s
+           step-persist throttle: today's configuration.
+  batched  ONE report_node_status delta RPC sampling the latest step
+           (agent/status_reporter.py semantics: goodput/resource
+           sections ride along only when changed). Master journal runs
+           the group-commit lane (flush window) with the per-event
+           step persist the lane makes affordable.
+
+Both modes deliver the same master-side information per cycle: node
+liveness, current global step (hence speed), cumulative goodput, and
+resource usage. Fan-in throughput is interval-equivalents/second at
+driver saturation.
+
+Also runs a LOAD-SHED phase against a master with a tiny admission
+limit (driver concurrency > 2x the limit): reports must be shed with
+retry-after and then land — zero dropped heartbeats, master still
+responsive. Delivery is proven end-to-end: the master's recorded
+(incarnation, seq) per reporter must equal the client's last acked seq.
+
+Prints ONE JSON line (BENCH conventions):
+
+  value                 batched fan-in throughput (agent-intervals/s)
+  vs_baseline           batched / unary interval throughput
+  journal_coalesce_ratio  events staged / store commits (batched lane)
+  *_p99_ms              client-observed per-RPC p99 by mode
+  *_master_cpu_s        master process CPU over the timed window
+  sheds / dropped       main batched phase (expected 0 / 0)
+  shed_phase_*          the low-limit phase (sheds > 0, dropped == 0)
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/master_swarm.py \
+          [--agents 1000] [--threads 16] [--duration 6] [--steps 10]
+      --smoke shrinks the run for the tier-1 suite.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: goodput ledger piggyback the unary trainer sends with EVERY step
+#: report (telemetry/goodput.py canonical phases)
+def _goodput_fields(elapsed: float) -> dict:
+    return {
+        "goodput_phases": {
+            "init": 45.0,
+            "rendezvous": 12.0,
+            "training": max(0.0, elapsed - 60.0),
+            "ckpt_stall": 3.0,
+        },
+        "goodput_elapsed_s": elapsed,
+        "goodput_start_ts": 1000.0,
+        "goodput_phase": "training",
+    }
+
+
+# --------------------------------------------------------------- master role
+
+
+def run_master(ns) -> int:
+    """Subprocess body: a real master servicer on an ephemeral port.
+    Prints ``PORT <n>`` when serving; dumps ``STATS <json>`` when the
+    parent closes stdin."""
+    from dlrover_tpu.common.constants import NodeStatus, NodeType
+    from dlrover_tpu.common.node import Node
+    from dlrover_tpu.master.node.dist_job_manager import (
+        DistributedJobManager,
+    )
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.servicer import create_master_service
+    from dlrover_tpu.master.state_journal import (
+        build_master_state_journal,
+    )
+    from dlrover_tpu.telemetry.goodput import GoodputAggregator
+
+    journal = build_master_state_journal(
+        "swarm-bench", state_dir=ns.state_dir, fresh=True,
+        commit_window=ns.window,
+    )
+    speed = SpeedMonitor()
+    speed.set_step_listener(
+        journal.save_global_step, persist_interval=ns.persist_interval
+    )
+    jm = DistributedJobManager(
+        speed_monitor=speed, heartbeat_timeout=3600.0
+    )
+    # the swarm is pre-registered RUNNING — agent launch is not what
+    # this bench measures
+    jm._node_managers[NodeType.WORKER].update_nodes({
+        i: Node(NodeType.WORKER, i, status=NodeStatus.RUNNING)
+        for i in range(ns.agents)
+    })
+    goodput = GoodputAggregator(
+        persist_fn=journal.save_goodput,
+        persist_interval=ns.persist_interval,
+    )
+    server, servicer = create_master_service(
+        0, job_manager=jm, speed_monitor=speed,
+        goodput_aggregator=goodput,
+    )
+    server.start()
+    print(f"PORT {server.port}", flush=True)
+    sys.stdin.read()  # parent closes stdin to stop us
+    server.stop(grace=0.5)
+    journal.close()
+    stats = {
+        "journal": journal.commit_stats(),
+        "reporters": {
+            f"{t}:{i}": seq
+            for (t, i), (_inc, seq) in servicer._reporters.items()
+        },
+        "final_step": getattr(speed, "_global_step", 0),
+    }
+    print("STATS " + json.dumps(stats), flush=True)
+    return 0
+
+
+class MasterProc:
+    """Parent-side handle on one master subprocess."""
+
+    def __init__(self, agents: int, window: float,
+                 persist_interval: float, env_extra=None):
+        self._tmp = tempfile.TemporaryDirectory(prefix="swarm_master_")
+        env = os.environ.copy()
+        env["DLROVER_TPU_METRICS_PORT"] = "off"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(env_extra or {})
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--role", "master", "--agents", str(agents),
+                "--window", str(window),
+                "--persist_interval", str(persist_interval),
+                "--state_dir", self._tmp.name,
+            ],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, cwd=self._tmp.name,
+            env=env,
+        )
+        self.port = None
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith("PORT "):
+                self.port = int(line.split()[1])
+                break
+        if self.port is None:
+            self.proc.kill()
+            raise RuntimeError("master subprocess never served")
+        self.addr = f"localhost:{self.port}"
+
+    def cpu_s(self) -> float:
+        """utime+stime of the master process, in seconds."""
+        with open(f"/proc/{self.proc.pid}/stat") as f:
+            fields = f.read().rsplit(")", 1)[1].split()
+        ticks = int(fields[11]) + int(fields[12])  # utime, stime
+        return ticks / os.sysconf("SC_CLK_TCK")
+
+    def stop(self) -> dict:
+        """Close stdin (the shutdown signal) and collect STATS."""
+        stats = {}
+        try:
+            self.proc.stdin.close()
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                line = self.proc.stdout.readline()
+                if not line:
+                    break
+                if line.startswith("STATS "):
+                    stats = json.loads(line[len("STATS "):])
+                    break
+            self.proc.wait(timeout=15.0)
+        except Exception:
+            self.proc.kill()
+        finally:
+            self._tmp.cleanup()
+        return stats
+
+
+# -------------------------------------------------------------- swarm driver
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+    return sorted_vals[i]
+
+
+def _drive(master: MasterProc, mode: str, agents: int, threads: int,
+           duration: float, steps_per_interval: int,
+           retry_cap: float = 0.5) -> dict:
+    """Hammer the master with interval-equivalent cycles until the
+    deadline; returns throughput + latency + delivery accounting."""
+    from dlrover_tpu.agent.status_reporter import DeltaTracker
+    from dlrover_tpu.common import comm
+    from dlrover_tpu.common.grpc_utils import GenericRpcClient
+
+    lat = [[] for _ in range(threads)]
+    cycles = [0] * threads
+    sheds = [0] * threads
+    acked_seq = {}  # agent id -> last acked seq (batched mode)
+    trackers = {a: DeltaTracker(incarnation=0) for a in range(agents)}
+    steps = {a: 0 for a in range(agents)}
+    start_evt = threading.Event()
+    warm_barrier = threading.Barrier(threads + 1)
+    errors = []
+
+    def one_cycle(cli, rank: int, a: int, timed: bool):
+        steps[a] += steps_per_interval
+        now = time.time()
+        gp = _goodput_fields(elapsed=steps[a] * 0.5)
+        if mode == "unary":
+            base_step = steps[a] - steps_per_interval
+            for k in range(steps_per_interval):
+                req = comm.GlobalStep(
+                    node_id=a, node_type="worker", timestamp=now,
+                    step=base_step + k + 1, pid=1000 + a, **gp,
+                )
+                t0 = time.perf_counter()
+                cli.call("report_global_step", req)
+                if timed:
+                    lat[rank].append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            cli.call("report_heartbeat", comm.HeartBeat(
+                node_id=a, node_type="worker", timestamp=now,
+            ))
+            if timed:
+                lat[rank].append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            cli.call("report_used_resource", comm.ResourceStats(
+                node_id=a, node_type="worker",
+                cpu_percent=50.0 + (steps[a] % 40),
+                memory_mb=4096 + steps[a] % 512,
+            ))
+            if timed:
+                lat[rank].append(time.perf_counter() - t0)
+        else:
+            rep = trackers[a].compose(
+                now, step=steps[a], pid=1000 + a, goodput_fields=gp,
+                resource=(
+                    50.0 + (steps[a] % 40), 4096 + steps[a] % 512,
+                ),
+                host=f"host-{a}",
+            )
+            rep.node_id = a
+            rep.node_type = "worker"
+            landed = False
+            while not landed:
+                t0 = time.perf_counter()
+                ack = cli.call("report_node_status", rep)
+                if timed:
+                    lat[rank].append(time.perf_counter() - t0)
+                if ack.accepted:
+                    trackers[a].commit(rep)
+                    acked_seq[a] = rep.seq
+                    landed = True
+                else:
+                    # shed: retry the SAME payload with a fresher
+                    # heartbeat, honoring the master's retry-after
+                    if timed:
+                        sheds[rank] += 1
+                    time.sleep(min(
+                        ack.retry_after_s or 0.05, retry_cap
+                    ))
+                    rep.timestamp = time.time()
+        if timed:
+            cycles[rank] += 1
+
+    def worker(rank: int):
+        cli = GenericRpcClient(master.addr, timeout=30.0)
+        mine = [a for a in range(agents) if a % threads == rank]
+        try:
+            # warmup pass (untimed): channel setup + each agent's
+            # initial full=True report — the timed window measures the
+            # steady-state fan-in a fleet runs at for hours
+            for a in mine:
+                one_cycle(cli, rank, a, timed=False)
+            warm_barrier.wait(timeout=120.0)
+            start_evt.wait()
+            deadline = time.monotonic() + duration
+            while time.monotonic() < deadline:
+                for a in mine:
+                    one_cycle(cli, rank, a, timed=True)
+                    if time.monotonic() >= deadline:
+                        break
+        except Exception as e:  # surfaces in the result, fails the run
+            errors.append(f"{mode} worker {rank}: {e!r}")
+        finally:
+            cli.close()
+
+    pool = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(threads)
+    ]
+    for t in pool:
+        t.start()
+    warm_barrier.wait(timeout=180.0)
+    cpu0 = master.cpu_s()
+    t0 = time.perf_counter()
+    start_evt.set()
+    for t in pool:
+        t.join(timeout=duration + 120.0)
+    elapsed = time.perf_counter() - t0
+    cpu1 = master.cpu_s()
+
+    all_lat = sorted(x for chunk in lat for x in chunk)
+    total_cycles = sum(cycles)
+    return {
+        "intervals_per_s": total_cycles / elapsed if elapsed else 0.0,
+        "cycles": total_cycles,
+        "rpcs": len(all_lat),
+        "elapsed_s": elapsed,
+        "p50_ms": _percentile(all_lat, 0.50) * 1000.0,
+        "p99_ms": _percentile(all_lat, 0.99) * 1000.0,
+        "master_cpu_s": cpu1 - cpu0,
+        "sheds": sum(sheds),
+        "acked_seq": acked_seq,
+        "errors": errors,
+    }
+
+
+def _dropped(res: dict, master_stats: dict) -> int:
+    """End-to-end delivery check: every agent's last ACKED seq must be
+    exactly what the master recorded for that reporter."""
+    reporters = master_stats.get("reporters", {})
+    dropped = 0
+    for a, seq in res["acked_seq"].items():
+        if reporters.get(f"worker:{a}", 0) != seq:
+            dropped += 1
+    return dropped
+
+
+# --------------------------------------------------------------------- main
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--role", default="driver", choices=["driver", "master"])
+    p.add_argument("--agents", type=int, default=1000)
+    p.add_argument("--threads", type=int, default=8,
+                   help="driver threads; 8 is the sweet spot on small "
+                        "hosts — more threads only add GIL churn once "
+                        "the master core saturates")
+    p.add_argument("--duration", type=float, default=6.0,
+                   help="seconds per timed phase")
+    p.add_argument("--steps", type=int, default=10, dest="steps",
+                   help="training steps per report interval: the unary "
+                        "agent sends one report_global_step per step")
+    p.add_argument("--window", type=float, default=0.05,
+                   help="(master role) journal flush window")
+    p.add_argument("--persist_interval", type=float, default=0.0,
+                   help="(master role) speed-monitor step persist "
+                        "throttle")
+    p.add_argument("--state_dir", default="")
+    p.add_argument("--min_speedup", type=float, default=None,
+                   help="acceptance gate on vs_baseline (default 10 "
+                        "full / 2 smoke)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny run for the tier-1 suite")
+    ns = p.parse_args()
+
+    if ns.role == "master":
+        return run_master(ns)
+
+    os.environ.setdefault("DLROVER_TPU_METRICS_PORT", "off")
+    if ns.smoke:
+        ns.agents = min(ns.agents, 64)
+        ns.threads = min(ns.threads, 8)
+        ns.duration = min(ns.duration, 1.5)
+    min_speedup = ns.min_speedup
+    if min_speedup is None:
+        min_speedup = 2.0 if ns.smoke else 10.0
+    min_coalesce = 5.0 if ns.smoke else 10.0
+
+    # phase 1 — unary baseline: today's master configuration
+    # (write-through journal, 1/s step-persist throttle)
+    m = MasterProc(ns.agents, window=0.0, persist_interval=1.0)
+    try:
+        unary = _drive(m, "unary", ns.agents, ns.threads, ns.duration,
+                       ns.steps)
+    finally:
+        unary_stats = m.stop()
+
+    # phase 2 — batched deltas against the group-commit lane
+    m = MasterProc(ns.agents, window=ns.window, persist_interval=0.0)
+    try:
+        batched = _drive(m, "batched", ns.agents, ns.threads,
+                         ns.duration, ns.steps)
+    finally:
+        batched_stats = m.stop()
+    dropped = _dropped(batched, batched_stats)
+
+    # phase 3 — load shed: admission limit 2, driver concurrency > 2x
+    # the limit, against a WRITE-THROUGH master (journal file I/O
+    # inside the handler — the configuration that actually piles
+    # handlers up under fan-in); every report must shed-then-land
+    # (zero dropped)
+    shed_agents = 24 if ns.smoke else 64
+    shed_threads = max(8, ns.threads // 2)
+    m = MasterProc(
+        shed_agents, window=0.0, persist_interval=0.0,
+        env_extra={
+            "DLROVER_TPU_REPORT_INFLIGHT_LIMIT": "2",
+            "DLROVER_TPU_REPORT_RETRY_AFTER": "0.02",
+        },
+    )
+    try:
+        shed = _drive(m, "batched", shed_agents, shed_threads,
+                      1.0 if ns.smoke else 2.0, ns.steps,
+                      retry_cap=0.05)
+    finally:
+        shed_stats = m.stop()
+    shed_dropped = _dropped(shed, shed_stats)
+
+    jstats = batched_stats.get("journal", {})
+    events = jstats.get("events", 0)
+    commits = max(1, jstats.get("commits", 0))
+    coalesce = events / commits
+    speedup = (
+        batched["intervals_per_s"] / unary["intervals_per_s"]
+        if unary["intervals_per_s"] else 0.0
+    )
+    errors = unary["errors"] + batched["errors"] + shed["errors"]
+    ok = (
+        not errors
+        and dropped == 0
+        and batched["sheds"] == 0
+        and shed["sheds"] > 0
+        and shed_dropped == 0
+        and speedup >= min_speedup
+        and coalesce >= min_coalesce
+        and batched["p99_ms"] < 1000.0
+    )
+    result = {
+        "metric": "control_plane_fanin_throughput",
+        "value": round(batched["intervals_per_s"], 1),
+        "unit": "agent-intervals/s",
+        "vs_baseline": round(speedup, 2),
+        "unary_intervals_per_s": round(unary["intervals_per_s"], 1),
+        "batched_intervals_per_s": round(batched["intervals_per_s"], 1),
+        "unary_rpcs_per_interval": ns.steps + 2,
+        "unary_p50_ms": round(unary["p50_ms"], 3),
+        "unary_p99_ms": round(unary["p99_ms"], 3),
+        "batched_p50_ms": round(batched["p50_ms"], 3),
+        "batched_p99_ms": round(batched["p99_ms"], 3),
+        "unary_master_cpu_s": round(unary["master_cpu_s"], 2),
+        "batched_master_cpu_s": round(batched["master_cpu_s"], 2),
+        "journal_events": events,
+        "journal_commits": jstats.get("commits", 0),
+        "journal_coalesce_ratio": round(coalesce, 1),
+        "unary_journal_commits":
+            unary_stats.get("journal", {}).get("commits", 0),
+        "sheds": batched["sheds"],
+        "dropped": dropped,
+        "shed_phase_sheds": shed["sheds"],
+        "shed_phase_dropped": shed_dropped,
+        "agents": ns.agents,
+        "threads": ns.threads,
+        "duration_s": ns.duration,
+        "steps_per_interval": ns.steps,
+        "smoke": bool(ns.smoke),
+        "ok": ok,
+    }
+    if errors:
+        result["errors"] = errors[:5]
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

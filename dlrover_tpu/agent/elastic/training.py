@@ -201,7 +201,7 @@ class ElasticTrainingAgent:
         self._proc: Optional[subprocess.Popen] = None
         self._stopped = False
         self._remaining_restarts = config.max_restarts
-        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._status_reporter = None
         self._restart_requested = threading.Event()
         # per-host scrape point (the master serves its own): ephemeral
         # port unless DLROVER_TPU_METRICS_PORT pins/disables it
@@ -214,54 +214,60 @@ class ElasticTrainingAgent:
 
         self._goodput = goodput.install()
 
+    def _handle_master_action(self, action: str):
+        """Act on the directive the master piggybacks on the report ack
+        (parity: the reference agent's DiagnosisAction handling). A
+        ``restart`` action recycles the training process on the monitor
+        loop without charging the restart budget — the node stays
+        RUNNING and the reporter keeps heartbeating throughout."""
+        if action == NodeAction.RESTART_WORKER:
+            logger.info("Master heartbeat action: restart workers")
+            self._restart_requested.set()
+        elif action == NodeAction.DRAIN:
+            logger.warning(
+                "Master heartbeat action: drain (platform "
+                "reclaim ahead) — SIGTERM worker group"
+            )
+            record(
+                "preempt.drain_action",
+                node_rank=self._config.node_rank,
+            )
+            # SIGTERM only: the worker's DrainCoordinator
+            # runs its notice-window sequence and exits
+            # rc 21; this agent stays up to classify it
+            self._signal_worker_group(signal.SIGTERM)
+        elif action == NodeAction.STOP:
+            logger.info("Master heartbeat action: stop")
+            # full stop: end the monitor loop AND kill the
+            # training process (an orphaned trainer would
+            # keep the TPU busy after the node "succeeded")
+            self.stop()
+
     def _start_heartbeat(self, interval: float = 15.0):
-        """Feed the master's liveness watchdog and act on the directive
-        piggybacked on the response (parity: the reference agent's
-        report_heartbeat loop + DiagnosisAction handling). A ``restart``
-        action recycles the training process on the monitor loop without
-        charging the restart budget — the node stays RUNNING and this
-        thread keeps heartbeating throughout."""
+        """Feed the master's liveness watchdog via the coalesced
+        ``report_node_status`` path (agent/status_reporter.py): one
+        delta rpc per interval carrying heartbeat + goodput snapshot,
+        ±20% jittered so a master restart doesn't face the whole
+        fleet's reports back in phase. The reporter degrades to the
+        legacy ``report_heartbeat`` rpc by itself against a master
+        that predates the batched path."""
+        from dlrover_tpu.agent.status_reporter import StatusReporter
 
-        def loop():
-            failures = 0
-            while not self._stopped:
-                try:
-                    action = self._client.report_heartbeat()
-                    failures = 0
-                    if action == NodeAction.RESTART_WORKER:
-                        logger.info(
-                            "Master heartbeat action: restart workers"
-                        )
-                        self._restart_requested.set()
-                    elif action == NodeAction.DRAIN:
-                        logger.warning(
-                            "Master heartbeat action: drain (platform "
-                            "reclaim ahead) — SIGTERM worker group"
-                        )
-                        record(
-                            "preempt.drain_action",
-                            node_rank=self._config.node_rank,
-                        )
-                        # SIGTERM only: the worker's DrainCoordinator
-                        # runs its notice-window sequence and exits
-                        # rc 21; this agent stays up to classify it
-                        self._signal_worker_group(signal.SIGTERM)
-                    elif action == NodeAction.STOP:
-                        logger.info("Master heartbeat action: stop")
-                        # full stop: end the monitor loop AND kill the
-                        # training process (an orphaned trainer would
-                        # keep the TPU busy after the node "succeeded")
-                        self.stop()
-                except Exception as e:
-                    failures += 1
-                    if failures <= 2:  # quiet after the master goes away
-                        logger.warning("heartbeat failed: %s", e)
-                time.sleep(interval)
-
-        self._heartbeat_thread = threading.Thread(
-            target=loop, daemon=True, name="agent-heartbeat"
+        self._status_reporter = StatusReporter(
+            self._client, interval,
+            incarnation=self._restart_count,
+            on_action=self._handle_master_action,
         )
-        self._heartbeat_thread.start()
+        # a replaced master has no delta baseline for this agent; it
+        # will reply resync=True on first contact, but re-sending full
+        # proactively on reconnect saves that round-trip
+        add_hook = getattr(self._client, "add_reconnect_hook", None)
+        if add_hook is not None:
+            add_hook(
+                "report-resync",
+                self._status_reporter._tracker.request_full,
+            )
+        self._status_reporter.start()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -511,6 +517,8 @@ class ElasticTrainingAgent:
 
     def stop(self):
         self._stopped = True
+        if self._status_reporter is not None:
+            self._status_reporter.stop()
         self._kill_workers()
         if self._metrics_server is not None:
             self._metrics_server.stop()

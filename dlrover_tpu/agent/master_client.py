@@ -458,6 +458,23 @@ class MasterClient:
         return self._call("report_heartbeat", req).action
 
     @supervised_rpc
+    def report_node_status(self, report: comm.NodeStatusReport):
+        """The coalesced fan-in rpc (agent/status_reporter.py builds
+        the delta payload): heartbeat + changed sections in one call.
+        Returns the :class:`~dlrover_tpu.common.comm.NodeStatusAck`, or
+        ``None`` when the master predates the RPC — the reporter then
+        degrades to the per-rpc paths for the rest of this process."""
+        try:
+            return self._call("report_node_status", self._fill(report))
+        except Exception as e:
+            if is_connection_error(e):
+                raise
+            logger.warning("report_node_status unsupported: %s", e)
+            record("report.rpc_fallback", rpc="report_node_status",
+                   error=str(e)[:200])
+            return None
+
+    @supervised_rpc
     def report_failure(self, error_data: str, level: str,
                        restart_count: int = 0):
         req = self._fill(comm.NodeFailure(
@@ -857,6 +874,10 @@ class LocalMasterClient:
 
     def report_heartbeat(self):
         return ""
+
+    def report_node_status(self, report):
+        # masterless: ack everything so the reporter idles quietly
+        return comm.NodeStatusAck(accepted=True, acked_seq=report.seq)
 
     # masterless serving: the request plane lives in-process, so a
     # single-host ``examples/serve.py`` run needs no master at all

@@ -53,6 +53,29 @@ except ImportError:  # pragma: no cover - numpy is a hard dep in practice
 #: can ever be constructed from network bytes
 _REGISTRY: Dict[str, type] = {}
 
+#: per-class field defaults, for the sparse encoding (built lazily;
+#: default_factory values are materialized once and never mutated)
+_DEFAULTS: Dict[type, Dict[str, object]] = {}
+
+
+def _class_defaults(cls) -> Dict[str, object]:
+    cached = _DEFAULTS.get(cls)
+    if cached is None:
+        cached = {}
+        for f in dataclasses.fields(cls):
+            if f.default is not dataclasses.MISSING:
+                cached[f.name] = f.default
+            elif f.default_factory is not dataclasses.MISSING:
+                cached[f.name] = f.default_factory()
+        _DEFAULTS[cls] = cached
+    return cached
+
+
+def _is_default(value, default) -> bool:
+    # strict type match: True == 1 and 0 == 0.0 in Python, but dropping
+    # the field would RE-TYPE it on decode (default comes back instead)
+    return type(value) is type(default) and value == default
+
 
 def _encode(obj):
     if obj is None or isinstance(obj, (bool, int, float, str)):
@@ -64,13 +87,19 @@ def _encode(obj):
     if isinstance(obj, bytes):
         return {"__b64__": base64.b64encode(obj).decode("ascii")}
     if isinstance(obj, BaseMessage):
-        return {
-            "__msg__": type(obj).__name__,
-            "f": {
-                f.name: _encode(getattr(obj, f.name))
-                for f in dataclasses.fields(obj)
-            },
-        }
+        # sparse encoding: omit fields still at their dataclass default
+        # — the decoder reconstructs them, so round-trips are identity
+        # and old peers (which also default missing fields) read the
+        # message unchanged. At fleet fan-in this is most of the bytes:
+        # a delta NodeStatusReport is ~20 declared fields, ~5 live ones.
+        defaults = _class_defaults(type(obj))
+        fields_out = {}
+        for f in dataclasses.fields(obj):
+            value = getattr(obj, f.name)
+            if f.name in defaults and _is_default(value, defaults[f.name]):
+                continue
+            fields_out[f.name] = _encode(value)
+        return {"__msg__": type(obj).__name__, "f": fields_out}
     if isinstance(obj, dict):
         for k in obj:
             # map keys must survive a JSON round trip AND be hashable
@@ -481,6 +510,55 @@ class GoodputReport(BaseRequest):
     goodput_start_ts: float = 0.0
     goodput_phase: str = ""
     final: bool = False
+
+
+@dataclass
+class NodeStatusReport(BaseRequest):
+    """Coalesced per-interval agent report: heartbeat + (optionally)
+    global step, goodput snapshot, and resource stats in ONE rpc, with
+    delta semantics — ``has_*`` gates mark which sections are present,
+    and the agent only includes a section when it changed since the
+    last *acked* report. ``full=True`` resends everything (first report
+    of an incarnation, reconnect, or master-requested resync). Old
+    masters reject the unknown method at the app layer; the agent then
+    falls back to the per-rpc paths, so mixed fleets keep working."""
+
+    timestamp: float = 0.0  # heartbeat: always present
+    #: agent restart count; a new incarnation implies a full report
+    incarnation: int = -1
+    #: per-incarnation monotonic report number; lets the master detect
+    #: gaps (missed interval => ask for a resync of delta'd sections)
+    seq: int = 0
+    full: bool = False
+    has_step: bool = False
+    step: int = 0
+    step_ts: float = 0.0
+    pid: int = 0
+    has_goodput: bool = False
+    goodput_phases: Dict = field(default_factory=dict)
+    goodput_elapsed_s: float = 0.0
+    goodput_start_ts: float = 0.0
+    goodput_phase: str = ""
+    host: str = ""
+    final: bool = False
+    has_resource: bool = False
+    cpu_percent: float = 0.0
+    memory_mb: int = 0
+
+
+@dataclass
+class NodeStatusAck(BaseMessage):
+    """Reply to NodeStatusReport. ``accepted=False`` is load-shed: the
+    master did NOT apply the report; retry the same payload after
+    ``retry_after_s`` (jittered). ``resync=True`` asks the agent to
+    send ``full=True`` next interval (master restarted / lost its
+    per-reporter delta baseline)."""
+
+    accepted: bool = True
+    retry_after_s: float = 0.0
+    action: str = ""  # pending NodeAction piggyback, same as heartbeat
+    resync: bool = False
+    acked_seq: int = -1
 
 
 @dataclass

@@ -10,6 +10,7 @@ raises :class:`~dlrover_tpu.common.comm.WireError` instead of executing.
 """
 
 import json
+import os
 import socket
 import threading
 from concurrent import futures
@@ -69,11 +70,21 @@ def addr_connected(addr: str, timeout: float = 3.0) -> bool:
         return False
 
 
+#: default dispatch pool size; DLROVER_TPU_GRPC_MAX_WORKERS overrides
+#: for fleet-scale masters (the servicer's bounded admission keeps the
+#: batched report path from monopolizing whatever size is chosen)
+DEFAULT_MAX_WORKERS = 64
+
+
 class GenericRpcServer:
     """gRPC server exposing one generic dispatch method."""
 
     def __init__(self, handler: Callable[[str, object], object], port: int = 0,
-                 max_workers: int = 64):
+                 max_workers: Optional[int] = None):
+        if max_workers is None:
+            max_workers = int(
+                os.environ.get("DLROVER_TPU_GRPC_MAX_WORKERS", "0")
+            ) or DEFAULT_MAX_WORKERS
         self._handler = handler
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),

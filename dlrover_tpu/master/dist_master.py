@@ -147,8 +147,14 @@ class DistributedJobMaster:
                     lambda p, _n=name:
                         self.state_journal.save_rdzv_params(_n, p)
                 )
+            # with the group-commit lane on, the lane does the
+            # coalescing — the monitor-side 1/s throttle would only
+            # add staleness on top of the flush window
             self.speed_monitor.set_step_listener(
-                self.state_journal.save_global_step
+                self.state_journal.save_global_step,
+                persist_interval=(
+                    0.0 if self.state_journal.coalescing else 1.0
+                ),
             )
         # job-wide goodput/badput/MTTR accounting: worker ledgers ride
         # in on report_global_step / report_goodput, the aggregator
@@ -158,6 +164,14 @@ class DistributedJobMaster:
             persist_fn=(
                 self.state_journal.save_goodput
                 if self.state_journal else None
+            ),
+            # same reasoning as the step listener: with the lane on,
+            # per-report persistence is one staged dict update — the
+            # aggregator-side 1/s throttle would only add staleness
+            persist_interval=(
+                0.0
+                if self.state_journal and self.state_journal.coalescing
+                else 1.0
             ),
         )
         self.sync_service = SyncService(self.job_manager)
@@ -470,6 +484,10 @@ class DistributedJobMaster:
                 logger.warning("goodput summary failed: %s", e)
         goodput_mod.set_job_provider(None)
         self._server.stop(grace=1.0)
+        if self.state_journal is not None:
+            # drain the group-commit lane: everything staged lands in
+            # one final transaction before the process exits
+            self.state_journal.close()
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None

@@ -80,13 +80,36 @@ class SpeedMonitor:
         self._host_durations: Dict[int, Deque[float]] = {}
         self._straggler_strikes: Dict[int, int] = {}
         self._stragglers: Set[int] = set()
+        # ---- swarm-scale bounds (ISSUE 12) ----
+        # per-host state and per-node metric labels are the master's
+        # only per-node-UNBOUNDED memory: at 10k nodes the duration
+        # deques alone are tens of MB and every report pays an
+        # O(hosts) scoring pass. Cap the tracked set (evict the
+        # stalest reporter), cap the metric label space (first-come),
+        # and rate-limit scoring once the fleet outgrows small sizes.
+        self._host_cap = max(2, int(
+            os.getenv("DLROVER_TPU_SPEED_HOST_CAP", "256")
+        ))
+        self._labeled_nodes: Set[int] = set()
+        self._score_interval = float(
+            os.getenv("DLROVER_TPU_STRAGGLER_SCORE_INTERVAL", "0.5")
+        )
+        self._last_score = 0.0
+        self._host_stale_s = float(
+            os.getenv("DLROVER_TPU_SPEED_HOST_STALE_S", "60")
+        )
+        self._last_evict_scan = 0.0
         # master state journal hook: listener(step, batch_feed) fires
-        # when the max step advances, throttled to one write per second
+        # when the max step advances, throttled to one write per
+        # ``step_persist_interval`` seconds (0 = every advance — used
+        # when the journal's group-commit lane does the coalescing)
         self._step_listener = None
+        self._step_persist_interval = 1.0
         self._last_step_persist = 0.0
 
-    def set_step_listener(self, listener):
+    def set_step_listener(self, listener, persist_interval: float = 1.0):
         self._step_listener = listener
+        self._step_persist_interval = max(0.0, persist_interval)
 
     def restore_global_step(self, global_step: int,
                             batch_feed: bool = False):
@@ -123,12 +146,7 @@ class SpeedMonitor:
         ).set(len(self._workers))
         # a removed host's history must not keep skewing the fleet
         # median (nor keep it on the straggler list after eviction)
-        self._host_last.pop(node_id, None)
-        self._host_durations.pop(node_id, None)
-        self._straggler_strikes.pop(node_id, None)
-        if node_id in self._stragglers:
-            self._stragglers.discard(node_id)
-            self._set_straggler_gauge()
+        self._evict_host(node_id)
 
     @property
     def running_workers(self):
@@ -164,7 +182,8 @@ class SpeedMonitor:
         if (
             self._step_listener is not None
             and advanced
-            and timestamp - self._last_step_persist >= 1.0
+            and timestamp - self._last_step_persist
+            >= self._step_persist_interval
         ):
             self._last_step_persist = timestamp
             try:
@@ -215,6 +234,37 @@ class SpeedMonitor:
         re-score. Durations are per-host deltas between the host's OWN
         consecutive reports — cross-host clock skew cancels out."""
         last = self._host_last.get(node_id)
+        if last is None and len(self._host_last) >= self._host_cap:
+            # tracked set full: admit the newcomer only by evicting a
+            # STALE incumbent (stopped reporting), found by a scan
+            # rate-limited to 1/s — at 10k nodes an O(cap) scan per
+            # untracked report would itself be the fan-in tax. Live
+            # incumbents keep their window; the newcomer's report is
+            # counted as untracked and dropped from straggler scoring
+            # (the fleet median needs A bounded sample, not every
+            # host).
+            now_mono = time.monotonic()
+            evicted = False
+            if now_mono - self._last_evict_scan >= 1.0:
+                self._last_evict_scan = now_mono
+                stalest = min(
+                    self._host_last, key=lambda n: self._host_last[n][1]
+                )
+                if timestamp - self._host_last[stalest][1] \
+                        > self._host_stale_s:
+                    self._evict_host(stalest)
+                    counter(
+                        "dlrover_speed_monitor_hosts_evicted_total",
+                        "Stale hosts evicted from straggler tracking "
+                        "at the cap",
+                    ).inc()
+                    evicted = True
+            if not evicted:
+                counter(
+                    "dlrover_speed_monitor_untracked_reports_total",
+                    "Step reports from hosts beyond the tracking cap",
+                ).inc()
+                return
         self._host_last[node_id] = (global_step, timestamp)
         if last is None:
             return
@@ -222,16 +272,37 @@ class SpeedMonitor:
         if global_step <= s0 or timestamp <= t0:
             return  # restart/replay or duplicate report: no signal
         duration = (timestamp - t0) / (global_step - s0)
-        histogram(
-            "dlrover_host_step_duration_seconds",
-            "Per-host step duration seen from that host's reports",
-            ["node"], buckets=_STEP_BUCKETS,
-        ).labels(node=str(node_id)).observe(duration)
+        # per-node labels are first-come bounded at the cap: label
+        # churn across evictions would otherwise grow the registry's
+        # series count with every node the job ever saw
+        if (node_id in self._labeled_nodes
+                or len(self._labeled_nodes) < self._host_cap):
+            self._labeled_nodes.add(node_id)
+            histogram(
+                "dlrover_host_step_duration_seconds",
+                "Per-host step duration seen from that host's reports",
+                ["node"], buckets=_STEP_BUCKETS,
+            ).labels(node=str(node_id)).observe(duration)
         durs = self._host_durations.setdefault(
             node_id, deque(maxlen=self._max_record_count)
         )
         durs.append(duration)
+        # per-report scoring is O(hosts): free at lab size, a fleet
+        # tax at 10k — rate-limit once the fleet outgrows small sizes
+        if len(self._host_durations) > 32:
+            now = time.monotonic()
+            if now - self._last_score < self._score_interval:
+                return
+            self._last_score = now
         self._score_stragglers()
+
+    def _evict_host(self, node_id: int) -> None:
+        self._host_last.pop(node_id, None)
+        self._host_durations.pop(node_id, None)
+        self._straggler_strikes.pop(node_id, None)
+        if node_id in self._stragglers:
+            self._stragglers.discard(node_id)
+            self._set_straggler_gauge()
 
     def _set_straggler_gauge(self) -> None:
         gauge(
@@ -254,11 +325,12 @@ class SpeedMonitor:
             return
         for node_id, dur in per_host.items():
             ratio = dur / fleet
-            gauge(
-                "dlrover_host_step_duration_ratio",
-                "Host rolling-median step duration over fleet median",
-                ["node"],
-            ).labels(node=str(node_id)).set(round(ratio, 3))
+            if node_id in self._labeled_nodes:
+                gauge(
+                    "dlrover_host_step_duration_ratio",
+                    "Host rolling-median step duration over fleet median",
+                    ["node"],
+                ).labels(node=str(node_id)).set(round(ratio, 3))
             if dur > self._straggler_ratio * fleet:
                 strikes = self._straggler_strikes.get(node_id, 0) + 1
                 self._straggler_strikes[node_id] = strikes

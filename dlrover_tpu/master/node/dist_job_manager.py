@@ -33,6 +33,40 @@ from dlrover_tpu.master.watcher.base_watcher import NodeEvent, NodeWatcher
 from dlrover_tpu.telemetry import record
 
 
+class _StripedActions:
+    """(node_type, node_id) -> NodeAction under striped locks.
+
+    Heartbeat collection pops from here once per agent per interval;
+    at 10k agents a single mutex shared with event processing turns
+    the pop into the fleet's serialization point. Stripes bound the
+    contention, and the empty-stripe fast path (a bare dict truth
+    test, atomic under the GIL) means the common no-pending-action
+    heartbeat takes no lock at all."""
+
+    STRIPES = 16
+
+    def __init__(self):
+        self._maps: List[Dict[tuple, str]] = [
+            {} for _ in range(self.STRIPES)
+        ]
+        self._locks = [threading.Lock() for _ in range(self.STRIPES)]
+
+    def _stripe(self, key: tuple) -> int:
+        return hash(key) % self.STRIPES
+
+    def put(self, key: tuple, action: str):
+        i = self._stripe(key)
+        with self._locks[i]:
+            self._maps[i][key] = action
+
+    def pop(self, key: tuple) -> Optional[str]:
+        i = self._stripe(key)
+        if not self._maps[i]:  # lock-free fast path
+            return None
+        with self._locks[i]:
+            return self._maps[i].pop(key, None)
+
+
 class DistributedJobManager:
     """Tracks {node_type: {id: Node}}, reacts to platform events, and
     decides relaunches."""
@@ -69,8 +103,12 @@ class DistributedJobManager:
         # on_node_deleted, each f(node) (parity: event_callback.py)
         self._callbacks: Dict[str, List[Callable]] = {}
         self._threads: List[threading.Thread] = []
-        # (node_type, node_id) -> NodeAction, delivered on next heartbeat
-        self._pending_actions: Dict[tuple, str] = {}
+        # (node_type, node_id) -> NodeAction, delivered on next heartbeat.
+        # Striped: heartbeat collection is the hottest path on the
+        # master (every agent, every interval) and must not serialize
+        # the fleet on the job-manager mutex shared with event
+        # processing and scaling.
+        self._pending_actions = _StripedActions()
         # critical-node fast-fail (parity: training_node.py:40-104
         # critical marking + the job-failure path): set when a critical
         # node is permanently lost; the master run loop fails the job
@@ -397,8 +435,7 @@ class DistributedJobManager:
         node's next heartbeat — the agent SIGTERMs its worker group so
         the in-process DrainCoordinator spends the notice window."""
         self.handle_preemption_notice(node_type, node_id, reason)
-        with self._lock:
-            self._pending_actions[(node_type, node_id)] = NodeAction.DRAIN
+        self._pending_actions.put((node_type, node_id), NodeAction.DRAIN)
         record(
             "preempt.drain_requested", node_type=node_type,
             node_id=node_id, reason=reason,
@@ -411,8 +448,7 @@ class DistributedJobManager:
         node = self.get_node(node_type, node_id)
         if node is not None:
             node.heartbeat_time = ts or time.time()
-        with self._lock:
-            action = self._pending_actions.pop((node_type, node_id), None)
+        action = self._pending_actions.pop((node_type, node_id))
         if action and node is not None:
             node.hang = False  # recovery is now in the agent's hands
         return action
@@ -432,10 +468,9 @@ class DistributedJobManager:
         )
         if node is not None:
             node.hang = True
-        with self._lock:
-            self._pending_actions[(node_type, node_id)] = (
-                NodeAction.RESTART_WORKER
-            )
+        self._pending_actions.put(
+            (node_type, node_id), NodeAction.RESTART_WORKER
+        )
 
     def _monitor_heartbeats(self):
         """The watchdog only arms for nodes that have reported at least
@@ -443,18 +478,24 @@ class DistributedJobManager:
         thread are never killed by it."""
         while not self._stopped.wait(self._heartbeat_timeout / 3):
             now = time.time()
-            # get_running_nodes snapshots each role dict under the
-            # per-manager lock (the one add_node takes)
-            for node in self.get_running_nodes():
+            # snapshot once (get_running_nodes copies each role dict
+            # under the per-manager lock, held only for the copy), then
+            # run the staleness scan lock-free — at 10k nodes the scan
+            # must not contend with the hot report path. Eviction work
+            # (relaunch plans, status flow) takes locks per hung node
+            # only, and hung nodes are the rare case by construction.
+            stale = [
+                node for node in self.get_running_nodes()
+                if node.heartbeat_time > 0
+                and now - node.heartbeat_time > self._heartbeat_timeout
+            ]
+            for node in stale:
                 try:
-                    if node.heartbeat_time <= 0:
-                        continue
-                    if now - node.heartbeat_time > self._heartbeat_timeout:
-                        logger.warning(
-                            "%s heartbeat lost for %.0fs -> failed",
-                            node.name, now - node.heartbeat_time,
-                        )
-                        self._handle_hung_node(node)
+                    logger.warning(
+                        "%s heartbeat lost for %.0fs -> failed",
+                        node.name, now - node.heartbeat_time,
+                    )
+                    self._handle_hung_node(node)
                 except Exception:
                     logger.exception(
                         "heartbeat watchdog failed on %s", node.name)
@@ -485,11 +526,8 @@ class DistributedJobManager:
         each agent's next heartbeat (best effort; used when the job
         ends while workers are still alive, e.g. data exhausted or a
         job-level hang verdict)."""
-        with self._lock:
-            for node in self.get_running_nodes():
-                self._pending_actions[(node.type, node.id)] = (
-                    NodeAction.STOP
-                )
+        for node in self.get_running_nodes():
+            self._pending_actions.put((node.type, node.id), NodeAction.STOP)
 
     def all_running_node_hanged(self) -> bool:
         """Resource-stagnation hang signal (parity:

@@ -8,8 +8,9 @@ RPC from the reference service (elastic_training.proto:243-299).
 
 import json
 import os
+import threading
 import time
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import (
@@ -87,6 +88,31 @@ class MasterServicer:
         self._max_rollbacks = int(
             os.environ.get("DLROVER_TPU_MAX_ROLLBACKS", "3")
         )
+        # --- batched report path (ISSUE 12) -------------------------
+        # delta baseline per reporter: (incarnation, seq) last applied.
+        # A reporter we've never seen (master restart) gets resync=True
+        # so its next report is full — deltas against a baseline the
+        # master lost would silently drop state.
+        self._reporters = {}
+        self._reporters_lock = threading.Lock()
+        # bounded admission: when this many report_node_status handlers
+        # are already in flight, shed the call with retry-after instead
+        # of queueing it into collapse. Kept under the gRPC pool size so
+        # shard/rendezvous RPCs always have threads left.
+        self._report_inflight = 0
+        self._report_inflight_limit = int(
+            os.environ.get("DLROVER_TPU_REPORT_INFLIGHT_LIMIT", "48")
+        )
+        self._report_retry_after = float(
+            os.environ.get("DLROVER_TPU_REPORT_RETRY_AFTER", "0.5")
+        )
+        self._last_shed_log = 0.0
+        # method -> (requests counter child, latency histogram child):
+        # binding the labelled children once keeps the registry walk
+        # off the per-RPC dispatch path
+        self._method_metrics: Dict[
+            str, Tuple[object, object]
+        ] = {}
 
     def _running_nodes(self):
         """Deferred node-list snapshot for the stats collector: only
@@ -106,10 +132,23 @@ class MasterServicer:
                 "RPCs that raised in the servicer", ["method"],
             ).labels(method=method).inc()
             raise ValueError(f"unknown RPC method {method}")
-        counter(
-            "dlrover_rpc_requests_total",
-            "RPCs dispatched by the master servicer", ["method"],
-        ).labels(method=method).inc()
+        bound = self._method_metrics.get(method)
+        if bound is None:
+            bound = (
+                counter(
+                    "dlrover_rpc_requests_total",
+                    "RPCs dispatched by the master servicer",
+                    ["method"],
+                ).labels(method=method),
+                histogram(
+                    "dlrover_rpc_latency_seconds",
+                    "Master-side RPC handling latency", ["method"],
+                    buckets=_RPC_BUCKETS,
+                ).labels(method=method),
+            )
+            self._method_metrics[method] = bound
+        requests_c, latency_h = bound
+        requests_c.inc()
         t0 = time.perf_counter()
         try:
             with tracing.span("rpc." + method):
@@ -121,11 +160,7 @@ class MasterServicer:
             ).labels(method=method).inc()
             raise
         finally:
-            histogram(
-                "dlrover_rpc_latency_seconds",
-                "Master-side RPC handling latency", ["method"],
-                buckets=_RPC_BUCKETS,
-            ).labels(method=method).observe(time.perf_counter() - t0)
+            latency_h.observe(time.perf_counter() - t0)
 
     # ------------------------------------------------------------ sharding
 
@@ -638,6 +673,90 @@ class MasterServicer:
                 host=req.host, final=req.final,
             )
         return comm.Response(success=True)
+
+    def rpc_report_node_status(
+        self, req: comm.NodeStatusReport
+    ) -> comm.NodeStatusAck:
+        """The coalesced fan-in path (ISSUE 12): one rpc per agent per
+        interval carrying heartbeat + whatever changed since the last
+        ack (step, goodput, resource), with the pending action piggy-
+        backed on the ack. Bounded admission: past the in-flight limit
+        the call is shed un-applied with a retry-after — the agent
+        retries the SAME payload, so load degrades latency, not
+        delivery."""
+        with self._reporters_lock:
+            if self._report_inflight >= self._report_inflight_limit:
+                counter(
+                    "dlrover_report_shed_total",
+                    "batched reports shed with retry-after",
+                ).inc()
+                now = time.monotonic()
+                if now - self._last_shed_log > 1.0:
+                    self._last_shed_log = now
+                    record(
+                        "control.load_shed",
+                        inflight=self._report_inflight,
+                        limit=self._report_inflight_limit,
+                        retry_after_s=self._report_retry_after,
+                    )
+                return comm.NodeStatusAck(
+                    accepted=False,
+                    retry_after_s=self._report_retry_after,
+                )
+            self._report_inflight += 1
+        try:
+            return self._apply_node_status(req)
+        finally:
+            with self._reporters_lock:
+                self._report_inflight -= 1
+
+    def _apply_node_status(
+        self, req: comm.NodeStatusReport
+    ) -> comm.NodeStatusAck:
+        key = (req.node_type, req.node_id)
+        resync = False
+        with self._reporters_lock:
+            last = self._reporters.get(key)
+            if not req.full and (
+                last is None or last[0] != req.incarnation
+            ):
+                # unknown reporter (master restarted) or stale baseline
+                # (new incarnation): deltas don't apply — ask for full
+                resync = True
+            self._reporters[key] = (req.incarnation, req.seq)
+        action = ""
+        if self._job_manager:
+            action = self._job_manager.collect_node_heartbeat(
+                req.node_type, req.node_id, req.timestamp
+            ) or ""
+        if req.has_step and self._speed_monitor:
+            self._speed_monitor.collect_global_step(
+                req.step, req.step_ts or req.timestamp,
+                node_id=req.node_id,
+            )
+            if self._job_metric_collector:
+                self._job_metric_collector.collect_runtime_stats(
+                    self._speed_monitor, self._running_nodes,
+                )
+        if req.has_goodput and self._goodput is not None \
+                and req.goodput_phases:
+            self._goodput.observe_report(
+                node_id=req.node_id, pid=req.pid,
+                start_ts=req.goodput_start_ts,
+                elapsed_s=req.goodput_elapsed_s,
+                phases=req.goodput_phases,
+                phase=req.goodput_phase,
+                host=req.host, final=req.final,
+            )
+        if req.has_resource and self._job_manager:
+            self._job_manager.update_node_resource_usage(
+                req.node_type, req.node_id, req.cpu_percent,
+                req.memory_mb, [],
+            )
+        return comm.NodeStatusAck(
+            accepted=True, action=action, resync=resync,
+            acked_seq=req.seq,
+        )
 
     def rpc_report_model_info(self, req: comm.ModelInfo) -> comm.Response:
         if self._job_metric_collector:

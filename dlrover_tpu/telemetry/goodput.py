@@ -434,7 +434,7 @@ class GoodputAggregator:
             entry = self._procs.get(key)
             if entry is None:
                 open_prior = [
-                    e for e in self._procs.values()
+                    (k, e) for k, e in self._procs.items()
                     if e["node_id"] == int(node_id)
                     and not e.get("final_seen")
                 ]
@@ -444,14 +444,17 @@ class GoodputAggregator:
                     # fault window from its last ledgered second to
                     # the successor's birth
                     died = max(e["start_ts"] + e["elapsed_s"]
-                               for e in open_prior)
+                               for _, e in open_prior)
                     self._note_fault_locked(
                         cause="worker_restart", node_id=int(node_id),
                         ts=died,
                         recovered_ts=max(died, float(start_ts)),
                     )
-                    for e in open_prior:
-                        e["final_seen"] = True
+                    for k, e in open_prior:
+                        # copy-on-write: entries are never mutated in
+                        # place, so to_state() can hand out a shallow
+                        # snapshot instead of copying every proc
+                        self._procs[k] = {**e, "final_seen": True}
             self._procs[key] = {
                 "node_id": int(node_id),
                 "pid": int(pid),
@@ -489,9 +492,10 @@ class GoodputAggregator:
         """Close the oldest open fault window of ``cause``."""
         ts = time.time() if ts is None else float(ts)
         with self._lock:
-            for f in self._faults:
+            for i, f in enumerate(self._faults):
                 if f["cause"] == cause and f["recovered_ts"] is None:
-                    f["recovered_ts"] = ts
+                    # copy-on-write, same contract as _procs entries
+                    self._faults[i] = {**f, "recovered_ts": ts}
                     break
 
     # ------------------------------------------------------------ summary
@@ -516,11 +520,16 @@ class GoodputAggregator:
 
     def to_state(self) -> Dict[str, Any]:
         with self._lock:
+            # shallow snapshot only: proc/fault entries are
+            # copy-on-write (never mutated in place), so copying the
+            # containers is enough. Deep-copying 1k+ proc dicts here
+            # was the dominant cost of per-report persistence when the
+            # journal lane runs with persist_interval=0.
             return {
                 "saved_at": time.time(),
                 "job_start": self._job_start,
-                "procs": {k: dict(v) for k, v in self._procs.items()},
-                "faults": [dict(f) for f in self._faults],
+                "procs": dict(self._procs),
+                "faults": list(self._faults),
             }
 
     def restore_state(self, state: Dict[str, Any],

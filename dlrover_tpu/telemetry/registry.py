@@ -71,11 +71,16 @@ def _format_value(v: float) -> str:
 def _labels_key(
     labelnames: Sequence[str], labels: Dict[str, str]
 ) -> Tuple[str, ...]:
-    if set(labels) != set(labelnames):
-        raise ValueError(
-            f"labels {sorted(labels)} != declared {sorted(labelnames)}"
-        )
-    return tuple(str(labels[name]) for name in labelnames)
+    # fast path: direct lookups; the set comparison only runs to build
+    # the error, this is per-sample on every metric touch
+    try:
+        if len(labels) == len(labelnames):
+            return tuple(str(labels[name]) for name in labelnames)
+    except KeyError:
+        pass
+    raise ValueError(
+        f"labels {sorted(labels)} != declared {sorted(labelnames)}"
+    )
 
 
 def _render_labels(
@@ -106,6 +111,12 @@ class _Metric:
 
     def labels(self, **labels):
         key = _labels_key(self.labelnames, labels)
+        # lock-free read: dict get is atomic under the GIL and children
+        # are only ever added, never replaced — the lock guards only
+        # the create race
+        child = self._children.get(key)
+        if child is not None:
+            return child
         with self._lock:
             child = self._children.get(key)
             if child is None:
@@ -296,23 +307,28 @@ class MetricsRegistry:
 
     def _get_or_create(self, cls, name: str, help: str,
                        labelnames: Sequence[str], **kwargs) -> _Metric:
-        with self._lock:
-            existing = self._metrics.get(name)
-            if existing is not None:
-                if not isinstance(existing, cls):
-                    raise ValueError(
-                        f"metric {name} already registered as "
-                        f"{existing.kind}, not {cls.kind}"
-                    )
-                if existing.labelnames != tuple(labelnames):
-                    raise ValueError(
-                        f"metric {name} label mismatch: "
-                        f"{existing.labelnames} vs {tuple(labelnames)}"
-                    )
-                return existing
-            metric = cls(name, help, labelnames, **kwargs)
-            self._metrics[name] = metric
-            return metric
+        # lock-free read first: families are only ever added, and the
+        # declaration checks don't need the lock — this runs on every
+        # counter()/gauge()/histogram() call on the RPC hot path
+        existing = self._metrics.get(name)
+        if existing is None:
+            with self._lock:
+                existing = self._metrics.get(name)
+                if existing is None:
+                    metric = cls(name, help, labelnames, **kwargs)
+                    self._metrics[name] = metric
+                    return metric
+        if not isinstance(existing, cls):
+            raise ValueError(
+                f"metric {name} already registered as "
+                f"{existing.kind}, not {cls.kind}"
+            )
+        if existing.labelnames != tuple(labelnames):
+            raise ValueError(
+                f"metric {name} label mismatch: "
+                f"{existing.labelnames} vs {tuple(labelnames)}"
+            )
+        return existing
 
     def counter(self, name: str, help: str = "",
                 labelnames: Sequence[str] = ()) -> Counter:

@@ -45,6 +45,15 @@ class StateBackend(ABC):
         self.set(key, value)
         return value
 
+    def set_many(self, items: Dict[str, Any]) -> None:
+        """Apply a batch of writes as one commit where the backend can
+        (FileStore uses a redo log so a crash mid-batch restores to
+        either all or none of the batch). The base implementation is a
+        plain loop — fine for MemoryStore, whose process dies with its
+        data anyway."""
+        for key, value in items.items():
+            self.set(key, value)
+
 
 class MemoryStore(StateBackend):
     def __init__(self):
@@ -79,10 +88,18 @@ class FileStore(StateBackend):
     (tmp + rename) so a killed master never leaves a torn value.
     Keys may contain '/' (mapped to subdirectories)."""
 
+    #: redo-log filename for multi-key commits; NOT ``*.json`` so
+    #: ``keys()`` never surfaces it as a store key
+    TXN_FILE = "__txn__.redo"
+
     def __init__(self, root: str):
         self._root = root
         self._lock = threading.Lock()
         os.makedirs(root, exist_ok=True)
+        #: keys replayed from an interrupted set_many commit (crash
+        #: after the commit point, before all per-key files landed);
+        #: callers surface this as a recovery event
+        self.recovered_txn_keys: List[str] = self._recover_txn()
 
     def _path(self, key: str) -> str:
         safe = key.strip("/")
@@ -125,6 +142,70 @@ class FileStore(StateBackend):
                 if key.startswith(prefix):
                     out.append(key)
         return sorted(out)
+
+    def _set_locked(self, key, value):
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(value, f)
+        os.replace(tmp, path)
+
+    def set_many(self, items):
+        """All-or-nothing multi-key commit via a redo log: the batch is
+        first written to one file (tmp + rename = the atomic commit
+        point), then applied per key, then the log is removed. A crash
+        before the rename leaves every key at its pre-batch value; a
+        crash after it is replayed by the next FileStore on this root —
+        so readers never observe a torn mix of old and new keys. This
+        is the group-commit transaction under
+        ``master/state_journal.py``'s write-behind lane."""
+        if not items:
+            return
+        if len(items) == 1:
+            ((key, value),) = items.items()
+            self.set(key, value)
+            return
+        txn_path = os.path.join(self._root, self.TXN_FILE)
+        with self._lock:
+            for key in items:
+                self._path(key)  # validate before the commit point
+            tmp = txn_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"items": [[k, v] for k, v in items.items()]}, f)
+            os.replace(tmp, txn_path)  # <- commit point
+            for key, value in items.items():
+                self._set_locked(key, value)
+            os.remove(txn_path)
+
+    def _recover_txn(self) -> List[str]:
+        """Replay an interrupted set_many: the redo log is only present
+        between the commit point and the cleanup, so its batch is
+        committed by definition — finish applying it."""
+        txn_path = os.path.join(self._root, self.TXN_FILE)
+        try:
+            with open(txn_path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            # absent (normal) or torn tmp-less partial — a torn redo
+            # log is impossible via the rename, but a foreign file
+            # shouldn't wedge the store either
+            try:
+                os.remove(txn_path)
+            except OSError:
+                pass
+            return []
+        keys = []
+        with self._lock:
+            for key, value in doc.get("items", []):
+                self._set_locked(key, value)
+                keys.append(key)
+            os.remove(txn_path)
+        logger.warning(
+            "FileStore %s: replayed interrupted commit of %d key(s)",
+            self._root, len(keys),
+        )
+        return keys
 
     def mutate(self, key, fn, default=None):
         """Cross-PROCESS atomic read-modify-write via an fcntl lock on

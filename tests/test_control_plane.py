@@ -1,0 +1,427 @@
+"""Control-plane fan-in drills (ISSUE 12).
+
+Four layers of the batched-report path, tested where each contract
+actually lives:
+
+* FileStore ``set_many`` crash consistency — a kill inside the flush
+  window restores to pre- or post-batch state, never a torn mix;
+* the journal lane over it — write-behind staging, redo-log recovery
+  surfacing ``control.journal_recovered``, and the shard ledger's
+  commit-before-reply writes staying synchronous;
+* DeltaTracker / servicer delta semantics — sections ride only when
+  changed since the last *acked* report, sheds never advance the
+  baseline, resync on unknown reporter or new incarnation;
+* the swarm bench's smoke tier end to end (real gRPC master), gating
+  zero dropped heartbeats under load shed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.agent.status_reporter import (
+    CPU_MIN_DELTA_PCT,
+    DeltaTracker,
+    MEM_MIN_DELTA_MB,
+)
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.state_journal import (
+    MasterStateJournal,
+    build_master_state_journal,
+)
+from dlrover_tpu.telemetry.journal import (
+    EventJournal,
+    default_journal,
+    set_default_journal,
+)
+from dlrover_tpu.util.state_store import FileStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_event_journal():
+    set_default_journal(EventJournal())
+    yield
+    set_default_journal(EventJournal())
+
+
+# --------------------------------------------------------- store crashes
+
+
+def _batch():
+    return {
+        "j/kv": {"a": "1", "b": "2"},
+        "j/rdzv/worker": {"round": 7},
+        "j/speed": {"step": 1200, "batch_feed": False},
+    }
+
+
+def test_crash_before_commit_point_restores_pre_batch(tmp_path,
+                                                      monkeypatch):
+    """A kill before the redo-log rename leaves every key at its
+    pre-batch value — the batch simply never happened."""
+    root = str(tmp_path / "store")
+    store = FileStore(root)
+    store.set("j/speed", {"step": 1000, "batch_feed": False})
+
+    real_replace = os.replace
+
+    def dying_replace(src, dst):
+        if dst.endswith(".redo"):
+            raise OSError("simulated kill before commit point")
+        return real_replace(src, dst)
+
+    import dlrover_tpu.util.state_store as state_store_mod
+    monkeypatch.setattr(state_store_mod.os, "replace", dying_replace)
+    with pytest.raises(OSError):
+        store.set_many(_batch())
+    monkeypatch.undo()
+
+    survivor = FileStore(root)
+    assert survivor.recovered_txn_keys == []
+    assert survivor.get("j/speed") == {"step": 1000, "batch_feed": False}
+    assert survivor.get("j/kv") is None
+    assert survivor.get("j/rdzv/worker") is None
+
+
+def test_crash_after_commit_point_replays_to_post_batch(tmp_path,
+                                                        monkeypatch):
+    """A kill after the rename but mid-apply is replayed by the next
+    FileStore on the root: every key ends at its post-batch value —
+    never a mix."""
+    root = str(tmp_path / "store")
+    store = FileStore(root)
+    store.set("j/speed", {"step": 1000, "batch_feed": False})
+
+    applied = []
+    real_set_locked = FileStore._set_locked
+
+    def dying_set_locked(self, key, value):
+        if applied:
+            # first key landed; die before the rest of the batch
+            raise SystemExit("simulated kill mid-apply")
+        applied.append(key)
+        return real_set_locked(self, key, value)
+
+    monkeypatch.setattr(FileStore, "_set_locked", dying_set_locked)
+    with pytest.raises(SystemExit):
+        store.set_many(_batch())
+    monkeypatch.undo()
+    assert len(applied) == 1  # genuinely torn on disk at "crash" time
+
+    survivor = FileStore(root)
+    assert sorted(survivor.recovered_txn_keys) == sorted(_batch())
+    for key, value in _batch().items():
+        assert survivor.get(key) == value, key
+
+
+def test_journal_recovery_surfaces_control_event(tmp_path):
+    """build_master_state_journal over a root holding an interrupted
+    commit replays it and records control.journal_recovered."""
+    root = str(tmp_path / "state")
+    os.makedirs(root)
+    with open(os.path.join(root, FileStore.TXN_FILE), "w") as f:
+        json.dump({"items": [[k, v] for k, v in _batch().items()]}, f)
+
+    journal = build_master_state_journal("drill", state_dir=root)
+    try:
+        events = default_journal().events("control.journal_recovered")
+        assert len(events) == 1
+        assert events[0]["data"]["keys"] == len(_batch())
+        assert journal._store.get("j/speed") == {
+            "step": 1200, "batch_feed": False,
+        }
+    finally:
+        journal.close()
+
+
+# --------------------------------------------------------- journal lane
+
+
+def test_unflushed_window_is_lost_whole_never_torn(tmp_path):
+    """Staged-but-unflushed mutations are the documented crash-window
+    loss: a successor reading the DISK sees the pre-batch state for
+    every key (the batch vanishes atomically, it never half-lands)."""
+    root = str(tmp_path / "state")
+    store = FileStore(root)
+    journal = MasterStateJournal(store, "drill", commit_window=3600.0)
+    journal.save_global_step(500)
+    journal.flush()
+
+    journal.save_global_step(900)
+    journal.save_rdzv_round("worker", 3)
+    journal.save_kv({"token": b"xyz"})
+    # crash: the journal object is abandoned without flush/close
+    survivor = MasterStateJournal(FileStore(root), "drill")
+    assert survivor.load_global_step() == (500, False)
+    assert survivor.load_rdzv_rounds() == {}
+    assert survivor.load_kv() == {}
+
+    # graceful path: flush commits the whole window as one transaction
+    journal.flush()
+    survivor = MasterStateJournal(FileStore(root), "drill")
+    assert survivor.load_global_step() == (900, False)
+    assert survivor.load_rdzv_rounds() == {"worker": 3}
+    assert survivor.load_kv() == {"token": b"xyz"}
+    journal.close()
+
+
+def test_shard_ledger_writes_through_the_lane(tmp_path):
+    """Dataset checkpoints keep the commit-before-reply contract: even
+    with a huge commit window they hit disk synchronously, because the
+    exactly-once argument for shard redelivery depends on it."""
+    root = str(tmp_path / "state")
+    journal = MasterStateJournal(FileStore(root), "drill",
+                                 commit_window=3600.0)
+    journal.save_dataset_params("train", {"dataset_name": "train",
+                                          "dataset_size": 100})
+    journal.save_dataset_checkpoint("train", json.dumps({"done": [1]}))
+    # a DIFFERENT store instance only sees what reached the disk
+    params, ckpt = MasterStateJournal(
+        FileStore(root), "drill"
+    ).load_dataset("train")
+    assert params == {"dataset_name": "train", "dataset_size": 100}
+    assert json.loads(ckpt) == {"done": [1]}
+    journal.close()
+
+
+def test_durable_put_jumps_the_window(tmp_path):
+    root = str(tmp_path / "state")
+    journal = MasterStateJournal(FileStore(root), "drill",
+                                 commit_window=3600.0)
+    journal.save_rdzv_round("worker", 9, durable=True)
+    assert MasterStateJournal(
+        FileStore(root), "drill"
+    ).load_rdzv_rounds() == {"worker": 9}
+    journal.close()
+
+
+# --------------------------------------------------------- delta tracker
+
+
+GP = {
+    "goodput_phases": {"init": 45.0, "training": 120.0},
+    "goodput_elapsed_s": 170.0,
+    "goodput_start_ts": 1000.0,
+    "goodput_phase": "training",
+}
+
+
+def _compose(tracker, **kw):
+    kw.setdefault("step", 100)
+    kw.setdefault("pid", 4242)
+    kw.setdefault("goodput_fields", dict(GP))
+    kw.setdefault("resource", (50.0, 4096))
+    kw.setdefault("host", "host-a")
+    return tracker.compose(time.time(), **kw)
+
+
+def test_first_report_is_full_then_deltas_shrink():
+    tracker = DeltaTracker(incarnation=1)
+    first = _compose(tracker)
+    assert first.full and first.has_step and first.has_goodput
+    assert first.has_resource and first.host == "host-a"
+    tracker.commit(first)
+
+    unchanged = _compose(tracker)
+    assert not unchanged.full
+    assert not unchanged.has_step        # step did not advance
+    assert not unchanged.has_goodput     # phases within min delta
+    assert not unchanged.has_resource    # cpu/mem within thresholds
+    assert unchanged.host == ""          # host rides only with goodput
+    assert unchanged.seq == first.seq + 1
+
+
+def test_sections_reappear_exactly_when_changed():
+    tracker = DeltaTracker(incarnation=1)
+    tracker.commit(_compose(tracker))
+
+    stepped = _compose(tracker, step=101)
+    assert stepped.has_step and stepped.step == 101
+    assert not stepped.has_goodput and not stepped.has_resource
+
+    gp = dict(GP)
+    gp["goodput_phases"] = {"init": 45.0, "training": 125.0}
+    moved = _compose(tracker, goodput_fields=gp)
+    assert moved.has_goodput and moved.host == "host-a"
+
+    hot = _compose(tracker,
+                   resource=(50.0 + CPU_MIN_DELTA_PCT, 4096))
+    assert hot.has_resource
+    fat = _compose(tracker,
+                   resource=(50.0, 4096 + MEM_MIN_DELTA_MB))
+    assert fat.has_resource
+
+
+def test_shed_report_never_advances_the_baseline():
+    """A composed-but-unacked report (load shed) keeps the baseline:
+    the delta is carried again until an ack commits it."""
+    tracker = DeltaTracker(incarnation=1)
+    tracker.commit(_compose(tracker))
+    shed = _compose(tracker, step=105)
+    assert shed.has_step
+    # no commit — the master never applied it
+    retry = _compose(tracker, step=105)
+    assert retry.has_step and retry.step == 105
+    tracker.commit(retry)
+    assert not _compose(tracker, step=105).has_step
+
+
+def test_max_skip_bounds_section_staleness():
+    tracker = DeltaTracker(incarnation=1, max_skip=3)
+    tracker.commit(_compose(tracker))
+    reports = [_compose(tracker) for _ in range(3)]
+    assert not any(r.has_goodput for r in reports[:-1])
+    assert reports[-1].has_goodput  # forced refresh on the Nth skip
+    assert not any(r.has_resource for r in reports[:-1])
+    assert reports[-1].has_resource
+
+
+def test_request_full_resends_everything():
+    tracker = DeltaTracker(incarnation=1)
+    tracker.commit(_compose(tracker))
+    tracker.request_full()
+    full = _compose(tracker)
+    assert full.full and full.has_step and full.has_goodput
+    assert full.has_resource and full.host == "host-a"
+
+
+# ------------------------------------------------------- sparse encoding
+
+
+def test_sparse_wire_encoding_round_trips_and_shrinks():
+    """Default-valued fields are omitted on the wire and restored by
+    the decoder from the dataclass defaults — a delta report must not
+    pay for the sections it is not carrying."""
+    tracker = DeltaTracker(incarnation=1)
+    tracker.commit(_compose(tracker))
+    delta = _compose(tracker)
+    delta.node_id, delta.node_type = 7, "worker"
+    wire = comm.serialize(delta)
+    clone = comm.deserialize(wire)
+    assert clone == delta
+    full = _compose(DeltaTracker(incarnation=1))
+    full.node_id, full.node_type = 7, "worker"
+    assert len(wire) < len(comm.serialize(full)) / 2
+
+
+def test_sparse_encoding_is_type_strict():
+    """True == 1 and 0 == 0.0 in Python; the encoder must not treat a
+    value of a different type as "still the default" or decode would
+    silently re-type the field."""
+    hb = comm.HeartBeat(node_id=0, node_type="worker", timestamp=1.0)
+    assert comm.deserialize(comm.serialize(hb)).node_id == 0
+    rep = comm.NodeStatusReport(timestamp=1.0, step=0)
+    clone = comm.deserialize(comm.serialize(rep))
+    assert clone.step == 0 and type(clone.step) is int
+
+
+# ------------------------------------------------- servicer delta logic
+
+
+def _servicer(agents=4):
+    speed = SpeedMonitor()
+    jm = DistributedJobManager(speed_monitor=speed,
+                               heartbeat_timeout=3600.0)
+    jm._node_managers[NodeType.WORKER].update_nodes({
+        i: Node(NodeType.WORKER, i, status=NodeStatus.RUNNING)
+        for i in range(agents)
+    })
+    return MasterServicer(job_manager=jm, speed_monitor=speed), jm
+
+
+def _report(tracker, node_id, **kw):
+    rep = _compose(tracker, **kw)
+    rep.node_id, rep.node_type = node_id, NodeType.WORKER
+    return rep
+
+
+def test_delta_report_lands_heartbeat_step_and_resource():
+    sv, jm = _servicer()
+    tracker = DeltaTracker(incarnation=0)
+    ack = sv.handle("report_node_status", _report(tracker, 1, step=77))
+    assert ack.accepted and ack.acked_seq == 1
+    assert not ack.resync  # full=True needs no resync
+    node = jm._node_managers[NodeType.WORKER].nodes[1]
+    assert node.heartbeat_time > 0
+    assert sv._speed_monitor._global_step == 77
+
+
+def test_unknown_reporter_and_new_incarnation_force_resync():
+    sv, _ = _servicer()
+    tracker = DeltaTracker(incarnation=0)
+    tracker.commit(_compose(tracker))  # baseline the master never saw
+    delta = _report(tracker, 2, step=101)
+    assert not delta.full
+    ack = sv.handle("report_node_status", delta)
+    assert ack.accepted and ack.resync
+
+    # the master now knows incarnation 0; a NON-full report claiming
+    # incarnation 1 (agent restarted) must resync too
+    reborn = DeltaTracker(incarnation=1)
+    reborn.commit(_compose(reborn))
+    ack = sv.handle("report_node_status", _report(reborn, 2, step=102))
+    assert ack.accepted and ack.resync
+    # ...and once a full report lands, deltas flow without resync
+    reborn.request_full()
+    ack = sv.handle("report_node_status", _report(reborn, 2, step=103))
+    assert ack.accepted and not ack.resync
+    ack = sv.handle("report_node_status", _report(reborn, 2, step=104))
+    assert ack.accepted and not ack.resync
+
+
+def test_load_shed_backpressure_then_retry_lands():
+    """Over the admission limit the servicer sheds with retry_after_s
+    instead of queueing into collapse; the SAME report retried after
+    the backoff is applied exactly once."""
+    sv, _ = _servicer()
+    tracker = DeltaTracker(incarnation=0)
+    rep = _report(tracker, 3, step=55)
+
+    sv._report_inflight_limit = 0  # everything sheds
+    shed_ack = sv.handle("report_node_status", rep)
+    assert not shed_ack.accepted
+    assert shed_ack.retry_after_s > 0
+    assert (NodeType.WORKER, 3) not in sv._reporters  # nothing applied
+    assert default_journal().events("control.load_shed")
+
+    sv._report_inflight_limit = 48
+    ack = sv.handle("report_node_status", rep)  # same payload, retried
+    assert ack.accepted and ack.acked_seq == rep.seq
+    assert sv._reporters[(NodeType.WORKER, 3)] == (0, rep.seq)
+
+
+# ------------------------------------------------------- swarm smoke
+
+
+def test_swarm_bench_smoke():
+    """The swarm bench's tier-1 smoke tier end to end: a real gRPC
+    master per phase, batched beats unary, the journal coalesces, the
+    shed phase actually sheds, and NO agent's last-acked seq diverges
+    from the master's ledger — zero dropped heartbeats."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DLROVER_TPU_METRICS_PORT="off")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "master_swarm.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["ok"] is True
+    assert result["vs_baseline"] >= 2.0
+    assert result["journal_coalesce_ratio"] >= 5.0
+    assert result["shed_phase_sheds"] > 0
+    assert result["dropped"] == 0
+    assert result["shed_phase_dropped"] == 0

@@ -256,6 +256,10 @@ def test_aggregator_state_roundtrip_counts_master_downtime(tmp_path):
         "gp-test", state_dir=str(tmp_path)
     )
     journal.save_goodput(agg.to_state())
+    # graceful handoff: the group-commit lane flushes on close, so the
+    # successor journal reads committed state (crash-window loss is
+    # covered by the drills in test_control_plane.py)
+    journal.close()
     loaded = build_master_state_journal(
         "gp-test", state_dir=str(tmp_path)
     ).load_goodput()

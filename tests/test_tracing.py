@@ -772,6 +772,53 @@ def test_serve_event_names_are_the_canonical_set():
     )
 
 
+#: the full vocabulary of the control-plane fan-in path (ISSUE 12):
+#: master-side backpressure + journal-lane recovery (control.*) and
+#: the agent-side coalesced reporter (report.*). The swarm bench, the
+#: control-plane drills and docs/SCALING.md / docs/TELEMETRY.md all
+#: match these names literally — an addition or rename must land
+#: everywhere in the same PR
+_CONTROL_EVENTS = {
+    "control.load_shed",
+    "control.journal_recovered",
+}
+
+_REPORT_EVENTS = {
+    "report.resync",
+    "report.retry_after",
+    "report.rpc_fallback",
+}
+
+
+def test_control_event_names_are_the_canonical_set():
+    """The control.* journal vocabulary is closed: every record() of a
+    control event uses exactly one of the documented names, and every
+    documented name has a live emitter."""
+    found = {
+        value
+        for _, _, value, kind in _record_call_literals()
+        if kind == "literal" and value.startswith("control.")
+    }
+    assert found == _CONTROL_EVENTS, (
+        f"unexpected: {sorted(found - _CONTROL_EVENTS)}, "
+        f"missing emitters for: {sorted(_CONTROL_EVENTS - found)}"
+    )
+
+
+def test_report_event_names_are_the_canonical_set():
+    """The report.* journal vocabulary is closed: same contract as the
+    control.* set, for the agent side of the fan-in path."""
+    found = {
+        value
+        for _, _, value, kind in _record_call_literals()
+        if kind == "literal" and value.startswith("report.")
+    }
+    assert found == _REPORT_EVENTS, (
+        f"unexpected: {sorted(found - _REPORT_EVENTS)}, "
+        f"missing emitters for: {sorted(_REPORT_EVENTS - found)}"
+    )
+
+
 #: span names allow a single undotted segment ("data", "dispatch" —
 #: the bench's train-thread phases predate the dotted convention);
 #: anything dotted must be fully snake-case dotted like event names
