@@ -514,6 +514,9 @@ def main():
             )
         result["ckpt_saves"] = len(ckpt_stalls)
         result["ckpt_interval"] = args.ckpt_interval
+        # archives written by this run are sharded format v2
+        # (topology-elastic manifest; docs/CHECKPOINT.md "Format v2")
+        result["ckpt_format"] = 2
     print(json.dumps(result))
 
 

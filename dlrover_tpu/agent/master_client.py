@@ -431,6 +431,12 @@ class MasterClient:
         ).value
 
     @supervised_rpc
+    def kv_store_keys(self, prefix: str = ""):
+        return self._call(
+            "kv_store_keys", comm.KVStoreKeysRequest(prefix=prefix)
+        ).keys
+
+    @supervised_rpc
     def kv_store_add(self, key: str, amount: int) -> int:
         return self._call(
             "kv_store_add", comm.KVStoreAddRequest(key=key, amount=amount)
@@ -848,6 +854,12 @@ class LocalMasterClient:
 
     def kv_store_get(self, key):
         return self._kv.get(key, b"")
+
+    def kv_store_keys(self, prefix=""):
+        return sorted(k for k in self._kv if k.startswith(prefix))
+
+    def kv_store_delete(self, key):
+        self._kv.pop(key, None)
 
     def report_global_step(self, step, timestamp=None):
         pass

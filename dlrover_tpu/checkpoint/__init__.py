@@ -1,0 +1,27 @@
+"""Sharded checkpoint plane (format v2): topology-elastic manifests,
+replica-deduplicated saves, and peer-served restore.
+
+See docs/CHECKPOINT.md ("Format v2"). Submodules:
+
+  manifest  pure-stdlib schema: domain normalization, shard keys,
+            owner election, step-manifest merge
+  saver     owned-only subset archives for the persist tier
+  loader    layout-free restore planning over local/peer/store tiers
+  peer      /ckpt/shard endpoint logic + master-KV peer registry
+
+``manifest`` is imported eagerly (the archive codec depends on it and
+it must stay stdlib-only); the jax-touching modules load on first
+attribute access so importing the package stays cheap.
+"""
+
+from dlrover_tpu.checkpoint import manifest  # noqa: F401
+
+__all__ = ["manifest", "saver", "loader", "peer"]
+
+
+def __getattr__(name):
+    if name in ("saver", "loader", "peer"):
+        import importlib
+
+        return importlib.import_module(f"dlrover_tpu.checkpoint.{name}")
+    raise AttributeError(name)

@@ -375,8 +375,18 @@ class KVStoreAddRequest(BaseMessage):
 
 
 @dataclass
+class KVStoreKeysRequest(BaseMessage):
+    prefix: str = ""
+
+
+@dataclass
 class KVStoreValue(BaseMessage):
     value: bytes = b""
+
+
+@dataclass
+class KVStoreKeys(BaseMessage):
+    keys: List[str] = field(default_factory=list)
 
 
 @dataclass

@@ -48,6 +48,14 @@ class KVStoreService:
         with self._lock:
             return self._store.get(key, b"")
 
+    def keys(self, prefix: str = "") -> list:
+        """Sorted keys under a prefix — the checkpoint peer registry
+        scans ``ckpt/peer/`` to learn who advertises which step."""
+        with self._lock:
+            return sorted(
+                k for k in self._store if k.startswith(prefix)
+            )
+
     def add(self, key: str, amount: int) -> int:
         """Atomic integer add (torch-Store-style counter semantics)."""
         with self._lock:

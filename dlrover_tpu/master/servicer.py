@@ -369,6 +369,9 @@ class MasterServicer:
     def rpc_kv_store_get(self, req: comm.KVStoreGetRequest) -> comm.KVStoreValue:
         return comm.KVStoreValue(value=self._kv_store.get(req.key))
 
+    def rpc_kv_store_keys(self, req: comm.KVStoreKeysRequest) -> comm.KVStoreKeys:
+        return comm.KVStoreKeys(keys=self._kv_store.keys(req.prefix))
+
     def rpc_kv_store_add(self, req: comm.KVStoreAddRequest) -> comm.KVStoreAddResult:
         return comm.KVStoreAddResult(
             value=self._kv_store.add(req.key, req.amount)

@@ -59,6 +59,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from dlrover_tpu.checkpoint import manifest as ckpt_manifest
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.telemetry import counter, gauge, histogram, record, tracing
 from dlrover_tpu.trainer import ckpt_store
@@ -112,7 +113,27 @@ def _is_snap_leaf(x) -> bool:
     return isinstance(x, dict) and x.get("__jax_shards__") is True
 
 
-def _stage_local_shards(pytree, sync: bool = False):
+def _global_domain_map(x, proc_of_device) -> List[Dict[str, Any]]:
+    """The logical array's GLOBAL domain map: every distinct index
+    domain the sharding produces, with its replica process set.
+    ``devices_indices_map`` is a global view every process holds, so
+    each host computes the identical map with no collective — the
+    foundation of format-v2 owner election (docs/CHECKPOINT.md)."""
+    groups: Dict[str, Dict[str, Any]] = {}
+    for dev, idx in x.sharding.devices_indices_map(
+        tuple(x.shape)
+    ).items():
+        nidx = ckpt_manifest.normalize_index(idx, x.shape)
+        key = ckpt_manifest.index_key(nidx)
+        g = groups.setdefault(key, {"idx": nidx, "replicas": set()})
+        g["replicas"].add(int(proc_of_device(dev)))
+    return [
+        {"idx": g["idx"], "replicas": sorted(g["replicas"])}
+        for g in groups.values()
+    ]
+
+
+def _stage_local_shards(pytree, sync: bool = False, topology=None):
     """Start the device->host snapshot of a pytree's *addressable*
     shards and return a staged pytree (shard-snap dicts whose shard
     data are device handles, or host arrays when ``sync=True``).
@@ -124,12 +145,26 @@ def _stage_local_shards(pytree, sync: bool = False):
     blocks for each shard's transfer here (the Orbax-async model: the
     D2H is the only train-thread cost; use it when donated buffers
     can't be guaranteed to outlive staging — see docs/CHECKPOINT.md).
+
+    ``topology`` (``{"process_index", "n_processes",
+    "proc_of_device"}``) turns on format-v2 staging: each snap dict
+    additionally carries the global ``domains`` map (replica sets for
+    owner election). A non-None ``proc_of_device`` also FILTERS the
+    staged shards to the virtual process's own devices — how the
+    drill suite runs a multi-host topology inside one real process.
     """
+    proc_of = None
+    me = None
+    if topology is not None:
+        proc_of = topology.get("proc_of_device")
+        me = int(topology["process_index"])
 
     def snap(x):
         if isinstance(x, jax.Array):
             shards = []
             for s in x.addressable_shards:
+                if proc_of is not None and int(proc_of(s.device)) != me:
+                    continue
                 d = s.data
                 if sync:
                     d = _owned_host_array(d)
@@ -139,12 +174,20 @@ def _stage_local_shards(pytree, sync: bool = False):
                     except (AttributeError, RuntimeError):
                         pass  # backend without async D2H: asarray later
                 shards.append((s.index, d))
-            return {
+            out = {
                 "__jax_shards__": True,
                 "shape": tuple(x.shape),
                 "dtype": str(x.dtype),
                 "shards": shards,
             }
+            if topology is not None:
+                out["domains"] = _global_domain_map(
+                    x,
+                    proc_of or (
+                        lambda dev: getattr(dev, "process_index", 0)
+                    ),
+                )
+            return out
         return x
 
     return jax.tree.map(snap, pytree)
@@ -486,6 +529,10 @@ class FlashCheckpointer:
         commit_timeout: float = 300.0,
         queue_depth: Optional[int] = None,
         stage: Optional[str] = None,
+        process_index: Optional[int] = None,
+        n_processes: Optional[int] = None,
+        proc_of_device: Optional[Callable[[Any], int]] = None,
+        peer_registry=None,
     ):
         self.persist_dir = (
             persist_dir if ckpt_store.is_url(persist_dir)
@@ -498,8 +545,22 @@ class FlashCheckpointer:
         self.max_ram_keep = max_ram_keep
         self.max_persist_keep = max_persist_keep
         self.commit_timeout = commit_timeout
-        self._process_index = jax.process_index()
-        self._n_processes = jax.process_count()
+        # overridable for virtual-host drills (several logical
+        # processes sharing one real jax process) and spare-host tools
+        self._process_index = (
+            jax.process_index() if process_index is None
+            else int(process_index)
+        )
+        self._n_processes = (
+            jax.process_count() if n_processes is None
+            else int(n_processes)
+        )
+        #: device -> owning (possibly virtual) process index; None
+        #: means the real topology (device.process_index)
+        self._proc_of_device = proc_of_device
+        #: checkpoint.peer.PeerRegistry advertising this host's
+        #: RAM-tier steps and resolving peers at restore (optional)
+        self._peer_registry = peer_registry
         # the save-attempt id scoping the COMMIT barrier (see
         # ckpt_store.write_step): the rendezvous round is globally
         # consistent across hosts of one world incarnation. Outside the
@@ -557,6 +618,37 @@ class FlashCheckpointer:
         if self._manager is None:
             self._store = ckpt_store.get_store(self.persist_dir)
 
+    def _stage_topology(self) -> Optional[Dict[str, Any]]:
+        """Staging-time topology for format-v2 domain maps: engaged on
+        any multi-process world or when a virtual-host device mapping
+        is installed; single-process saves skip the bookkeeping (their
+        archives are complete and self-contained either way)."""
+        if self._n_processes <= 1 and self._proc_of_device is None:
+            return None
+        return {
+            "process_index": self._process_index,
+            "n_processes": self._n_processes,
+            "proc_of_device": self._proc_of_device,
+        }
+
+    def _save_topology(self) -> Dict[str, int]:
+        return {
+            "n_processes": self._n_processes,
+            "process_index": self._process_index,
+        }
+
+    def shard_provider(self) -> Callable[[int], Optional[str]]:
+        """The ``/ckpt/shard`` backing for this host: step -> RAM-tier
+        archive path when held. Wire it with
+        ``telemetry.http.set_shard_provider(ckpt.shard_provider())``
+        (or the MetricsServer ``shard_provider`` arg)."""
+
+        def provide(step: int) -> Optional[str]:
+            path = self._ram_path(int(step))
+            return path if os.path.exists(path) else None
+
+        return provide
+
     def set_clean_fn(self, fn: Optional[Callable[[], bool]]) -> None:
         """Install the sentinel's clean-verdict callback. Called on the
         train thread at save() time; its answer tags the archive
@@ -585,7 +677,10 @@ class FlashCheckpointer:
         the zero-stall budget it alerts on."""
         t0 = time.perf_counter()
         ts_wall = time.time()
-        staged = _stage_local_shards(state, sync=self._stage_sync)
+        staged = _stage_local_shards(
+            state, sync=self._stage_sync,
+            topology=self._stage_topology(),
+        )
         # verdict captured on the train thread, at save() time: the
         # background lanes must tag the archive with what the sentinel
         # knew when the state was snapshotted, not when it lands
@@ -713,6 +808,10 @@ class FlashCheckpointer:
             _observe_ckpt(
                 "save", "ram", job.step, dt, bytes=nbytes,
             )
+            if self._peer_registry is not None:
+                # the RAM archive is now servable over /ckpt/shard:
+                # tell the master KV so restoring peers can find it
+                self._peer_registry.advertise(job.step)
             self._gc_ram()
         except Exception as e:
             ram_ok = False
@@ -740,9 +839,14 @@ class FlashCheckpointer:
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             nbytes = ckpt_store.snapshot_to_file(
-                snapshot, step, f, last_good=last_good
+                snapshot, step, f, last_good=last_good,
+                topology=self._save_topology(),
             )
         os.replace(tmp, path)
+        counter(
+            "dlrover_ckpt_shard_bytes_total",
+            "Checkpoint shard bytes moved, by tier", ["tier"],
+        ).labels(tier="ram").inc(max(0, nbytes))
         return nbytes
 
     def _pin(self, path: str) -> None:
@@ -767,7 +871,10 @@ class FlashCheckpointer:
             try:
                 os.remove(path)
             except OSError:
-                pass
+                continue
+            if self._peer_registry is not None:
+                # stop advertising what we no longer hold
+                self._peer_registry.withdraw(step)
 
     def _list_ram(self):
         # let queued saves land first so listings (and the gc/consensus
@@ -824,6 +931,44 @@ class FlashCheckpointer:
             )
         self._persistq.submit(job)
 
+    def _put_owned_subset(self, step: int, src) -> None:
+        """Persist-tier upload for a format-v2 multi-process save: the
+        OWNED subset of the full archive (dedup — replicated shards go
+        up exactly once, from their elected owner) plus this host's
+        index piece (the subset manifest) for rank 0's merge."""
+        import json
+
+        from dlrover_tpu.checkpoint import saver as ckpt_saver
+
+        if isinstance(src, str):
+            with open(src, "rb") as f:
+                sub_bytes, sub_man, stats = ckpt_saver.subset_archive(
+                    f, self._process_index
+                )
+        else:
+            sub_bytes, sub_man, stats = ckpt_saver.subset_archive(
+                src, self._process_index
+            )
+        ckpt_store.put_shard_stream(
+            self._store, step, self._process_index,
+            io.BytesIO(sub_bytes), attempt=self._attempt,
+            size=len(sub_bytes),
+        )
+        self._store.put(
+            ckpt_store.index_key(
+                step, self._process_index, self._attempt
+            ),
+            json.dumps(sub_man, separators=(",", ":")).encode("utf-8"),
+        )
+        counter(
+            "dlrover_ckpt_shard_bytes_total",
+            "Checkpoint shard bytes moved, by tier", ["tier"],
+        ).labels(tier="persistent").inc(len(sub_bytes))
+        record(
+            "ckpt.dedup", step=step,
+            process_index=self._process_index, **stats,
+        )
+
     def _skip_persist(self, job: _PersistJob, reason: str) -> None:
         job.abandon()
         counter(
@@ -869,26 +1014,36 @@ class FlashCheckpointer:
                 )
                 return
             extra = {}
+            sharded = self._n_processes > 1
             if kind == "store":
                 try:
-                    with open(payload, "rb") as f:
-                        size = os.fstat(f.fileno()).st_size
-                        ckpt_store.put_shard_stream(
-                            self._store, step, self._process_index, f,
-                            attempt=self._attempt, size=size,
-                        )
+                    if sharded:
+                        self._put_owned_subset(step, payload)
+                    else:
+                        with open(payload, "rb") as f:
+                            size = os.fstat(f.fileno()).st_size
+                            ckpt_store.put_shard_stream(
+                                self._store, step,
+                                self._process_index, f,
+                                attempt=self._attempt, size=size,
+                            )
                 finally:
                     job.abandon()  # upload done/failed: unpin RAM file
             else:  # "snapshot": RAM tier failed — archive from memory
                 buf = io.BytesIO()
                 size = ckpt_store.snapshot_to_file(
-                    payload, step, buf, last_good=job.last_good
+                    payload, step, buf, last_good=job.last_good,
+                    topology=self._save_topology(),
                 )
-                buf.seek(0)
-                ckpt_store.put_shard_stream(
-                    self._store, step, self._process_index, buf,
-                    attempt=self._attempt, size=size,
-                )
+                if sharded:
+                    buf.seek(0)
+                    self._put_owned_subset(step, buf)
+                else:
+                    buf.seek(0)
+                    ckpt_store.put_shard_stream(
+                        self._store, step, self._process_index, buf,
+                        attempt=self._attempt, size=size,
+                    )
                 extra = {"source": "memory"}
             if self._process_index != 0:
                 # only rank 0 knows whether the step COMMITs;
@@ -899,12 +1054,26 @@ class FlashCheckpointer:
                     "(awaiting rank-0 commit)", step,
                 )
                 return
-            committed = ckpt_store.commit_step(
-                self._store, step, self._n_processes,
-                attempt=self._attempt,
-                timeout=self.commit_timeout,
-                last_good=job.last_good,
-            )
+            if sharded:
+                committed = ckpt_store.commit_step_sharded(
+                    self._store, step, self._n_processes,
+                    attempt=self._attempt,
+                    timeout=self.commit_timeout,
+                    last_good=job.last_good,
+                )
+                if committed:
+                    record(
+                        "ckpt.manifest_committed", step=step,
+                        n_processes=self._n_processes,
+                        attempt=self._attempt,
+                    )
+            else:
+                committed = ckpt_store.commit_step(
+                    self._store, step, self._n_processes,
+                    attempt=self._attempt,
+                    timeout=self.commit_timeout,
+                    last_good=job.last_good,
+                )
             if committed:
                 ckpt_store.gc_steps(self._store, self.max_persist_keep)
                 logger.info("Persistent save step %d done", step)
@@ -991,6 +1160,9 @@ class FlashCheckpointer:
             arr = np.full((k,), -1, dtype=np.int64)
             arr[: len(mine)] = mine
             gathered = multihost_utils.process_allgather(arr)
+            # a single-controller world gathers to the same 1-D shape
+            # (no leading process axis) — normalize before iterating
+            gathered = np.asarray(gathered).reshape(-1, k)
             sets = [
                 {int(s) for s in row if s >= 0} for row in gathered
             ]
@@ -1083,6 +1255,14 @@ class FlashCheckpointer:
                 )
             except Exception as e:
                 logger.warning("persist-tier listing failed: %s", e)
+        if self._peer_registry is not None:
+            # steps survivors still hold in RAM are candidates too:
+            # the v2 loader can assemble them over /ckpt/shard even
+            # when this host lost its tmpfs and the store is down
+            try:
+                steps |= set(self._peer_registry.advertised_steps())
+            except Exception as e:
+                logger.warning("peer step listing failed: %s", e)
         return steps
 
     def _restore_once(self, target: Any = None,
@@ -1097,9 +1277,15 @@ class FlashCheckpointer:
         # down), so explicit-step restores skip the scan entirely
         avail: Optional[list] = None
         if self._manager is None and auto_step:
-            avail = ckpt_store.available_steps(
-                self._store, self._process_index
-            )
+            # an unreachable store must not kill the whole attempt:
+            # the RAM and peer tiers can still restore the step
+            try:
+                avail = ckpt_store.available_steps(
+                    self._store, self._process_index
+                )
+            except Exception as e:
+                logger.warning("persist-tier listing failed: %s", e)
+                avail = []
         if step is None:
             if self._manager is not None:
                 # the Orbax path needs the same cross-process agreement
@@ -1119,18 +1305,17 @@ class FlashCheckpointer:
             tainted = False
             try:
                 with open(ram[step], "rb") as f:
+                    man = ckpt_store.read_manifest(f)
                     # an auto-selected step saved inside an anomaly
                     # window must not be restored — the corruption the
                     # sentinel tripped on may already be in it. An
                     # explicit step is the caller's (master's) choice.
-                    if (auto_step and
-                            ckpt_store.archive_last_good(f) is False):
+                    if auto_step and man.get("last_good") is False:
                         tainted = True
                     else:
-                        snapshot, _ = ckpt_store.snapshot_from_file(
-                            f, target
+                        state = self._restore_local_archive(
+                            f, man, step, target
                         )
-                        state = _restore_shards(snapshot, target)
                         logger.info(
                             "Restored step %d from RAM tier", step
                         )
@@ -1188,10 +1373,48 @@ class FlashCheckpointer:
                     is False):
                 self._note_tainted(cand, step, tier="persistent")
                 continue
+            # format-v2 first: catalog from the store's step manifest
+            # and/or surviving peers, shards assembled from any tier —
+            # works across any topology change and with the store off
+            # the critical path when peers still hold the step
+            try:
+                state, stats = self._restore_v2(cand, target)
+            except Exception as e:
+                state, stats = None, None
+                logger.info(
+                    "step %d not v2-restorable (%s); trying the "
+                    "monolithic path", cand, e,
+                )
+            if state is not None:
+                tier = (
+                    "peer"
+                    if stats.get("peer")
+                    and not stats.get("store") and not stats.get("local")
+                    else "persistent"
+                )
+                if cand != step:
+                    logger.warning(
+                        "Step %d not restorable; restored older "
+                        "step %d", step, cand,
+                    )
+                _observe_ckpt(
+                    "restore", tier, cand, time.time() - t0,
+                    backend="store", requested_step=step,
+                )
+                return state, cand
+            # legacy monolithic path (format v1, or a v2 single-proc
+            # archive readable whole)
             try:
                 with ckpt_store.open_step(
                     self._store, cand, self._process_index
                 ) as f:
+                    man = ckpt_store.read_manifest(f)
+                    if int(man.get("version", 1)) < 2:
+                        record(
+                            "checkpoint.legacy_format", step=cand,
+                            tier="persistent",
+                            version=int(man.get("version", 1)),
+                        )
                     snapshot, _ = ckpt_store.snapshot_from_file(
                         f, target
                     )
@@ -1229,6 +1452,136 @@ class FlashCheckpointer:
             )
             return _restore_shards(snapshot, target), cand
         return None, None
+
+    def _restore_local_archive(self, f, man, step: int, target):
+        """RAM-tier restore dispatch on the archive's format. v1
+        archives (and complete single-process v2 archives) go through
+        the monolithic reader; a multi-process v2 archive holds only
+        this host's addressable shards, so the v2 planner assembles
+        the rest from peers / the store."""
+        version = int(man.get("version", 1))
+        topo_n = int((man.get("topology") or {}).get("n_processes", 1))
+        if version < 2:
+            # pre-manifest monolithic archive: fully served by the
+            # legacy reader — existing saves and the warm-restart
+            # drill keep working, and the journal says so
+            record(
+                "checkpoint.legacy_format", step=step, tier="ram",
+                version=version,
+            )
+        if version < 2 or (topo_n <= 1 and not man.get("subset")):
+            snapshot, _ = ckpt_store.snapshot_from_file(f, target)
+            return _restore_shards(snapshot, target)
+        state, _ = self._restore_v2(step, target, local_file=f)
+        return state
+
+    def _restore_v2(self, step: int, target, local_file=None):
+        """Format-v2 catalog restore across the tier chain: build the
+        widest catalog the surviving metadata allows (this host's
+        archive manifest, peers' manifests, the store's merged step
+        manifest), then assemble the CURRENT topology's needed domains
+        through local -> peer -> store sources with per-shard digest
+        verification. Returns ``(state, stats)``; raises when the step
+        has no v2 metadata or cannot be fully assembled."""
+        from dlrover_tpu.checkpoint import loader as ckpt_loader
+        from dlrover_tpu.checkpoint import peer as ckpt_peer
+
+        catalog = None
+        sources: List[Any] = []
+        if local_file is not None:
+            man = ckpt_store.read_manifest(local_file)
+            catalog = ckpt_loader.StepCatalog.from_archive_manifest(man)
+            sources.append(ckpt_loader.LocalArchiveSource(local_file))
+        peers: Dict[int, str] = {}
+        if self._peer_registry is not None:
+            try:
+                peers = {
+                    p: u
+                    for p, u in self._peer_registry.peers(step).items()
+                    if p != self._process_index
+                }
+            except Exception as e:
+                logger.warning("peer lookup failed: %s", e)
+                peers = {}
+            for p in sorted(peers):
+                try:
+                    man = ckpt_peer.fetch_manifest(peers[p], step)
+                except Exception as e:
+                    logger.warning(
+                        "peer manifest from proc %d failed: %s", p, e
+                    )
+                    continue
+                if man is None:
+                    continue
+                if catalog is None:
+                    catalog = ckpt_loader.StepCatalog.from_archive_manifest(
+                        man
+                    )
+                else:
+                    catalog.absorb(man)
+            if peers:
+                sources.append(
+                    ckpt_loader.PeerSource(
+                        peers, step,
+                        process_index=self._process_index,
+                    )
+                )
+        if self._store is not None:
+            man2 = None
+            try:
+                man2 = ckpt_store.step_manifest(self._store, step)
+            except Exception as e:
+                logger.warning(
+                    "step manifest unavailable from store: %s", e
+                )
+            if man2 is not None:
+                store_cat = ckpt_loader.StepCatalog.from_step_manifest(
+                    man2
+                )
+                if catalog is None:
+                    catalog = store_cat
+                else:
+                    for k, loc in store_cat.locations.items():
+                        catalog.locations.setdefault(k, loc)
+                    for k, v in store_cat.digests.items():
+                        catalog.digests.setdefault(k, v)
+                    for k, v in store_cat.encodings.items():
+                        catalog.encodings.setdefault(k, v)
+                sources.append(
+                    ckpt_loader.StoreSource(
+                        self._store, step,
+                        str(man2.get("attempt", "0")),
+                        store_cat.locations,
+                    )
+                )
+        if catalog is None:
+            raise KeyError(
+                f"step {step}: no format-v2 metadata reachable"
+            )
+        try:
+            state, _, stats = ckpt_loader.restore_from_catalog(
+                catalog, target, sources
+            )
+        finally:
+            for s in sources:
+                close = getattr(s, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+        record(
+            "ckpt.topology_restore", step=step,
+            saved_processes=int(
+                (catalog.topology or {}).get("n_processes", 1)
+            ),
+            restore_processes=self._n_processes,
+            local=stats.get("local", 0), peer=stats.get("peer", 0),
+            store=stats.get("store", 0),
+            digest_mismatch=stats.get("digest_mismatch", 0),
+            bytes=stats.get("bytes", 0),
+        )
+        return state, stats
 
     def _note_tainted(self, cand: int, requested: int,
                       tier: str) -> None:

@@ -18,6 +18,8 @@ here:
    written *after* the data objects, not from ``os.rename``:
 
        <prefix>/step-<N>/proc-<P>.ckpt   per-process shard archive
+       <prefix>/step-<N>/xidx-<P>.json   per-process index piece (v2)
+       <prefix>/step-<N>/MANIFEST.json   merged step manifest (v2)
        <prefix>/step-<N>/COMMIT          JSON {"step": N, "procs": [..]}
 
    A step without its COMMIT object is invisible to readers — exactly
@@ -54,6 +56,7 @@ __all__ = [
     "snapshot_to_file",
     "snapshot_from_bytes",
     "snapshot_from_file",
+    "read_manifest",
 ]
 
 #: chunk size for streaming copies between files and object stores
@@ -94,7 +97,14 @@ class _HashingWriter:
 # --------------------------------------------------------------------------
 
 _MANIFEST = "manifest.json"
-_FORMAT_VERSION = 1
+#: version 2 = the sharded checkpoint plane (docs/CHECKPOINT.md
+#: "Format v2"): normalized logical-shard domains, a global domain map
+#: with replica sets and elected owners in every entry, and optional
+#: owned-only subset archives. Version-1 archives (monolithic, welded
+#: to the saving topology) are still READ — restore auto-detects them
+#: and routes through the legacy path.
+_FORMAT_VERSION = 2
+_SUPPORTED_VERSIONS = (1, 2)
 
 
 def _path_components(path) -> List[Dict[str, Any]]:
@@ -140,7 +150,9 @@ def _is_snap(x) -> bool:
 
 
 def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO,
-                     last_good: Optional[bool] = None) -> int:
+                     last_good: Optional[bool] = None,
+                     topology: Optional[Dict[str, int]] = None,
+                     owned_only: bool = False) -> int:
     """Stream a local-shard snapshot pytree to ``fileobj`` as a safe
     archive; returns the bytes written (-1 if the file can't tell()).
 
@@ -151,9 +163,22 @@ def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO,
     Leaves may be shard-snap dicts (from ``_local_shards``), numpy
     arrays/scalars, or JSON primitives; anything else raises
     ArchiveError at SAVE time (loud, not latent).
+
+    ``topology`` (``{"n_processes": N, "process_index": p}``) stamps
+    the save topology into the manifest and switches shard domains to
+    the normalized v2 form; snap dicts may then carry the global
+    ``domains`` map (``_stage_local_shards`` computes it from
+    ``devices_indices_map``) whose replica sets drive owner election.
+    ``owned_only=True`` writes a dedup subset: members are emitted only
+    for shards THIS process owns (plus everything unreplicated), while
+    the manifest keeps the full global metadata — the persist tier's
+    aggregate bytes stop scaling with the data-parallel world size.
     """
     import jax
 
+    from dlrover_tpu.checkpoint import manifest as ckpt_manifest
+
+    me = int(topology["process_index"]) if topology else 0
     leaves = jax.tree_util.tree_flatten_with_path(
         snapshot, is_leaf=_is_snap
     )[0]
@@ -177,6 +202,16 @@ def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO,
         # restore walk-down must skip it. Absent (older archives, or no
         # sentinel armed) is treated as clean.
         manifest["last_good"] = bool(last_good)
+    if topology is not None:
+        manifest["topology"] = {
+            "n_processes": int(topology.get("n_processes", 1)),
+            "process_index": me,
+        }
+    if owned_only:
+        # a dedup subset is not independently restorable through the
+        # legacy reader (members for unowned shards are elsewhere);
+        # the v2 loader assembles across process files instead
+        manifest["subset"] = True
     counter = [0]
 
     with zipfile.ZipFile(
@@ -208,19 +243,83 @@ def snapshot_to_file(snapshot: Any, step: int, fileobj: BinaryIO,
             manifest["digests"][name + ".npy"] = digest.hexdigest()
             return name
 
+        all_procs = (
+            list(range(int(topology["n_processes"])))
+            if topology else [0]
+        )
         for path, leaf in leaves:
-            entry: Dict[str, Any] = {"path": _path_components(path)}
+            comps = _path_components(path)
+            entry: Dict[str, Any] = {"path": comps}
+            pkey = ckpt_manifest.path_key(comps)
             if _is_snap(leaf):
                 entry["kind"] = "shards"
-                entry["shape"] = list(leaf["shape"])
+                shape = list(leaf["shape"])
+                entry["shape"] = shape
                 entry["dtype"] = str(leaf["dtype"])
-                entry["shards"] = [
-                    {"idx": _index_to_json(idx), "a": add_array(data)}
-                    for idx, data in leaf["shards"]
-                ]
+                # global domain map (replica sets from the staged
+                # devices_indices_map when present, else this file's
+                # own shards) with a deterministically elected owner
+                # per domain — identical on every host by construction
+                domain_docs = leaf.get("domains")
+                if domain_docs is None:
+                    domain_docs = [
+                        {
+                            "idx": ckpt_manifest.normalize_index(
+                                _index_to_json(idx), shape
+                            ),
+                            "replicas": [me],
+                        }
+                        for idx, _ in leaf["shards"]
+                    ]
+                domains, owners = [], {}
+                for d in domain_docs:
+                    idx_doc = ckpt_manifest.normalize_index(
+                        d["idx"], shape
+                    )
+                    key = ckpt_manifest.shard_key(pkey, idx_doc)
+                    owner = ckpt_manifest.elect_owner(
+                        key, d.get("replicas", [me])
+                    )
+                    owners[ckpt_manifest.index_key(idx_doc)] = (
+                        owner, sorted(d.get("replicas", [me]))
+                    )
+                    domains.append({
+                        "idx": idx_doc,
+                        "replicas": sorted(d.get("replicas", [me])),
+                        "owner": owner,
+                    })
+                entry["domains"] = domains
+                shards_doc = []
+                seen = set()
+                for idx, data in leaf["shards"]:
+                    idx_doc = ckpt_manifest.normalize_index(
+                        _index_to_json(idx), shape
+                    )
+                    ikey = ckpt_manifest.index_key(idx_doc)
+                    owner, replicas = owners.get(ikey, (me, [me]))
+                    rec: Dict[str, Any] = {
+                        "idx": idx_doc,
+                        "replicas": replicas,
+                        "owner": owner,
+                    }
+                    if ikey in seen:
+                        continue  # replicated across local devices
+                    seen.add(ikey)
+                    if not (owned_only and owner != me):
+                        rec["a"] = add_array(data)
+                    shards_doc.append(rec)
+                entry["shards"] = shards_doc
             elif isinstance(leaf, (np.ndarray, np.generic)):
                 entry["kind"] = "array"
-                entry["a"] = add_array(leaf)
+                # non-jax leaves are host-replicated state (every
+                # process snapshots the same value): dedup them too
+                owner = ckpt_manifest.elect_owner(
+                    ckpt_manifest.shard_key(pkey, "full"), all_procs
+                )
+                entry["replicas"] = all_procs
+                entry["owner"] = owner
+                if not (owned_only and owner != me):
+                    entry["a"] = add_array(leaf)
             elif leaf is None or isinstance(leaf, (bool, int, float, str)):
                 entry["kind"] = "py"
                 entry["v"] = leaf
@@ -264,7 +363,7 @@ def _load_archive_file(fileobj: BinaryIO):
         raise
     except Exception as e:
         raise ArchiveError(f"corrupt checkpoint archive: {e}")
-    if manifest.get("version") != _FORMAT_VERSION:
+    if manifest.get("version") not in _SUPPORTED_VERSIONS:
         raise ArchiveError(
             f"unsupported archive version {manifest.get('version')!r}"
         )
@@ -358,6 +457,30 @@ def snapshot_from_bytes(data: bytes, target: Any = None):
     like the evaluator that read params by name.
     """
     return snapshot_from_file(io.BytesIO(data), target)
+
+
+def read_manifest(fileobj: BinaryIO) -> Dict[str, Any]:
+    """The archive's JSON manifest alone — no member loads, no digest
+    pass. The v2 restore planner builds its catalog from this (and the
+    peer tier serves it over ``/ckpt/shard?what=manifest``); the
+    position of ``fileobj`` is restored so a subsequent full read
+    starts clean. Raises :class:`ArchiveError` on anything unreadable."""
+    try:
+        pos = fileobj.tell()
+        with zipfile.ZipFile(fileobj) as zf:
+            manifest = json.loads(zf.read(_MANIFEST).decode("utf-8"))
+        fileobj.seek(pos)
+    except ArchiveError:
+        raise
+    except Exception as e:
+        raise ArchiveError(f"unreadable archive manifest: {e}")
+    if not isinstance(manifest, dict):
+        raise ArchiveError("archive manifest malformed")
+    if manifest.get("version") not in _SUPPORTED_VERSIONS:
+        raise ArchiveError(
+            f"unsupported archive version {manifest.get('version')!r}"
+        )
+    return manifest
 
 
 def archive_last_good(fileobj: BinaryIO) -> Optional[bool]:
@@ -631,6 +754,22 @@ def step_key(step: int, process_index: int, attempt: str = "0") -> str:
     return f"step-{step}/proc-{process_index}.a{attempt}.ckpt"
 
 
+def index_key(step: int, process_index: int, attempt: str = "0") -> str:
+    """One host's index piece (its archive manifest as standalone
+    JSON): what rank 0 merges into the step manifest. The ``x`` prefix
+    keeps it out of the ``proc-`` shard namespace the commit barrier
+    and legacy readers pattern-match on."""
+    return f"step-{step}/xidx-{process_index}.a{attempt}.json"
+
+
+def manifest_key(step: int, attempt: str = "0") -> str:
+    """The merged step manifest (format v2): logical arrays, global
+    domain maps, and the shard-key -> (process file, member, sha256)
+    location table. Published BEFORE the COMMIT marker — a committed
+    v2 step always has its manifest."""
+    return f"step-{step}/MANIFEST.a{attempt}.json"
+
+
 def commit_key(step: int) -> str:
     return f"step-{step}/COMMIT"
 
@@ -708,6 +847,69 @@ def commit_step(store: ObjectStore, step: int, n_processes: int,
     return True
 
 
+def commit_step_sharded(store: ObjectStore, step: int, n_processes: int,
+                        attempt: str = "0", timeout: float = 600.0,
+                        last_good: Optional[bool] = None) -> bool:
+    """Rank 0's commit half for a format-v2 save: wait for every
+    process's shard file AND index piece, merge the pieces into the
+    step manifest, publish it, then the COMMIT marker (tagged
+    ``format: 2``). The same store-is-the-barrier contract as
+    :func:`commit_step`; a merge that finds a shard with no persisted
+    member fails the commit instead of publishing a torn step."""
+    from dlrover_tpu.checkpoint import manifest as ckpt_manifest
+
+    want = {step_key(step, p, attempt) for p in range(n_processes)}
+    want |= {index_key(step, p, attempt) for p in range(n_processes)}
+    if not _await_keys(store, step, want, timeout):
+        return False
+    pieces = []
+    for p in range(n_processes):
+        try:
+            pieces.append(
+                json.loads(
+                    store.get(index_key(step, p, attempt)).decode("utf-8")
+                )
+            )
+        except (KeyError, ValueError) as e:
+            raise ArchiveError(
+                f"step {step}: index piece for proc {p} unreadable: {e}"
+            )
+    doc = ckpt_manifest.merge_index_pieces(
+        pieces, step, attempt=attempt, last_good=last_good
+    )
+    store.put(
+        manifest_key(step, attempt),
+        json.dumps(doc, separators=(",", ":")).encode("utf-8"),
+    )
+    commit_doc: Dict[str, Any] = {
+        "step": step, "n_processes": n_processes, "attempt": attempt,
+        "format": 2,
+    }
+    if last_good is not None:
+        commit_doc["last_good"] = bool(last_good)
+    store.put(
+        commit_key(step), json.dumps(commit_doc).encode("utf-8")
+    )
+    return True
+
+
+def step_manifest(store: ObjectStore, step: int) -> Optional[Dict[str, Any]]:
+    """The merged v2 manifest of a COMMITTED step, or None for legacy
+    (format-1) steps. KeyError when the step is uncommitted or a v2
+    step lost its manifest object."""
+    doc = _commit_manifest(store, step)  # KeyError if uncommitted
+    if doc.get("format") != 2:
+        return None
+    raw = store.get(manifest_key(step, str(doc.get("attempt", "0"))))
+    try:
+        man = json.loads(raw.decode("utf-8"))
+    except ValueError as e:
+        raise KeyError(f"step {step} manifest unreadable: {e}")
+    if not isinstance(man, dict) or man.get("format") != 2:
+        raise KeyError(f"step {step} manifest malformed")
+    return man
+
+
 def step_last_good(store: ObjectStore, step: int) -> Optional[bool]:
     """The sentinel verdict recorded at commit time: False = saved
     inside an anomaly window, True = sentinel-clean, None = no verdict
@@ -722,10 +924,14 @@ def step_last_good(store: ObjectStore, step: int) -> Optional[bool]:
 
 def _await_shards(store: ObjectStore, step: int, n_processes: int,
                   timeout: float, attempt: str) -> bool:
+    want = {step_key(step, p, attempt) for p in range(n_processes)}
+    return _await_keys(store, step, want, timeout)
+
+
+def _await_keys(store: ObjectStore, step: int, want, timeout: float) -> bool:
     import time
 
     deadline = time.time() + timeout
-    want = {step_key(step, p, attempt) for p in range(n_processes)}
     while True:
         have = set(store.list(f"step-{step}/"))
         if want <= have:
@@ -760,17 +966,27 @@ def _commit_manifest(store: ObjectStore, step: int) -> Dict[str, Any]:
 
 
 def available_steps(store: ObjectStore, process_index: int) -> List[int]:
-    """Committed steps whose shard for THIS process exists — the only
-    steps this process can actually restore (a committed step can still
-    lose an object; readers must not select it)."""
+    """Committed steps this process can actually restore (a committed
+    step can still lose an object; readers must not select it).
+
+    Format-v2 steps are restorable by ANY process — the loader
+    assembles needed domains from whichever process files hold them —
+    so availability means the step manifest exists, not a shard keyed
+    by this process's index (which may not even be in the save
+    topology after a world resize). Legacy steps keep the per-process
+    shard check."""
     out = []
     for s in committed_steps(store):
         try:
             manifest = _commit_manifest(store, s)
         except KeyError:
             continue
-        key = step_key(s, process_index, str(manifest.get("attempt", "0")))
-        if store.exists(key):
+        attempt = str(manifest.get("attempt", "0"))
+        if manifest.get("format") == 2:
+            if store.exists(manifest_key(s, attempt)):
+                out.append(s)
+            continue
+        if store.exists(step_key(s, process_index, attempt)):
             out.append(s)
     return out
 

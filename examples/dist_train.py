@@ -76,11 +76,41 @@ def main():
     opt_state = opt.init(params)
     params = jax.device_put(params, repl)
 
+    # peer shard tier (docs/CHECKPOINT.md "Format v2"): serve this
+    # process's RAM archives over /ckpt/shard and advertise them in
+    # the master KV, so a relaunched peer restores hot shards from
+    # survivors instead of the persist store. Every piece is guarded:
+    # masterless runs (no DLROVER_TPU_MASTER_ADDR) train as before.
+    peer_registry = None
+    shard_server = None
+    if os.getenv("DLROVER_TPU_MASTER_ADDR"):
+        try:
+            from dlrover_tpu.agent.master_client import (
+                build_master_client,
+            )
+            from dlrover_tpu.agent.elastic.training import _local_ip
+            from dlrover_tpu.checkpoint.peer import PeerRegistry
+            from dlrover_tpu.telemetry.http import (
+                set_shard_provider,
+                start_metrics_server,
+            )
+
+            shard_server = start_metrics_server()
+            if shard_server is not None:
+                url = f"http://{_local_ip()}:{shard_server.port}"
+                peer_registry = PeerRegistry(
+                    build_master_client(), jax.process_index(), url)
+        except Exception:
+            peer_registry = None
+
     ckpt = FlashCheckpointer(
         persist_dir=os.path.join(args.ckpt_dir, "persist"),
         ram_dir=os.path.join(args.ckpt_dir, "ram"),
         persist_interval=0, use_orbax=False,
+        peer_registry=peer_registry,
     )
+    if shard_server is not None:
+        set_shard_provider(ckpt.shard_provider())
     state = {"params": params, "opt_state": opt_state,
              "step": jnp.array(0)}
     restored, _ = ckpt.restore(target=state)

@@ -942,3 +942,37 @@ def test_goodput_phase_labels_are_canonical():
         "goodput phase labels must be canonical Phase members:\n"
         + "\n".join(bad)
     )
+
+
+#: the full vocabulary of the sharded checkpoint plane (format v2):
+#: saver dedup, rank-0 manifest commit, the peer shard tier and the
+#: topology-elastic restore. docs/TELEMETRY.md and the ckpt drills'
+#: journal asserts match these names literally — an addition or rename
+#: must land here, in the docs and in every consumer, in the same PR.
+#: (legacy-archive detection journals "checkpoint.legacy_format",
+#: which lives in the checkpoint.* namespace with the other
+#: FlashCheckpointer lifecycle events, not here.)
+_CKPT_EVENTS = {
+    "ckpt.manifest_committed",
+    "ckpt.dedup",
+    "ckpt.peer_advertised",
+    "ckpt.peer_fetch",
+    "ckpt.peer_served",
+    "ckpt.shard_refetch",
+    "ckpt.topology_restore",
+}
+
+
+def test_ckpt_event_names_are_the_canonical_set():
+    """The ckpt.* journal vocabulary is closed: every record() of a
+    ckpt event uses exactly one of the documented names, and every
+    documented name has a live emitter."""
+    found = {
+        value
+        for _, _, value, kind in _record_call_literals()
+        if kind == "literal" and value.startswith("ckpt.")
+    }
+    assert found == _CKPT_EVENTS, (
+        f"unexpected: {sorted(found - _CKPT_EVENTS)}, "
+        f"missing emitters for: {sorted(_CKPT_EVENTS - found)}"
+    )
