@@ -1,0 +1,302 @@
+"""Reshard-in-place vs restart-the-world MTTR -> RESHARD_r07.json.
+
+The PR 14 claim in numbers: when a host dies, an in-process mesh
+transition (dlrover_tpu/reshard/) re-targets the checkpointer at the
+surviving topology and assembles the new shard set through the tiered
+v2 loader — no process exit, no interpreter/jax re-init, no re-jit.
+Restart-the-world pays a fresh incarnation per rank before the same
+restore can even begin.
+
+Both paths recover the SAME committed flash save of a 4-virtual-host
+world (8 forced CPU devices, 2 per host) after host 2 is declared
+dead, landing on the 3-host remap as new index 1 — the survivor that
+needs the dead rank's rows, so its restore exercises the store tier,
+not just its own archive:
+
+* reshard: build the re-targeted FlashCheckpointer + migrate_from_
+  checkpoint() in THIS process — adopt-to-restored wall time.
+* restart: a fresh ``--worker`` subprocess does the identical restore;
+  wall time includes interpreter + jax import, the floor every rank
+  pays under restart-the-world (real fleets add rendezvous + re-jit
+  on top, so the measured speedup is a lower bound).
+
+``exactly_once`` asserts the migrated state is bit-identical to the
+saved state with zero digest mismatches — every domain fetched from
+exactly one tier, none lost, none double-applied.
+
+Run:  python benchmarks/reshard_mttr.py            # full -> JSON
+      python benchmarks/reshard_mttr.py --smoke    # one-line JSON
+The tier-1 gate (tests/test_reshard_mttr_smoke.py) runs --smoke and
+requires speedup >= 5 and exactly_once.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_OLD = 4  # pre-loss virtual hosts
+N_NEW = 3  # survivors
+DEAD = 2  # declared-dead old rank
+SURVIVOR = 1  # measured rank (new index 1 needs the dead rank's rows)
+STEP = 7
+
+
+def _force_host_devices():
+    """8 virtual CPU devices, set BEFORE jax import (driver+worker)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def _proc_of(n_procs, n_devs=8):
+    return lambda d: d.id * n_procs // n_devs
+
+
+def _mesh_state(rows):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    assert len(devs) == 8
+    mesh = Mesh(np.array(devs), ("dp",))
+    w = (
+        np.arange(8 * rows, dtype=np.float32).reshape(8, rows) + STEP
+    )
+    sharding = NamedSharding(mesh, P("dp"))
+    return mesh, sharding, w
+
+
+def _ckpt(store_dir, ram_dir, index, n_procs):
+    from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+    return FlashCheckpointer(
+        store_dir,
+        ram_dir=ram_dir,
+        persist_interval=1,
+        max_ram_keep=8,
+        max_persist_keep=8,
+        commit_timeout=60.0,
+        use_orbax=False,
+        stage="sync",
+        process_index=index,
+        n_processes=n_procs,
+        proc_of_device=_proc_of(n_procs),
+    )
+
+
+def _build_world(store_dir, ram_root, rows):
+    """Commit STEP from all 4 virtual hosts; returns the saved array.
+
+    Non-zero ranks upload first so rank 0's commit barrier finds every
+    shard already in place.
+    """
+    import jax
+
+    _, sharding, w = _mesh_state(rows)
+    state = {"w": jax.device_put(w, sharding), "step": STEP}
+    for index in (1, 2, 3, 0):
+        c = _ckpt(
+            store_dir, os.path.join(ram_root, f"r{index}"),
+            index, N_OLD,
+        )
+        c.save(STEP, state, durable=True, force_persist=True)
+        c.wait()
+        c.close()
+    # the dead rank's RAM tier dies with it: only the store can serve
+    # its rows afterwards
+    import shutil
+
+    shutil.rmtree(os.path.join(ram_root, f"r{DEAD}"),
+                  ignore_errors=True)
+    return w
+
+
+def _restore_target(rows):
+    import jax
+    import numpy as np
+
+    _, sharding, _ = _mesh_state(rows)
+    return {
+        "w": jax.device_put(
+            np.zeros((8, rows), np.float32), sharding
+        ),
+        "step": 0,
+    }
+
+
+def _reshard_once(store_dir, ram_root, rows, w_ref):
+    """In-process transition: adopt -> re-targeted ckpt -> migrated."""
+    import numpy as np
+
+    from dlrover_tpu.reshard.migrate import migrate_from_checkpoint
+
+    t0 = time.perf_counter()
+    ckpt = _ckpt(
+        store_dir, os.path.join(ram_root, f"r{SURVIVOR}"),
+        SURVIVOR, N_NEW,
+    )
+    state, got, stats = migrate_from_checkpoint(
+        ckpt, target=_restore_target(rows), step=STEP,
+    )
+    ms = (time.perf_counter() - t0) * 1000.0
+    ckpt.close()
+    assert state is not None and got == STEP, (state, got)
+    identical = bool(np.array_equal(np.asarray(state["w"]), w_ref))
+    exactly_once = identical and stats.get("digest_mismatch", 0) == 0
+    return ms, stats, exactly_once
+
+
+def worker(args) -> int:
+    """One restart-the-world incarnation: fresh interpreter + jax +
+    the identical re-targeted restore. Prints a TIMING line; the
+    driver measures the full process wall time around it."""
+    import numpy as np
+
+    from dlrover_tpu.reshard.migrate import migrate_from_checkpoint
+
+    t0 = time.perf_counter()
+    ckpt = _ckpt(
+        args.store_dir, os.path.join(args.ram_root, f"r{SURVIVOR}"),
+        SURVIVOR, N_NEW,
+    )
+    state, got, stats = migrate_from_checkpoint(
+        ckpt, target=_restore_target(args.rows), step=STEP,
+    )
+    restore_ms = (time.perf_counter() - t0) * 1000.0
+    ckpt.close()
+    assert state is not None and got == STEP, (state, got)
+    np.asarray(state["w"])  # materialized before we call it restored
+    print("TIMING " + json.dumps({
+        "restore_ms": round(restore_ms, 1),
+        "stats": stats,
+    }), flush=True)
+    return 0
+
+
+def _restart_once(store_dir, ram_root, rows):
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--store_dir", store_dir, "--ram_root", ram_root,
+         "--rows", str(rows)],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env=dict(os.environ),
+    )
+    ms = (time.perf_counter() - t0) * 1000.0
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"restart worker failed:\n{proc.stderr[-3000:]}"
+        )
+    for line in proc.stdout.splitlines():
+        if line.startswith("TIMING "):
+            return ms, json.loads(line[len("TIMING "):])
+    raise RuntimeError(f"no TIMING line:\n{proc.stdout[-2000:]}")
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main(argv=None) -> int:
+    _force_host_devices()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--store_dir", default="")
+    ap.add_argument("--ram_root", default="")
+    ap.add_argument("--rows", type=int, default=4)
+    ap.add_argument("--samples", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "RESHARD_r07.json"
+    ))
+    args = ap.parse_args(argv)
+    if args.worker:
+        return worker(args)
+
+    samples = args.samples or (1 if args.smoke else 3)
+    rows = args.rows if args.rows != 4 else (
+        4 if args.smoke else 1 << 18  # 8 MiB of f32 in the full tier
+    )
+    reshard_ms, restart_ms = [], []
+    with tempfile.TemporaryDirectory() as tmp:
+        store_dir = os.path.join(tmp, "store")
+        ram_root = os.path.join(tmp, "ram")
+        w_ref = _build_world(store_dir, ram_root, rows)
+        exactly_once = True
+        stats = {}
+        for _ in range(samples):
+            ms, stats, once = _reshard_once(
+                store_dir, ram_root, rows, w_ref
+            )
+            reshard_ms.append(round(ms, 1))
+            exactly_once = exactly_once and once
+        restart_detail = {}
+        for _ in range(samples):
+            ms, restart_detail = _restart_once(
+                store_dir, ram_root, rows
+            )
+            restart_ms.append(round(ms, 1))
+
+    res = _median(reshard_ms)
+    rst = _median(restart_ms)
+    summary = {
+        "reshard_mttr_ms": res,
+        "restart_mttr_ms": rst,
+        "speedup": round(rst / max(res, 1e-6), 1),
+        "exactly_once": exactly_once,
+    }
+    if args.smoke:
+        print(json.dumps(summary))
+        return 0
+
+    doc = {
+        "what": (
+            "MTTR of an in-process mesh transition (reshard-in-place: "
+            "re-targeted FlashCheckpointer + tiered migrate in the "
+            "surviving process) vs restart-the-world (fresh "
+            "interpreter + jax import + the identical restore), both "
+            "recovering the same committed 4-host flash save onto the "
+            "3-host remap after host 2 dies; survivor new-index 1 "
+            "needs the dead rank's rows so the store tier is on the "
+            "measured path"
+        ),
+        **summary,
+        "samples": {
+            "reshard_ms": reshard_ms,
+            "restart_ms": restart_ms,
+        },
+        "state_bytes": 8 * rows * 4,
+        "migrate_stats": stats,
+        "restart_breakdown": restart_detail,
+        "notes": (
+            "restart wall time is the per-rank floor only "
+            "(interpreter + jax import + restore); a real restart "
+            "additionally pays scheduler relaunch, rendezvous, and "
+            "re-jit across EVERY rank, so the speedup is a lower "
+            "bound. exactly_once = migrated state bit-identical to "
+            "the save with zero digest mismatches. The end-to-end "
+            "chaos drill (tests/test_reshard_drill.py) proves the "
+            "same transition against a live master with dataset "
+            "exactly-once accounting."
+        ),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -546,6 +546,28 @@ class MasterClient:
             return None
 
     @supervised_rpc
+    def report_reshard(self, order_id: int, phase: str,
+                       detail: str = ""):
+        """Mesh-transition progress (reshard/transition.py): this
+        survivor reached ``phase`` of transition order ``order_id``.
+        The coordinator answers ok/stale/abort. A master predating the
+        RPC rejects the unknown message with an application error —
+        the worker then treats the transition as unsupervised and
+        falls back to restart-the-world (None return)."""
+        req = self._fill(comm.ReshardReport(
+            order_id=order_id, phase=phase, detail=detail,
+        ))
+        try:
+            return self._call("report_reshard", req)
+        except Exception as e:
+            if is_connection_error(e):
+                raise
+            logger.warning("report_reshard unsupported: %s", e)
+            record("anomaly.rpc_fallback", rpc="report_reshard",
+                   error=str(e)[:200])
+            return None
+
+    @supervised_rpc
     def relinquish_shards(self, dataset_name: str = "") -> int:
         """Drain step 3: return this node's in-flight shards to the
         todo queue immediately (empty name = every dataset). Returns
@@ -875,6 +897,10 @@ class LocalMasterClient:
                        host="", last_good_step=-1, restart_count=0):
         # masterless: no one to coordinate a rollback with; the
         # sentinel's local anomaly window is the whole story
+        return None
+
+    def report_reshard(self, order_id, phase, detail=""):
+        # masterless: a single process has no mesh to transition
         return None
 
     def relinquish_shards(self, dataset_name=""):

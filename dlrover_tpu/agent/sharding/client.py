@@ -64,7 +64,14 @@ class ShardingClient:
         self._dataset_name = dataset_name
         self._count_minibatches_per_shard = num_minibatches_per_shard
         self._pending_tasks = deque()
-        self._batch_count = 0
+        # records (samples) of the HEAD pending shard already consumed.
+        # Counted in records, not minibatches: a mid-shard resize
+        # (reshard re-arms the batch geometry) changes the minibatch
+        # count of an in-flight shard, and a minibatch counter would
+        # report the head task done before (or after) its records were
+        # actually consumed — losing the tail to exactly-once if the
+        # worker then dies
+        self._records_done = 0
         self._lock = threading.Lock()
         self._current_task = None
         self._stopped = False
@@ -343,10 +350,23 @@ class ShardingClient:
         if remove is not None:
             remove(f"dataset:{self._dataset_name}")
 
+    def resize(self, batch_size: int) -> None:
+        """Re-arm the batch geometry after a world resize (reshard
+        transition): future completion accounting and index chunking
+        use the new per-host batch size. Safe mid-shard — completion
+        is counted in records, which a geometry change cannot skew;
+        call between steps, after the mesh transition lands."""
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive: {batch_size}")
+        with self._lock:
+            self._batch_size = batch_size
+
     def report_batch_done(self, batch_size: Optional[int] = None) -> bool:
-        """Accumulate minibatch completions; report the oldest pending task
+        """Accumulate batch completions; report the oldest pending task
         done once its shard's records are consumed
-        (parity: sharding/client.py:146).
+        (parity: sharding/client.py:146). ``batch_size`` overrides the
+        client's configured size for THIS batch (short final batches,
+        mixed geometry across a resize).
 
         The completion RPC runs OUTSIDE the lock: a slow or
         reconnecting master must not stall stop()/report_task_done()
@@ -355,15 +375,15 @@ class ShardingClient:
         with self._lock:
             if not self._pending_tasks:
                 return False
-            self._batch_count += 1
             head = self._pending_tasks[0]
             records = head.shard.end - head.shard.start
-            minibatches = max(
-                1, (records + self._batch_size - 1) // self._batch_size
-            )
-            if self._batch_count >= minibatches:
+            self._records_done += batch_size or self._batch_size
+            if self._records_done >= records:
                 self._pending_tasks.popleft()
-                self._batch_count = 0
+                # carry the overflow: an index-stream chunk straddles
+                # shard boundaries, so its tail belongs to (and must
+                # credit) the NEXT head
+                self._records_done -= records
                 task = head
         if task is None:
             return False
@@ -384,6 +404,13 @@ class ShardingClient:
             self._dataset_name, task_id, err
         )
         with self._lock:
+            if (
+                self._pending_tasks
+                and self._pending_tasks[0].task_id == task_id
+            ):
+                # the partially-counted head is gone: a stale record
+                # count must not leak onto the next head shard
+                self._records_done = 0
             self._pending_tasks = deque(
                 t for t in self._pending_tasks if t.task_id != task_id
             )

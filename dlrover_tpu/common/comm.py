@@ -472,6 +472,26 @@ class AnomalyResponse(BaseMessage):
 
 
 @dataclass
+class ReshardReport(BaseRequest):
+    """Worker progress on a mesh-transition order (reshard/): the
+    survivor reached ``phase`` ("adopted" | "migrated" | "completed" |
+    "aborted") of the order it adopted from the KV broadcast."""
+
+    order_id: int = 0
+    phase: str = ""
+    detail: str = ""
+
+
+@dataclass
+class ReshardResponse(BaseMessage):
+    """Coordinator verdict on a reshard progress report: carry on
+    (``ok``), drop the order (``stale`` — it is no longer the active
+    transition), or fall back to restart-the-world (``abort``)."""
+
+    action: str = "ok"  # "ok" | "stale" | "abort" | "none"
+
+
+@dataclass
 class HeartBeat(BaseRequest):
     timestamp: float = 0.0
 

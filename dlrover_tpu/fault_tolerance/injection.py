@@ -49,6 +49,21 @@ Two triggers:
                                     with other roles drop the kind, and
                                     ``host=H`` restricts it to node
                                     rank H like the corruption kinds.
+  - ``node_lost@8`` / ``node_lost@8:host=2``  SIGKILL the worker at
+                                    step 8 with NO relaunch: the
+                                    master's TransitionCoordinator
+                                    (reshard/coordinator.py) turns the
+                                    loss into an online mesh shrink
+                                    instead of restarting the world.
+                                    ``host=H`` restricts the kill to
+                                    node rank H so a multi-worker
+                                    drill loses exactly one host.
+  - ``node_join@12``                marker only — prints/journals the
+                                    join point so a drill harness can
+                                    launch the joining rank there; the
+                                    joiner announces itself through
+                                    the normal node-running path and
+                                    the coordinator cuts a grow order.
   - ``master_crash@5`` / ``master_crash@5:2``  kill the JOB MASTER
                                     (rc 28) once the reported global
                                     step reaches 5, after an optional
@@ -87,7 +102,7 @@ KV_PREFIX = "fault_inject"
 
 KINDS = (
     "crash", "hang", "oom", "error", "preempt", "master_crash",
-    "nan", "sdc", "serve_kill",
+    "nan", "sdc", "serve_kill", "node_lost", "node_join",
 )
 
 #: silent-corruption kinds: they do not kill the process — the trainer
@@ -102,6 +117,10 @@ MASTER_KINDS = frozenset({"master_crash"})
 #: counts responses served, not training steps) — other roles drop them
 #: so one shared spec can chaos a mixed train+serve job
 SERVING_KINDS = frozenset({"serve_kill"})
+
+#: reshard-drill kinds: also honor ``host=H`` scoping so one shared
+#: spec loses (or joins) exactly one node rank of a multi-worker drill
+RESHARD_KINDS = frozenset({"node_lost", "node_join"})
 
 #: distinct from a worker crash (17) and a deliberate job failure
 #: (main.JOB_FAILED_EXIT_CODE=3): the operator should see a master
@@ -239,7 +258,8 @@ class FaultInjector:
                 continue
             if f.kind in SERVING_KINDS and self._role != "serving":
                 continue
-            if f.kind in CORRUPTION_KINDS or f.kind in SERVING_KINDS:
+            if (f.kind in CORRUPTION_KINDS or f.kind in SERVING_KINDS
+                    or f.kind in RESHARD_KINDS):
                 host = _arg_kv(f.arg, "host")
                 if host is not None and int(host) != self._node_rank:
                     continue
@@ -376,6 +396,21 @@ class FaultInjector:
             )
             _signal_own_group(signal.SIGKILL)
             time.sleep(30)  # await delivery; SIGKILL cannot be handled
+        elif fault.kind == "node_lost":
+            # SIGKILL with NO relaunch expectation: a hard node death
+            # the master's TransitionCoordinator adopts as an online
+            # mesh shrink (reshard/coordinator.py) — survivors migrate
+            # in place; nothing comes back on this rank
+            print(f"INJECTED NODE LOST at step {step}", flush=True)
+            _signal_own_group(signal.SIGKILL)
+            time.sleep(30)  # await delivery; SIGKILL cannot be handled
+        elif fault.kind == "node_join":
+            # marker only: the joining process does not exist yet. The
+            # drill harness watches this line (and the journaled
+            # fault.injected) to launch the joining rank, which
+            # announces itself through the normal node-running path so
+            # the coordinator cuts a grow order.
+            print(f"INJECTED NODE JOIN at step {step}", flush=True)
         elif fault.kind == "preempt":
             # arg ``notice=N``: the platform's termination-notice
             # window — SIGTERM now, hard SIGKILL reclaim N seconds

@@ -32,6 +32,7 @@ from dlrover_tpu.master.node.job_auto_scaler import new_job_auto_scaler
 from dlrover_tpu.master.node.quarantine import QuarantineManager
 from dlrover_tpu.master.resource.local_optimizer import TPULocalOptimizer
 from dlrover_tpu.master.servicer import create_master_service
+from dlrover_tpu.reshard import TransitionCoordinator, reshard_opted_in
 from dlrover_tpu.serving.autoscaler import ServingAutoScaler
 from dlrover_tpu.serving.router import RequestRouter
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
@@ -174,6 +175,21 @@ class DistributedJobMaster:
                 else 1.0
             ),
         )
+        # reshard-in-place (reshard/coordinator.py): node loss/join
+        # becomes an online mesh transition — order broadcast over the
+        # KV store, lost rank's shards relinquished exactly-once,
+        # relaunch suppressed for the shed rank. Opt-in
+        # (DLROVER_TPU_RESHARD=1): the coordinator changes the
+        # recovery semantics of every worker loss, so jobs without the
+        # flag keep restart-the-world.
+        self.transition_coordinator = None
+        if reshard_opted_in():
+            self.transition_coordinator = TransitionCoordinator(
+                self.kv_store,
+                task_manager=self.task_manager,
+                goodput=self.goodput_aggregator,
+                fallback_fn=self._reshard_fallback,
+            )
         self.sync_service = SyncService(self.job_manager)
         self.auto_scaler = new_job_auto_scaler(
             self.job_manager, self.job_optimizer, scaler,
@@ -226,6 +242,7 @@ class DistributedJobMaster:
             kv_store=self.kv_store,
             goodput_aggregator=self.goodput_aggregator,
             request_router=self.request_router,
+            transition_coordinator=self.transition_coordinator,
         )
         self.port = self._server.port
         self._exit_code = 0
@@ -252,13 +269,26 @@ class DistributedJobMaster:
         def on_failed(node):
             if node.type != NodeType.WORKER:
                 return
+            rank = (node.rank_index if node.rank_index is not None
+                    else node.id)
+            # reshard-in-place first: when the coordinator cuts a
+            # shrink order for this loss, the survivors transition
+            # online and the dead rank must NOT be relaunched (the new
+            # world does not include it). A None order — disabled,
+            # budget spent, world too small, transition in flight —
+            # falls through to the restart path untouched.
+            if self.transition_coordinator is not None:
+                order = self.transition_coordinator.note_node_lost(
+                    rank, reason=node.exit_reason or ""
+                )
+                if order is not None:
+                    node.relaunchable = False
             # requeue the dead worker's data shards
-            # (parity: TaskRescheduleCallback event_callback.py:117)
+            # (parity: TaskRescheduleCallback event_callback.py:117);
+            # a no-op after the coordinator's exactly-once relinquish
             self.task_manager.recover_tasks(node.type, node.id)
             # rendezvous sets are keyed by RANK: a relaunched node keeps
             # its rank under a fresh id
-            rank = (node.rank_index if node.rank_index is not None
-                    else node.id)
             for mgr in self.rdzv_managers.values():
                 mgr.remove_alive_node(rank)
 
@@ -425,6 +455,10 @@ class DistributedJobMaster:
                     self._exit_reason = JobExitReason.HANG_ERROR
                     self._broadcast_stop(check_interval)
                     break
+                if self.transition_coordinator is not None:
+                    # abort watchdog: an order still open past the
+                    # timeout falls back to restart-the-world
+                    self.transition_coordinator.check_abort()
                 if self.job_manager.is_job_failed():
                     # critical-node fast-fail (dist_job_manager
                     # mark_job_failed): don't limp at reduced capacity
@@ -458,6 +492,16 @@ class DistributedJobMaster:
             time.sleep(grace)
         except Exception as e:
             logger.warning("stop broadcast failed: %s", e)
+
+    def _reshard_fallback(self, order):
+        """An online transition aborted: hand the incident to the
+        restart-the-world machinery — the shed ranks become
+        relaunchable again and come back as fresh incarnations."""
+        handle = getattr(
+            self.job_manager, "handle_reshard_fallback", None
+        )
+        if handle is not None:
+            handle(order.lost)
 
     def _goodput_summary(self):
         summary = self.goodput_aggregator.summary()

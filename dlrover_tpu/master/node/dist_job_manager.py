@@ -428,6 +428,30 @@ class DistributedJobManager:
             node.name, host or node.host_name,
         )
 
+    def handle_reshard_fallback(self, ranks, node_type=NodeType.WORKER):
+        """An online mesh transition aborted (coordinator timeout,
+        second casualty, worker-side refusal): restore the
+        restart-the-world contract for the ranks the order had shed —
+        they become relaunchable again and come back as fresh
+        incarnations, and survivors rejoin through the normal
+        rendezvous."""
+        lost = set(ranks or ())
+        mgr = self._node_managers.get(node_type)
+        if not lost or mgr is None:
+            return
+        for node in list(mgr.nodes.values()):
+            rank = (node.rank_index if node.rank_index is not None
+                    else node.id)
+            if rank not in lost or node.is_released:
+                continue
+            node.relaunchable = True
+            logger.warning(
+                "Reshard fallback: re-enabling relaunch for %s "
+                "(rank %s)", node.name, rank,
+            )
+            if node.status in (NodeStatus.FAILED, NodeStatus.DELETED):
+                self._maybe_relaunch(node)
+
     def request_node_drain(self, node_type: str, node_id: int,
                            reason: str = ""):
         """Master-initiated drain (scheduler maintenance signal): mark

@@ -50,6 +50,7 @@ class MasterServicer:
         kv_store=None,
         goodput_aggregator=None,
         request_router=None,
+        transition_coordinator=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -64,6 +65,9 @@ class MasterServicer:
         # without a serving tier — serve RPCs then raise an application
         # error the client's rpc_fallback path reports
         self._request_router = request_router
+        # reshard-in-place (reshard/coordinator.py); None falls back
+        # to restart-the-world for every scale event
+        self._transition_coordinator = transition_coordinator
         # injectable so the master can wire a journal-backed store that
         # survives a master restart (master/state_journal.py)
         self._kv_store = kv_store or KVStoreService()
@@ -404,6 +408,12 @@ class MasterServicer:
                 mgr.mark_node_succeeded(rank)
             elif req.status in ("failed", "deleted"):
                 mgr.remove_alive_node(rank)
+        if req.status == "running" and self._transition_coordinator:
+            # RUNNING workers are mesh-transition material: the
+            # coordinator's world membership is what a shrink order's
+            # survivor list is computed from
+            if req.node_type == NodeType.WORKER:
+                self._transition_coordinator.note_node_running(rank)
         if req.status == "running" and rank in self._preempted_ranks:
             # the relaunched incarnation is back: the preemption window
             # closes here for MTTR accounting
@@ -564,6 +574,21 @@ class MasterServicer:
             action="rollback", rollback_id=order["id"],
             rollback_step=order["step"], quarantined=quarantined,
         )
+
+    def rpc_report_reshard(
+        self, req: comm.ReshardReport
+    ) -> comm.ReshardResponse:
+        """Mesh-transition progress (reshard/): a survivor reports how
+        far it got executing the active TransitionOrder. The
+        coordinator completes the transition once every survivor says
+        ``completed``, or aborts it on the first ``aborted``."""
+        if self._transition_coordinator is None:
+            return comm.ReshardResponse(action="none")
+        rank = self._rank_of(req.node_type, req.node_id)
+        action = self._transition_coordinator.note_worker_phase(
+            rank, req.order_id, req.phase
+        )
+        return comm.ReshardResponse(action=action)
 
     def rpc_relinquish_shards(
         self, req: comm.RelinquishShardsRequest
@@ -885,6 +910,7 @@ def create_master_service(
     kv_store=None,
     goodput_aggregator=None,
     request_router=None,
+    transition_coordinator=None,
 ):
     """Build the gRPC server around a MasterServicer
     (parity: servicer.py:478)."""
@@ -900,6 +926,7 @@ def create_master_service(
         kv_store=kv_store,
         goodput_aggregator=goodput_aggregator,
         request_router=request_router,
+        transition_coordinator=transition_coordinator,
     )
     server = GenericRpcServer(servicer.handle, port=port)
     return server, servicer

@@ -1,0 +1,46 @@
+"""Reshard-in-place: online mesh transitions without restarting the
+world.
+
+A world-size change (node lost, node joined, quarantine eviction,
+drain notice) becomes an in-process state migration instead of a
+restart:
+
+* master side — :class:`~dlrover_tpu.reshard.coordinator.
+  TransitionCoordinator` detects the change, computes the new world,
+  and broadcasts a versioned :class:`~dlrover_tpu.reshard.order.
+  TransitionOrder` over the KV store.
+* worker side — :class:`~dlrover_tpu.reshard.transition.
+  MeshTransition` adopts the order exactly-once and executes it at
+  the next step boundary; :mod:`~dlrover_tpu.reshard.migrate` moves
+  the state (``jax.device_put`` for held shards, digest-verified
+  peer/store fetch for lost ones).
+
+See docs/ELASTICITY.md for the state machine, wire format, and the
+abort → restart-the-world fallback contract.
+"""
+
+from dlrover_tpu.reshard.coordinator import (  # noqa: F401
+    TransitionCoordinator,
+    reshard_enabled,
+    reshard_opted_in,
+)
+from dlrover_tpu.reshard.order import (  # noqa: F401
+    KIND_ABORT,
+    KIND_GROW,
+    KIND_SHRINK,
+    TRANSITION_ORDER_KEY,
+    TransitionOrder,
+)
+from dlrover_tpu.reshard.transition import MeshTransition  # noqa: F401
+
+__all__ = [
+    "TransitionCoordinator",
+    "TransitionOrder",
+    "MeshTransition",
+    "TRANSITION_ORDER_KEY",
+    "KIND_SHRINK",
+    "KIND_GROW",
+    "KIND_ABORT",
+    "reshard_enabled",
+    "reshard_opted_in",
+]

@@ -1,0 +1,98 @@
+"""State migration for mesh transitions.
+
+Two movers, one stats vocabulary:
+
+* :func:`reshard_arrays` — the pure in-process path for shards this
+  rank already holds: ``jax.device_put`` each leaf into its new
+  ``NamedSharding`` (the SNIPPETS.md pattern; Universal Checkpointing
+  makes this legal because format-v2 state is layout-free). Counts as
+  ``device`` moves.
+* :func:`migrate_from_checkpoint` — for shards this rank does NOT
+  hold (the dead rank's rows, or rows the remap hands to a different
+  survivor): assemble the last flash save through the PR 13 tiered
+  loader — this host's RAM archive (``local``), surviving peers' RAM
+  tier over ``/ckpt/shard`` (``peer``), the persistent store
+  (``store``) — every shard digest-verified before it is trusted.
+
+Both return a stats dict with the shared keys
+``{"local","peer","store","device","digest_mismatch","bytes"}``;
+:meth:`MeshTransition.note_migrated` journals it and feeds the
+``dlrover_reshard_shard_moves_total{source}`` counters.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+
+#: the canonical per-source move-count keys
+MOVE_SOURCES = ("local", "peer", "store", "device")
+
+
+def empty_stats() -> Dict[str, int]:
+    stats = {s: 0 for s in MOVE_SOURCES}
+    stats["digest_mismatch"] = 0
+    stats["bytes"] = 0
+    return stats
+
+
+def merge_stats(*parts: Optional[Dict[str, int]]) -> Dict[str, int]:
+    out = empty_stats()
+    for p in parts:
+        for k, v in (p or {}).items():
+            out[k] = out.get(k, 0) + int(v)
+    return out
+
+
+def reshard_arrays(state: Any, shardings: Any) -> Tuple[Any, Dict]:
+    """Move addressable shards into their new layout in-process.
+
+    ``shardings`` is a pytree congruent with ``state`` whose leaves
+    are the new ``NamedSharding``s (or None to leave a leaf alone).
+    Returns ``(state, stats)`` where ``stats["device"]`` counts the
+    leaves actually moved. No host round-trip: XLA moves only the
+    bytes whose device assignment changed.
+    """
+    import jax
+
+    stats = empty_stats()
+
+    def _put(x, s):
+        if s is None:
+            return x
+        if getattr(x, "sharding", None) == s:
+            return x  # already in the target layout: zero-copy
+        stats["device"] += 1
+        return jax.device_put(x, s)
+
+    state = jax.tree.map(
+        _put, state, shardings,
+        is_leaf=lambda x: x is None,
+    )
+    return state, stats
+
+
+def migrate_from_checkpoint(
+    checkpointer,
+    target: Any = None,
+    step: Optional[int] = None,
+) -> Tuple[Any, Optional[int], Dict]:
+    """Assemble this rank's NEW shard set from the last flash save.
+
+    ``checkpointer`` must already be re-targeted at the post-
+    transition topology (``process_index``/``n_processes`` of the new
+    world — see ``FlashCheckpointer``'s virtual-host kwargs); the
+    tiered v2 loader then fetches exactly the domains the new layout
+    assigns here, preferring the cheapest tier that still has them.
+    Returns ``(state, restored_step, stats)``; ``state`` is None when
+    nothing was restorable (callers abort the transition).
+    """
+    state, got = checkpointer.restore(target=target, step=step)
+    stats = merge_stats(
+        getattr(checkpointer, "last_restore_stats", None)
+    )
+    if state is None:
+        logger.warning(
+            "reshard migration found no restorable step "
+            "(requested %s)", step,
+        )
+    return state, got, stats

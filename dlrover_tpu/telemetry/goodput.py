@@ -79,6 +79,7 @@ class Phase:
     RESTART = "restart"        # fault-to-recovery (incl. master loss)
     PREEMPT = "preempt"        # reclaim notice -> drain -> relaunch
     ROLLBACK = "rollback"      # sentinel trip -> last-good restore
+    RESHARD = "reshard"        # online mesh transition (no restart)
     SERVING = "serving"        # inference replica answering requests
     IDLE = "idle"              # unattributed
 
@@ -86,7 +87,7 @@ class Phase:
 PHASES: Tuple[str, ...] = (
     Phase.INIT, Phase.RENDEZVOUS, Phase.TRAINING, Phase.CKPT_STALL,
     Phase.HANG, Phase.RESTART, Phase.PREEMPT, Phase.ROLLBACK,
-    Phase.SERVING, Phase.IDLE,
+    Phase.RESHARD, Phase.SERVING, Phase.IDLE,
 )
 
 #: badput breakdown keys: every phase that is neither useful work
@@ -94,7 +95,7 @@ PHASES: Tuple[str, ...] = (
 #: unattributed
 BADPUT_CAUSES: Tuple[str, ...] = (
     Phase.INIT, Phase.RENDEZVOUS, Phase.CKPT_STALL, Phase.HANG,
-    Phase.RESTART, Phase.PREEMPT, Phase.ROLLBACK,
+    Phase.RESTART, Phase.PREEMPT, Phase.ROLLBACK, Phase.RESHARD,
 )
 
 
@@ -134,7 +135,7 @@ class PhaseLedger:
             self._totals[self._phase] += max(0.0, ts - self._mark)
             prev = self._phase
             if prev not in (Phase.HANG, Phase.RESTART, Phase.PREEMPT,
-                            Phase.ROLLBACK):
+                            Phase.ROLLBACK, Phase.RESHARD):
                 # a fault phase ends by returning to what it interrupted
                 self._resume_phase = prev
             self._phase = phase
@@ -298,6 +299,14 @@ EVENT_RULES: Dict[str, Callable[[PhaseLedger, float, Dict], None]] = {
         lambda led, ts, data: led.transition(Phase.ROLLBACK, ts=ts),
     "rollback.ordered":
         lambda led, ts, data: led.transition(Phase.ROLLBACK, ts=ts),
+    # an adopted mesh-transition order opens the reshard window on the
+    # surviving rank's ledger; the first post-migration step closes it
+    # via on_step. An abort falls through to the restart-the-world
+    # path, so its time books as restart from the abort on
+    "reshard.adopted":
+        lambda led, ts, data: led.transition(Phase.RESHARD, ts=ts),
+    "reshard.aborted":
+        lambda led, ts, data: led.transition(Phase.RESTART, ts=ts),
     # a serving replica's useful-work phase opens when its weights are
     # loaded and it starts answering (serving/worker.py) — without this
     # rule serve time books as idle; same rule drives the offline
@@ -798,9 +807,12 @@ def _ledger_from_snapshot(data: Dict, fallback: PhaseLedger):
 
 #: generic kinds that prove a process was doing phase-attributable
 #: work (pre-ledger journals): drives the heuristic fallback. NOTE
-#: ``fault.injected`` is deliberately absent — the master records it
-#: too, and a master process must not be mistaken for a training node.
-_HEURISTIC_KINDS = (set(EVENT_RULES) - {"fault.injected"}) | {
+#: ``fault.injected`` and ``reshard.aborted`` are deliberately absent
+#: — the master records them too, and a master process must not be
+#: mistaken for a training node.
+_HEURISTIC_KINDS = (
+    set(EVENT_RULES) - {"fault.injected", "reshard.aborted"}
+) | {
     "distributed.init", "checkpoint.save", "checkpoint.restore",
 }
 
@@ -882,6 +894,23 @@ def _fault_windows(events: List[Dict]) -> List[Dict[str, Any]]:
             # one incident's order covers all ranks that adopted it
             for f in faults:
                 if (f["cause"] == Phase.ROLLBACK
+                        and f["recovered_ts"] is None):
+                    f["recovered_ts"] = ts
+        elif kind == "reshard.ordered":
+            # the MASTER journals the order; the casualty is the first
+            # lost rank (a grow order has none — fall back to proc)
+            lost = data.get("lost") or []
+            faults.append({
+                "cause": Phase.RESHARD,
+                "node_id": lost[0] if lost else e.get("proc"),
+                "ts": ts, "recovered_ts": None,
+            })
+        elif kind in ("reshard.completed", "reshard.aborted"):
+            # one transition covers every rank that adopted the order;
+            # an abort hands the incident to the restart-the-world
+            # machinery, which opens its own windows
+            for f in faults:
+                if (f["cause"] == Phase.RESHARD
                         and f["recovered_ts"] is None):
                     f["recovered_ts"] = ts
     # an injected master crash recovers at master.restored; an injected

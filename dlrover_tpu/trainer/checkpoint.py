@@ -1198,6 +1198,10 @@ class FlashCheckpointer:
         process starts fresh — never a mix.
         """
         self._drain_saves()
+        # per-tier shard-move stats of the newest v2 assembly (consumed
+        # by reshard/migrate.py to attribute where shards came from);
+        # None until a topology restore runs
+        self.last_restore_stats = None
         auto_mode = step is None
         if not (auto_mode and self._n_processes > 1):
             # no agreement collective on this path: let failures
@@ -1570,6 +1574,7 @@ class FlashCheckpointer:
                         close()
                     except Exception:
                         pass
+        self.last_restore_stats = dict(stats)
         record(
             "ckpt.topology_restore", step=step,
             saved_processes=int(

@@ -141,6 +141,15 @@ class ElasticTrainer:
         from dlrover_tpu.fault_tolerance.sentinel import TrainingSentinel
 
         self._sentinel = TrainingSentinel.from_env(self._master_client)
+        # reshard-in-place (reshard/transition.py): adopts master
+        # transition orders exactly-once; the step loop executes them
+        # at the next boundary via pending_reshard().
+        # DLROVER_TPU_RESHARD=0 disables
+        from dlrover_tpu.reshard import MeshTransition
+
+        self._mesh_transition = MeshTransition.from_env(
+            self._master_client
+        )
         # zero-code timeline capture (DLROVER_TRACE_DIR): see
         # trainer/profiler.py TraceCapture
         from dlrover_tpu.trainer.profiler import TraceCapture
@@ -355,6 +364,11 @@ class ElasticTrainer:
             # no scalar this step: still poll for rollback orders
             # issued on another rank's anomaly
             self._sentinel.poll_rollback_order()
+        if self._mesh_transition is not None:
+            # mesh-transition orders are adopted here (exactly-once by
+            # order id) and executed by the step loop at the boundary
+            # it chooses — see pending_reshard()
+            self._mesh_transition.poll_order()
         if (
             self._master_client is not None
             and self._global_step % self._report_interval == 0
@@ -434,6 +448,25 @@ class ElasticTrainer:
     @property
     def global_step(self) -> int:
         return self._global_step
+
+    # ------------------------------------------------------------ reshard
+
+    def pending_reshard(self):
+        """The adopted-but-unexecuted :class:`~dlrover_tpu.reshard.
+        order.TransitionOrder`, or None. The step loop checks this at
+        each step boundary; on a hit it re-forms the collective world,
+        migrates state (reshard/migrate.py), calls :meth:`set_world`
+        with the new node count (re-jit with ``_step_cache`` reuse),
+        and acknowledges through :attr:`mesh_transition`."""
+        if self._mesh_transition is None:
+            return None
+        return self._mesh_transition.pending()
+
+    @property
+    def mesh_transition(self):
+        """The armed :class:`~dlrover_tpu.reshard.transition.
+        MeshTransition` (None when DLROVER_TPU_RESHARD=0)."""
+        return self._mesh_transition
 
     @property
     def sentinel(self):

@@ -53,6 +53,21 @@ class ElasticDistributedSampler:
         self.completed_num = 0
         self._recompute_sizes()
 
+    def set_world(self, num_replicas: int, rank: int):
+        """Resize mid-epoch (reshard transition): the remaining
+        ``dataset_size - completed_num`` samples re-partition across
+        the new world. Call between batches and build a FRESH iterator
+        afterwards — a live iterator keeps the stride it was built
+        with (see __iter__/iter_batches), so indices it already handed
+        out stay counted under the old geometry."""
+        if rank >= num_replicas or rank < 0:
+            raise ValueError(
+                f"rank {rank} out of range for {num_replicas} replicas"
+            )
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self._recompute_sizes()
+
     def _epoch_indices(self) -> List[int]:
         indices = list(range(self.dataset_size))
         if self.shuffle:
@@ -61,13 +76,21 @@ class ElasticDistributedSampler:
         return indices
 
     def __iter__(self) -> Iterator[int]:
+        # stride snapshot: these indices were partitioned under THIS
+        # world size — a set_world during iteration must not advance
+        # completed_num at the new stride for old-geometry indices
+        stride = self.num_replicas
         for idx in self._rank_indices():
             # count global progress: each yielded index advances the global
             # consumed count by num_replicas (all replicas move in lockstep)
-            self.completed_num += self.num_replicas
+            self.completed_num += stride
             yield idx
 
     def _rank_indices(self) -> List[int]:
+        # completed_num advances between calls (mid-epoch suspension,
+        # set_world): size the padding from the CURRENT remainder, not
+        # the one seen at construction/resize time
+        self._recompute_sizes()
         indices = self._epoch_indices()[self.completed_num:]
         if not self.drop_last:
             # pad to a replica multiple
@@ -86,10 +109,11 @@ class ElasticDistributedSampler:
         num_replicas, committed when the batch is handed out."""
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive: {batch_size}")
+        stride = self.num_replicas  # snapshot; see __iter__
         indices = np.asarray(self._rank_indices(), dtype=np.int64)
         for off in range(0, indices.size, batch_size):
             batch = indices[off:off + batch_size]
-            self.completed_num += batch.size * self.num_replicas
+            self.completed_num += batch.size * stride
             yield batch
 
     def __len__(self) -> int:

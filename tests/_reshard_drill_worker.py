@@ -1,0 +1,327 @@
+"""Drill worker for the reshard-in-place chaos test (not a test
+module).
+
+Speaks the real agent protocol against a live master with the reshard
+plane armed: registers RUNNING (the TransitionCoordinator's
+membership), heartbeats from a background thread (the watchdog's
+liveness signal), consumes data shards, and saves a format-v2
+checkpoint every step under the 4-virtual-host topology (8 forced CPU
+devices, 2 per "host"), advertising its RAM tier over ``/ckpt/shard``.
+
+Fault surface: ``DLROVER_FAULT_INJECT=node_lost@N:host=H`` SIGKILLs
+node rank H at its step N — after ``ckpt.wait()``, so the victim's
+last advertised step is durable in BOTH tiers before it dies. The
+master's heartbeat watchdog detects the loss and the coordinator cuts
+a shrink order.
+
+Survivors poll the order on the step cadence and execute it at the
+next step boundary WITHOUT process exit: re-form the rendezvous world,
+rebuild the mesh, re-target the checkpointer at the 3-host topology,
+and migrate state through the tiered v2 loader — own RAM (``local``),
+surviving peers over HTTP (``peer``), the store for the dead rank's
+pieces (``store``) — then re-arm the data plane and report
+migrated/completed. ``MIGRATED`` lines carry the restored step plus a
+sha256 of the restored arrays so the test can prove every survivor
+landed on the SAME bit-identical state.
+
+``DRILL_RESHARD_REFUSE=1`` makes this rank refuse the order instead
+(reports ``aborted``): the coordinator broadcasts the abort and every
+survivor falls back to the restart-the-world path (``FALLBACK`` line,
+rc 7) — the fallback drill's surface.
+"""
+
+import argparse
+import hashlib
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+FALLBACK_RC = 7
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master_addr", required=True)
+    p.add_argument("--node_id", type=int, required=True)
+    p.add_argument("--n_nodes", type=int, default=4)
+    p.add_argument("--out", required=True)
+    p.add_argument("--store_dir", required=True)
+    p.add_argument("--ram_dir", required=True)
+    p.add_argument("--dataset_size", type=int, default=96)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--shard_secs", type=float, default=0.05)
+    args = p.parse_args()
+
+    from dlrover_tpu.common.log import set_process_index
+
+    set_process_index(args.node_id)
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.sharding.client import ShardingClient
+    from dlrover_tpu.checkpoint import peer
+    from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+    from dlrover_tpu.fault_tolerance.injection import FaultInjector
+    from dlrover_tpu.reshard import MeshTransition
+    from dlrover_tpu.reshard.migrate import migrate_from_checkpoint
+    from dlrover_tpu.telemetry import goodput, record
+    from dlrover_tpu.telemetry.http import MetricsServer
+    from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+    led = goodput.install()
+    restart_count = int(os.environ.get(NodeEnv.RESTART_COUNT, "0") or 0)
+    refuse = os.environ.get("DRILL_RESHARD_REFUSE", "") == "1"
+
+    out = open(args.out, "a", buffering=1)
+
+    def emit(line: str):
+        out.write(line + "\n")
+        print(f"[worker {args.node_id}] {line}", flush=True)
+
+    emit(f"PID {os.getpid()} {restart_count}")
+
+    devs = jax.devices()
+    assert len(devs) == 8, "drill needs 8 forced host devices"
+    mesh = Mesh(np.array(devs), ("dp",))
+
+    def proc_of_device(n_procs):
+        # contiguous balanced partition of the 8 devices into n_procs
+        # virtual hosts ({0:[0,1,2],1:[3,4,5],2:[6,7]} for 3)
+        return lambda d: d.id * n_procs // len(devs)
+
+    def state_for(step: int):
+        w = np.arange(32, dtype=np.float32).reshape(8, 4) + step
+        return {
+            "w": jax.device_put(w, NamedSharding(mesh, P("dp"))),
+            "step": step,
+        }
+
+    def digest_of(state) -> str:
+        h = hashlib.sha256()
+        h.update(np.asarray(state["w"]).tobytes())
+        h.update(str(int(state["step"])).encode())
+        return h.hexdigest()[:16]
+
+    client = MasterClient(
+        args.master_addr, node_id=args.node_id, node_type="worker",
+    )
+    client.update_node_status("running", "", restart_count)
+    injector = FaultInjector.from_env(role="worker")
+    mt = MeshTransition.from_env(client)
+    assert mt is not None, "drill needs the reshard plane armed"
+
+    # background heartbeats: the watchdog must keep seeing survivors
+    # alive through rendezvous waits, WAIT polls, and the migration
+    stop_hb = threading.Event()
+
+    def heartbeat_loop():
+        while not stop_hb.wait(0.5):
+            try:
+                client.report_heartbeat()
+            except Exception:
+                pass
+
+    threading.Thread(target=heartbeat_loop, daemon=True,
+                     name="drill-heartbeat").start()
+
+    srv = None
+    ckpt = None
+
+    def build_ckpt(proc_index, n_procs):
+        c = FlashCheckpointer(
+            args.store_dir,
+            ram_dir=args.ram_dir,
+            persist_interval=1,
+            max_ram_keep=64,
+            max_persist_keep=64,
+            commit_timeout=8.0,
+            use_orbax=False,
+            stage="sync",
+            process_index=proc_index,
+            n_processes=n_procs,
+            proc_of_device=proc_of_device(n_procs),
+            peer_registry=peer.PeerRegistry(
+                client, proc_index,
+                f"http://127.0.0.1:{srv.port}" if srv else "",
+            ),
+        )
+        return c
+
+    def rendezvous(tag: str) -> int:
+        client.join_rendezvous(args.node_id, 1)
+        deadline = time.monotonic() + 60
+        while True:
+            rdzv_round, _, world = client.get_comm_world(
+                RendezvousName.TRAINING, args.node_id
+            )
+            if world and args.node_id in world:
+                record("rendezvous.joined", round=rdzv_round,
+                       node=args.node_id)
+                emit(f"{tag} {rdzv_round}")
+                return rdzv_round
+            if time.monotonic() > deadline:
+                emit(f"ERROR {tag} timeout")
+                raise TimeoutError(tag)
+            time.sleep(0.2)
+
+    client.report_rdzv_params(
+        min_nodes=1, max_nodes=args.n_nodes, waiting_timeout=0.5,
+        node_unit=1,
+    )
+    rendezvous("ROUND")
+
+    ckpt = build_ckpt(args.node_id, args.n_nodes)
+    srv = MetricsServer(port=0, shard_provider=ckpt.shard_provider())
+    srv.start()
+    # the registry built before the server knew its port: re-wire it
+    ckpt._peer_registry = peer.PeerRegistry(
+        client, args.node_id, f"http://127.0.0.1:{srv.port}"
+    )
+
+    # lookahead=0 / fetch_batch=1: the victim dies holding exactly its
+    # in-flight shard, which the coordinator's ledger rebalance
+    # requeues exactly-once
+    sharding = ShardingClient(
+        dataset_name="reshard-drill",
+        batch_size=args.batch_size,
+        num_epochs=1,
+        dataset_size=args.dataset_size,
+        shuffle=False,
+        num_minibatches_per_shard=1,
+        master_client=client,
+        fetch_batch=1,
+        lookahead=0,
+    )
+
+    step = 0
+    cur = state_for(0)
+
+    def execute_transition(order) -> bool:
+        """The in-process mesh transition; False aborts into fallback."""
+        nonlocal ckpt, mesh, cur, step
+        t0 = time.time()
+        new_index = order.new_index(args.node_id)
+        emit(f"ADOPT {order.id} {new_index} {order.world_size}")
+        if refuse:
+            # let every other survivor adopt the shrink broadcast
+            # first: the abort overwrites the single KV order key, and
+            # the fallback drill wants all of them mid-transition when
+            # the abort lands
+            time.sleep(2.0)
+            mt.abort(order, "drill refusal")
+            return False
+        # 1. re-form the collective world among survivors
+        rendezvous("REFORMED")
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        # 2. re-target the checkpointer at the new topology; the
+        # restore step is the newest store-COMMITted step — the only
+        # tier that can still serve the dead rank's rows (its RAM
+        # server died with it), and deterministic across survivors
+        # because a commit needs every OLD rank's upload, so none can
+        # land after the loss
+        registry = peer.PeerRegistry(
+            client, new_index, f"http://127.0.0.1:{srv.port}"
+        )
+        from dlrover_tpu.trainer import ckpt_store
+        avail = ckpt_store.available_steps(
+            ckpt_store.get_store(args.store_dir), new_index
+        )
+        if not avail:
+            mt.abort(order, "no committed step to migrate from")
+            return False
+        target_step = max(avail)
+        old = ckpt
+        ckpt = build_ckpt(new_index, order.world_size)
+        ckpt._peer_registry = registry
+        old.close()
+        # 3. migrate state through the tiered v2 loader
+        target = {
+            "w": jax.device_put(
+                np.zeros((8, 4), np.float32),
+                NamedSharding(mesh, P("dp")),
+            ),
+            "step": 0,
+        }
+        state, got, stats = migrate_from_checkpoint(
+            ckpt, target=target, step=target_step
+        )
+        if state is None or got != target_step:
+            mt.abort(order, f"migration found {got}, "
+                            f"wanted {target_step}")
+            return False
+        ok = bool(np.array_equal(
+            np.asarray(state["w"]), np.asarray(state_for(got)["w"])
+        ))
+        cur, step = state, int(got)
+        dur = time.time() - t0
+        if mt.note_migrated(order, stats, duration_s=dur) != "ok":
+            return False
+        emit(f"MIGRATED {got} {digest_of(state)} "
+             f"{'ok' if ok else 'STATE_MISMATCH'} "
+             f"local={stats.get('local', 0)} peer={stats.get('peer', 0)} "
+             f"store={stats.get('store', 0)} "
+             f"mismatch={stats.get('digest_mismatch', 0)}")
+        # 4. re-arm the data plane under the new geometry (record-based
+        # completion accounting keeps the in-flight shard exactly-once)
+        sharding.resize(args.batch_size)
+        if mt.complete(order) != "ok":
+            return False
+        emit(f"TRANSITION {order.id} {dur * 1000:.1f}")
+        return True
+
+    while True:
+        mt.poll_order()
+        if mt.fallback:
+            # the transition aborted: take the restart-the-world path
+            # this process always had (exit; the harness relaunches)
+            emit("FALLBACK")
+            led.close()
+            return FALLBACK_RC
+        if mt.excluded:
+            emit("EXCLUDED")
+            break
+        order = mt.pop_pending()
+        if order is not None and not execute_transition(order):
+            continue  # fallback/abort surfaces on the next poll
+        shard = sharding.fetch_shard(poll_interval=0.2, max_wait=120.0)
+        if shard is None:
+            break
+        time.sleep(args.shard_secs)
+        step += 1
+        cur = state_for(step)
+        led.on_step()
+        ckpt.save(step, cur, durable=True, force_persist=True)
+        # both tiers durable BEFORE the injector can kill us: the
+        # victim's last save is then always in the store (its upload
+        # lands inside wait(); the step COMMITs once every peer
+        # passes it) so its rows stay restorable after it dies
+        ckpt.wait()
+        if injector is not None:
+            # the victim dies HERE — after its save is durable, before
+            # its in-flight shard completes, so the ledger rebalance
+            # has real work to requeue exactly-once
+            injector.maybe_inject(step)
+        assert sharding._current_task is not None
+        task_id = sharding._current_task.task_id
+        if sharding.report_task_done(task_id):
+            emit(f"SHARD {shard.start} {shard.end}")
+        client.report_global_step(step)
+
+    emit(f"STEPS {step}")
+    snap = led.close()
+    client.report_goodput(final=True)
+    emit(f"ELAPSED {snap['elapsed_s']:.3f}")
+    emit("DONE")
+    ckpt.close()
+    srv.stop()
+    client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,29 @@ class TestFaultInjectionSpec:
         inj.maybe_inject(1)
         assert time.monotonic() - t0 >= 0.1
 
+    def test_parse_node_lost_and_join(self):
+        faults = parse_spec("node_lost@8:host=2, node_join@12")
+        kinds = [(f.kind, f.step, f.arg) for f in faults]
+        assert kinds == [
+            ("node_lost", 8, "host=2"), ("node_join", 12, ""),
+        ]
+
+    def test_node_lost_host_scoping(self):
+        # host=H keeps the kill on exactly one rank of a shared spec
+        inj_hit = FaultInjector("node_lost@8:host=2", node_rank=2)
+        inj_miss = FaultInjector("node_lost@8:host=2", node_rank=1)
+        assert [f.kind for f in inj_hit._faults] == ["node_lost"]
+        assert inj_miss._faults == []
+
+    def test_node_join_is_a_marker(self, capsys):
+        # no signal, no exception: the drill harness launches the
+        # joiner on this line
+        inj = FaultInjector("node_join@3")
+        inj.maybe_inject(3)
+        assert "INJECTED NODE JOIN at step 3" in capsys.readouterr().out
+        inj.maybe_inject(4)  # fired once, never again
+        assert "NODE JOIN" not in capsys.readouterr().out
+
     def test_remote_kv_injection_consumed(self):
         class FakeClient:
             def __init__(self):

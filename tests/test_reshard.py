@@ -1,0 +1,467 @@
+"""Unit tests for the reshard plane (dlrover_tpu/reshard/).
+
+Covers the three layers in isolation: the TransitionOrder wire
+format, the master-side TransitionCoordinator state machine (cut /
+complete / abort / budget / fallback), and the worker-side
+MeshTransition adopt-exactly-once executor plus the migrate stats
+vocabulary. The end-to-end path (real master, real SIGKILL) lives in
+tests/test_reshard_drill.py.
+"""
+
+import numpy as np
+import pytest
+
+import dlrover_tpu.telemetry as T
+from dlrover_tpu.common.comm import ReshardResponse
+from dlrover_tpu.reshard import (
+    KIND_ABORT,
+    KIND_GROW,
+    KIND_SHRINK,
+    TRANSITION_ORDER_KEY,
+    MeshTransition,
+    TransitionCoordinator,
+    TransitionOrder,
+    reshard_enabled,
+    reshard_opted_in,
+)
+from dlrover_tpu.reshard.migrate import (
+    empty_stats,
+    merge_stats,
+    migrate_from_checkpoint,
+    reshard_arrays,
+)
+from dlrover_tpu.telemetry.journal import EventJournal
+
+
+@pytest.fixture(autouse=True)
+def _fresh_journal():
+    jr = T.set_default_journal(EventJournal(None))
+    yield jr
+    T.set_default_journal(EventJournal(None))
+
+
+def _kinds(journal, prefix="reshard"):
+    return [e["kind"] for e in journal.events(prefix)]
+
+
+class FakeKV:
+    def __init__(self):
+        self.data = {}
+
+    def set(self, key, value):
+        self.data[key] = value
+
+    def get(self, key):
+        return self.data.get(key, b"")
+
+
+class FakeTaskManager:
+    def __init__(self, requeued=3):
+        self.requeued = requeued
+        self.calls = []
+
+    def relinquish_tasks(self, node_type, rank):
+        self.calls.append((node_type, rank))
+        return self.requeued
+
+
+class FakeGoodput:
+    def __init__(self):
+        self.faults = []
+        self.recovered = []
+
+    def note_fault(self, cause="", node_id=None):
+        self.faults.append((cause, node_id))
+
+    def mark_recovered(self, cause=""):
+        self.recovered.append(cause)
+
+
+def _coordinator(kv=None, **kw):
+    kw.setdefault("max_transitions", 8)
+    kw.setdefault("abort_timeout", 120.0)
+    return TransitionCoordinator(kv or FakeKV(), **kw)
+
+
+def _last_order(kv):
+    return TransitionOrder.from_json(kv.data[TRANSITION_ORDER_KEY])
+
+
+# ---------------------------------------------------------------- wire format
+
+
+class TestTransitionOrder:
+    def test_json_round_trip(self):
+        order = TransitionOrder(
+            id=3, kind=KIND_SHRINK, step=120, old_world_size=4,
+            world_size=3, survivors=[0, 1, 3], lost=[2],
+            reason="heartbeat timeout",
+        )
+        back = TransitionOrder.from_json(order.to_json())
+        assert back == order
+
+    def test_unknown_fields_are_dropped(self):
+        raw = (b'{"id": 7, "kind": "grow", "survivors": [0, 1],'
+               b' "joined": [1], "from_the_future": true}')
+        order = TransitionOrder.from_json(raw)
+        assert order.id == 7 and order.kind == KIND_GROW
+        assert not hasattr(order, "from_the_future")
+
+    def test_missing_fields_default(self):
+        order = TransitionOrder.from_json(b'{"id": 1}')
+        assert order.survivors == [] and order.aborted_id == 0
+
+    def test_non_object_payload_rejected(self):
+        with pytest.raises(ValueError):
+            TransitionOrder.from_json(b'[1, 2, 3]')
+
+    def test_new_index_is_position_in_survivors(self):
+        order = TransitionOrder(
+            id=1, kind=KIND_SHRINK, survivors=[0, 1, 3], lost=[2]
+        )
+        assert order.new_index(0) == 0
+        assert order.new_index(3) == 2
+        assert order.new_index(2) is None  # the shed rank
+        assert order.new_index(9) is None
+
+
+# ------------------------------------------------------------ env three-state
+
+
+class TestEnvGates:
+    def test_master_opt_in_requires_explicit_flag(self, monkeypatch):
+        monkeypatch.delenv("DLROVER_TPU_RESHARD", raising=False)
+        assert not reshard_opted_in()
+        assert reshard_enabled()  # workers poll by default
+        monkeypatch.setenv("DLROVER_TPU_RESHARD", "1")
+        assert reshard_opted_in() and reshard_enabled()
+        monkeypatch.setenv("DLROVER_TPU_RESHARD", "0")
+        assert not reshard_opted_in() and not reshard_enabled()
+
+    def test_from_env_disabled(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESHARD", "0")
+        assert MeshTransition.from_env(None) is None
+        monkeypatch.delenv("DLROVER_TPU_RESHARD", raising=False)
+        assert MeshTransition.from_env(None) is not None
+
+
+# -------------------------------------------------------------- coordinator
+
+
+class TestTransitionCoordinator:
+    def test_lost_member_cuts_a_shrink_order(self, _fresh_journal):
+        kv = FakeKV()
+        coord = _coordinator(kv)
+        for r in range(4):
+            coord.note_node_running(r)
+        order = coord.note_node_lost(2, reason="heartbeat timeout")
+        assert order is not None and order.kind == KIND_SHRINK
+        assert order.survivors == [0, 1, 3] and order.lost == [2]
+        assert order.old_world_size == 4 and order.world_size == 3
+        # the order is on the wire, verbatim
+        assert _last_order(kv) == order
+        assert _kinds(_fresh_journal) == [
+            "reshard.detected", "reshard.ordered", "reshard.rebalanced",
+        ]
+
+    def test_unknown_rank_takes_the_restart_path(self):
+        coord = _coordinator()
+        coord.note_node_running(0)
+        assert coord.note_node_lost(7) is None
+
+    def test_min_world_guard(self):
+        coord = _coordinator(min_world=2)
+        coord.note_node_running(0)
+        coord.note_node_running(1)
+        assert coord.note_node_lost(1) is None
+
+    def test_ledger_rebalanced_exactly_once(self, _fresh_journal):
+        tm = FakeTaskManager(requeued=5)
+        coord = _coordinator(task_manager=tm)
+        for r in range(3):
+            coord.note_node_running(r)
+        coord.note_node_lost(1)
+        assert tm.calls == [("worker", 1)]
+        (evt,) = _fresh_journal.events("reshard.rebalanced")
+        assert evt["data"]["requeued"] == 5
+
+    def test_completion_requires_every_survivor(self, _fresh_journal):
+        goodput = FakeGoodput()
+        coord = _coordinator(goodput=goodput)
+        for r in range(4):
+            coord.note_node_running(r)
+        order = coord.note_node_lost(2)
+        assert goodput.faults == [("reshard", 2)]
+        for phase in ("adopted", "migrated", "completed"):
+            assert coord.note_worker_phase(0, order.id, phase) == "ok"
+        assert coord.active_order is not None  # 1 and 3 still pending
+        assert coord.note_worker_phase(1, order.id, "completed") == "ok"
+        assert coord.note_worker_phase(3, order.id, "completed") == "ok"
+        assert coord.active_order is None
+        assert coord.world == [0, 1, 3]
+        assert coord.transitions_done == 1
+        assert goodput.recovered == ["reshard"]
+        assert "reshard.completed" in _kinds(_fresh_journal)
+
+    def test_stale_order_id_is_rejected(self):
+        coord = _coordinator()
+        for r in range(3):
+            coord.note_node_running(r)
+        order = coord.note_node_lost(2)
+        assert coord.note_worker_phase(0, order.id + 1, "adopted") == "stale"
+        # and with no open transition everything is stale
+        coord.abort("test")
+        assert coord.note_worker_phase(0, order.id, "completed") == "stale"
+
+    def test_second_casualty_aborts_into_fallback(self, _fresh_journal):
+        kv = FakeKV()
+        fallbacks = []
+        coord = _coordinator(kv, fallback_fn=fallbacks.append)
+        for r in range(4):
+            coord.note_node_running(r)
+        order = coord.note_node_lost(2)
+        # a SURVIVOR of the open order dies: undecidable remap
+        assert coord.note_node_lost(1) is None
+        assert coord.active_order is None
+        assert fallbacks == [order]
+        abort = _last_order(kv)
+        assert abort.kind == KIND_ABORT and abort.aborted_id == order.id
+        assert abort.id > order.id  # fresh id: adopted exactly-once too
+        assert "reshard.aborted" in _kinds(_fresh_journal)
+        # the lost rank left the membership either way
+        assert 2 not in coord.world
+
+    def test_worker_refusal_aborts(self):
+        fallbacks = []
+        coord = _coordinator(fallback_fn=fallbacks.append)
+        for r in range(3):
+            coord.note_node_running(r)
+        order = coord.note_node_lost(2)
+        assert coord.note_worker_phase(0, order.id, "aborted") == "abort"
+        assert coord.active_order is None and fallbacks == [order]
+
+    def test_abort_timeout_watchdog(self):
+        coord = _coordinator(abort_timeout=10.0)
+        for r in range(3):
+            coord.note_node_running(r)
+        order = coord.note_node_lost(2)
+        import time
+        coord.check_abort(now=time.time() + 5)
+        assert coord.active_order is order  # still inside the window
+        coord.check_abort(now=time.time() + 11)
+        assert coord.active_order is None
+
+    def test_budget_degrades_to_restart(self):
+        coord = _coordinator(max_transitions=1)
+        for r in range(4):
+            coord.note_node_running(r)
+        order = coord.note_node_lost(3)
+        for r in (0, 1, 2):
+            coord.note_worker_phase(r, order.id, "completed")
+        assert coord.transitions_done == 1
+        # budget spent: the next loss takes the restart path
+        assert coord.note_node_lost(2) is None
+
+    def test_aborted_attempt_spends_budget_too(self):
+        coord = _coordinator(max_transitions=1)
+        for r in range(4):
+            coord.note_node_running(r)
+        coord.note_node_lost(3)
+        coord.abort("drill")
+        assert coord.transitions_done == 1
+        # a job that keeps aborting degrades to always-restart
+        assert coord.note_node_lost(2) is None
+
+    def test_join_cuts_a_grow_order(self):
+        kv = FakeKV()
+        coord = _coordinator(kv)
+        for r in range(2):
+            coord.note_node_running(r)
+        order = coord.note_node_join(2)
+        assert order.kind == KIND_GROW and order.survivors == [0, 1, 2]
+        assert order.joined == [2] and order.world_size == 3
+        # the joiner acks too; completion needs all three
+        for r in (0, 1):
+            coord.note_worker_phase(r, order.id, "completed")
+        assert coord.active_order is not None
+        coord.note_worker_phase(2, order.id, "completed")
+        assert coord.world == [0, 1, 2]
+
+    def test_join_waits_while_a_transition_is_open(self):
+        coord = _coordinator()
+        for r in range(3):
+            coord.note_node_running(r)
+        coord.note_node_lost(2)
+        assert coord.note_node_join(5) is None
+
+
+# ------------------------------------------------------------ worker executor
+
+
+class FakeMasterClient:
+    def __init__(self, kv=None, action="ok"):
+        self.kv = kv or FakeKV()
+        self.action = action
+        self.reports = []
+
+    def kv_store_get(self, key):
+        return self.kv.get(key)
+
+    def report_reshard(self, order_id, phase, detail=""):
+        self.reports.append((order_id, phase))
+        return ReshardResponse(action=self.action)
+
+
+def _shrink(order_id=1, survivors=(0, 2), lost=(1,)):
+    return TransitionOrder(
+        id=order_id, kind=KIND_SHRINK,
+        old_world_size=len(survivors) + len(lost),
+        world_size=len(survivors),
+        survivors=list(survivors), lost=list(lost),
+    )
+
+
+class TestMeshTransition:
+    def test_adopt_exactly_once_by_id(self, _fresh_journal):
+        client = FakeMasterClient()
+        client.kv.set(TRANSITION_ORDER_KEY, _shrink().to_json())
+        mt = MeshTransition(client, node_rank=2)
+        first = mt.poll_order()
+        assert first is not None and first.id == 1
+        # the broadcast stays on the KV store; re-polls are no-ops
+        assert mt.poll_order() is first
+        assert len(_fresh_journal.events("reshard.adopted")) == 1
+
+    def test_excluded_rank_stands_down(self):
+        client = FakeMasterClient()
+        client.kv.set(TRANSITION_ORDER_KEY, _shrink().to_json())
+        mt = MeshTransition(client, node_rank=1)  # the shed rank
+        assert mt.poll_order() is None
+        assert mt.excluded and not mt.fallback
+
+    def test_abort_cancels_the_pending_order(self, _fresh_journal):
+        client = FakeMasterClient()
+        client.kv.set(TRANSITION_ORDER_KEY, _shrink(order_id=1).to_json())
+        mt = MeshTransition(client, node_rank=0)
+        assert mt.poll_order() is not None
+        client.kv.set(TRANSITION_ORDER_KEY, TransitionOrder(
+            id=2, kind=KIND_ABORT, aborted_id=1, reason="timeout",
+        ).to_json())
+        assert mt.poll_order() is None
+        assert mt.fallback
+        assert len(_fresh_journal.events("reshard.aborted")) == 1
+
+    def test_fresh_incarnation_ignores_stale_abort(self, _fresh_journal):
+        # a relaunched process reads the abort broadcast of a
+        # transition it never participated in: falling back would
+        # loop relaunches forever — it must be ignored
+        client = FakeMasterClient()
+        client.kv.set(TRANSITION_ORDER_KEY, TransitionOrder(
+            id=2, kind=KIND_ABORT, aborted_id=1, reason="timeout",
+        ).to_json())
+        mt = MeshTransition(client, node_rank=0)
+        assert mt.poll_order() is None
+        assert not mt.fallback
+        assert _fresh_journal.events("reshard.aborted") == []
+        # ...but a LATER abort addressed to an order this incarnation
+        # adopted still falls back
+        client.kv.set(TRANSITION_ORDER_KEY, _shrink(order_id=3).to_json())
+        assert mt.poll_order() is not None
+        client.kv.set(TRANSITION_ORDER_KEY, TransitionOrder(
+            id=4, kind=KIND_ABORT, aborted_id=3, reason="refused",
+        ).to_json())
+        assert mt.poll_order() is None
+        assert mt.fallback
+
+    def test_pop_pending_clears_at_the_step_boundary(self):
+        client = FakeMasterClient()
+        client.kv.set(TRANSITION_ORDER_KEY, _shrink().to_json())
+        mt = MeshTransition(client, node_rank=0)
+        order = mt.poll_order()
+        assert mt.pop_pending() is order
+        assert mt.pending() is None
+
+    def test_bad_broadcast_never_takes_training_down(self):
+        client = FakeMasterClient()
+        client.kv.set(TRANSITION_ORDER_KEY, b"{not json")
+        mt = MeshTransition(client, node_rank=0)
+        assert mt.poll_order() is None
+
+    def test_stale_answer_flips_fallback(self):
+        client = FakeMasterClient(action="stale")
+        mt = MeshTransition(client, node_rank=0)
+        assert mt.report_phase(_shrink(), "migrated") == "stale"
+        assert mt.fallback
+
+    def test_note_migrated_journals_move_stats(self, _fresh_journal):
+        client = FakeMasterClient()
+        mt = MeshTransition(client, node_rank=0)
+        stats = merge_stats({"device": 4, "peer": 2, "bytes": 1024})
+        assert mt.note_migrated(_shrink(), stats, duration_s=0.5) == "ok"
+        (evt,) = _fresh_journal.events("reshard.migrated")
+        assert evt["data"]["device"] == 4 and evt["data"]["peer"] == 2
+        assert client.reports == [(1, "migrated")]
+
+    def test_worker_abort_reports_and_falls_back(self, _fresh_journal):
+        client = FakeMasterClient(action="abort")
+        mt = MeshTransition(client, node_rank=0)
+        mt.abort(_shrink(), "state digest mismatch")
+        assert mt.fallback
+        assert client.reports == [(1, "aborted")]
+        assert len(_fresh_journal.events("reshard.aborted")) == 1
+
+    def test_masterless_transition_still_functions(self):
+        mt = MeshTransition(None, node_rank=0)
+        assert mt.poll_order() is None
+        assert mt.report_phase(_shrink(), "completed") is None
+
+
+# ---------------------------------------------------------------- migration
+
+
+class TestMigrate:
+    def test_stats_vocabulary(self):
+        stats = empty_stats()
+        assert set(stats) == {
+            "local", "peer", "store", "device", "digest_mismatch",
+            "bytes",
+        }
+        merged = merge_stats({"peer": 1}, {"peer": 2, "bytes": 8}, None)
+        assert merged["peer"] == 3 and merged["bytes"] == 8
+
+    def test_reshard_arrays_moves_only_what_changed(self):
+        import jax
+
+        state = {"w": np.arange(8, dtype=np.float32), "step": 3}
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        new_state, stats = reshard_arrays(
+            state, {"w": sharding, "step": None}
+        )
+        assert stats["device"] == 1  # "step" was left alone
+        assert new_state["w"].sharding == sharding
+        np.testing.assert_array_equal(np.asarray(new_state["w"]),
+                                      state["w"])
+        # already in the target layout: zero-copy, zero moves
+        again, stats2 = reshard_arrays(new_state, {"w": sharding,
+                                                   "step": None})
+        assert stats2["device"] == 0 and again["w"] is new_state["w"]
+
+    def test_migrate_from_checkpoint_merges_loader_stats(self):
+        class FakeCheckpointer:
+            last_restore_stats = {"peer": 3, "store": 1, "bytes": 4096}
+
+            def restore(self, target=None, step=None):
+                return {"w": [1, 2]}, 40
+
+        state, step, stats = migrate_from_checkpoint(FakeCheckpointer())
+        assert state == {"w": [1, 2]} and step == 40
+        assert stats["peer"] == 3 and stats["store"] == 1
+
+    def test_migrate_from_checkpoint_nothing_restorable(self):
+        class EmptyCheckpointer:
+            def restore(self, target=None, step=None):
+                return None, None
+
+        state, step, stats = migrate_from_checkpoint(EmptyCheckpointer())
+        assert state is None and step is None
+        assert stats == empty_stats()

@@ -1,0 +1,350 @@
+"""Reshard-in-place chaos drill: kill 1 of 4 real processes mid-epoch
+and watch the 3 survivors resume WITHOUT a single process restart.
+
+A real master (reshard plane opted in, heartbeat watchdog armed at
+seconds-scale) serves four protocol-speaking workers
+(``_reshard_drill_worker.py``), each a virtual TPU host of 2 forced
+CPU devices saving a format-v2 checkpoint every step.
+``DLROVER_FAULT_INJECT=node_lost@6:host=2`` SIGKILLs rank 2 after its
+step-6 save is durable; the watchdog detects the silence, the
+coordinator cuts a shrink order, and every survivor executes the mesh
+transition in-process: re-rendezvous, rebuild, migrate through the
+tiered loader (own RAM / peers / store), re-arm the data plane,
+complete.
+
+Asserted: the victim dies by SIGKILL and the survivors' ORIGINAL
+processes run to rc 0 (one incarnation each — zero restarts); the
+journal tells the transition story exactly once (detected/ordered/
+rebalanced once, adopted/migrated per survivor, completed once, no
+abort, no ``scale.restart``); every survivor restored the SAME step
+with the SAME digest, bit-identical to the expected state; the shard
+ledger stays exactly-once across the resize (the victim's in-flight
+shard included); the migration pulled from all three tiers; and the
+goodput account books the outage under the ``reshard`` phase with a
+recovered fault window.
+
+The fallback drill flips one survivor to refuse the order
+(``DRILL_RESHARD_REFUSE=1``): the coordinator aborts, every survivor
+exits into the restart-the-world path (rc 7), the master re-enables
+relaunch for the lost rank, and relaunched fresh incarnations drain
+the dataset — still exactly-once — with ``reshard.aborted`` (and no
+``reshard.completed``) on the record.
+"""
+
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import subprocess  # noqa: E402
+
+from test_goodput_drill import (  # noqa: E402
+    _drill_env,
+    _killpg,
+    _master_port,
+    _tail,
+    _wait,
+)
+
+from dlrover_tpu.telemetry import goodput  # noqa: E402
+from dlrover_tpu.telemetry.goodput import Phase  # noqa: E402
+from dlrover_tpu.telemetry.journal import read_journal  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_NODES = 4
+VICTIM = 2
+KILL_STEP = 6
+DATASET_SIZE = 720
+BATCH_SIZE = 4
+SHARD_SECS = 0.2
+#: seconds of heartbeat silence before the watchdog declares a node
+#: lost — low enough to keep the drill fast, high enough that a
+#: survivor mid-migration (heartbeating from a daemon thread every
+#: 0.5s) can never be mistaken for a casualty
+HEARTBEAT_TIMEOUT = 5
+FALLBACK_RC = 7
+
+
+def _spawn_master(tmp, env, state_dir, port, tag):
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.master.main",
+        "--platform", "process", "--node_num", "0",
+        "--job_name", "reshard-drill", "--port", str(port),
+        "--state_dir", state_dir,
+        "--autoscale_interval", "600", "--check_interval", "0.2",
+        "--heartbeat_timeout", str(HEARTBEAT_TIMEOUT),
+    ]
+    return subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=open(os.path.join(tmp, f"master-{tag}.out"), "w"),
+        stderr=open(os.path.join(tmp, f"master-{tag}.err"), "w"),
+        start_new_session=True,
+    )
+
+
+def _spawn_worker(tmp, env, port, node_id, tag, store_dir, ram_dir):
+    return subprocess.Popen(
+        [sys.executable,
+         os.path.join(REPO, "tests", "_reshard_drill_worker.py"),
+         "--master_addr", f"localhost:{port}",
+         "--node_id", str(node_id),
+         "--n_nodes", str(N_NODES),
+         "--out", os.path.join(tmp, f"worker-{tag}.txt"),
+         "--store_dir", store_dir,
+         "--ram_dir", ram_dir,
+         "--dataset_size", str(DATASET_SIZE),
+         "--batch_size", str(BATCH_SIZE),
+         "--shard_secs", str(SHARD_SECS)],
+        cwd=REPO, env=env,
+        stdout=open(os.path.join(tmp, f"worker-{tag}.out"), "w"),
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+
+
+def _worker_env(env, rank, extra=None):
+    out = dict(
+        env,
+        DLROVER_TPU_NODE_RANK=str(rank),
+        DLROVER_FAULT_INJECT=f"node_lost@{KILL_STEP}:host={VICTIM}",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    out.update(extra or {})
+    return out
+
+
+def _lines(tmp, tag, key):
+    path = os.path.join(tmp, f"worker-{tag}.txt")
+    if not os.path.exists(path):
+        return []
+    return [
+        line.split()
+        for line in open(path).read().splitlines()
+        if line == key or line.startswith(key + " ")
+    ]
+
+
+def _assert_exactly_once(tmp, tags):
+    ranges = []
+    for tag in tags:
+        for parts in _lines(tmp, tag, "SHARD"):
+            ranges.append((int(parts[1]), int(parts[2])))
+    ranges.sort()
+    assert ranges, "no shards consumed at all"
+    assert ranges[0][0] == 0 and ranges[-1][1] == DATASET_SIZE, ranges
+    for (_, end), (start, _) in zip(ranges, ranges[1:]):
+        assert end == start, f"shard gap/overlap at {start}: {ranges}"
+
+
+def test_reshard_chaos_drill(tmp_path):
+    tmp = str(tmp_path)
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    store_dir = os.path.join(tmp, "store")
+    env = _drill_env(journal_path)
+    master_env = dict(env, DLROVER_TPU_RESHARD="1")
+
+    procs = {}
+    try:
+        master = _spawn_master(
+            tmp, master_env, os.path.join(tmp, "state"), 0, "1"
+        )
+        procs["master"] = master
+        port = _master_port(tmp, "1", master)
+
+        for rank in range(N_NODES):
+            procs[rank] = _spawn_worker(
+                tmp, _worker_env(env, rank), port, rank, str(rank),
+                store_dir, os.path.join(tmp, f"ram{rank}"),
+            )
+
+        # the victim dies by its own injected SIGKILL
+        rc = _wait(procs[VICTIM], 180, "victim (kill expected)", tmp,
+                   [f"worker-{VICTIM}.out", "master-1.err"])
+        assert rc == -signal.SIGKILL, (
+            f"victim exited rc={rc}, wanted SIGKILL; "
+            + _tail(tmp, f"worker-{VICTIM}.out")
+        )
+
+        # the survivors' ORIGINAL processes finish the epoch: no exit,
+        # no relaunch, no fresh incarnation — rc 0 from the pids we
+        # spawned before the fault
+        survivors = [r for r in range(N_NODES) if r != VICTIM]
+        for rank in survivors:
+            rc = _wait(procs[rank], 300, f"survivor {rank}", tmp,
+                       [f"worker-{rank}.out", "master-1.err"])
+            assert rc == 0, (
+                f"survivor {rank} exited rc={rc}; "
+                + _tail(tmp, f"worker-{rank}.out")
+            )
+        rc = _wait(master, 60, "master", tmp, ["master-1.err"])
+        assert rc == 0, _tail(tmp, "master-1.err")
+    finally:
+        for p in procs.values():
+            _killpg(p, signal.SIGTERM)
+        time.sleep(0.5)
+        for p in procs.values():
+            _killpg(p)
+
+    survivors = [r for r in range(N_NODES) if r != VICTIM]
+
+    # ---- zero process restarts: one incarnation per survivor --------
+    for rank in survivors:
+        pids = _lines(tmp, str(rank), "PID")
+        assert len(pids) == 1 and pids[0][2] == "0", pids
+        assert _lines(tmp, str(rank), "FALLBACK") == []
+        # the survivor executed the transition in-process
+        assert len(_lines(tmp, str(rank), "TRANSITION")) == 1
+
+    # ---- the journal tells the story exactly once --------------------
+    events = read_journal(journal_path)
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e.get("kind"), []).append(e)
+    assert "scale.restart" not in by_kind, by_kind.get("scale.restart")
+    assert "reshard.aborted" not in by_kind, by_kind["reshard.aborted"]
+
+    (detected,) = by_kind["reshard.detected"]
+    assert detected["data"]["node_rank"] == VICTIM
+    (ordered,) = by_kind["reshard.ordered"]
+    assert ordered["data"]["order_kind"] == "shrink"
+    assert ordered["data"]["world_size"] == N_NODES - 1
+    assert ordered["data"]["lost"] == [VICTIM]
+    (rebalanced,) = by_kind["reshard.rebalanced"]
+    # the victim died holding an in-flight shard: the ledger requeued
+    # it (exactly-once is then proven by the SHARD arithmetic below)
+    assert rebalanced["data"]["requeued"] >= 1, rebalanced
+    assert len(by_kind["reshard.adopted"]) == len(survivors)
+    (completed,) = by_kind["reshard.completed"]
+    assert completed["data"]["duration_s"] > 0.0
+
+    migrated = by_kind["reshard.migrated"]
+    assert len(migrated) == len(survivors)
+    assert {e["data"]["node_rank"] for e in migrated} == set(survivors)
+    for e in migrated:
+        assert e["data"]["digest_mismatch"] == 0, e
+    # the migration exercised every tier: shards this host kept
+    # (local), shards fetched from surviving peers' RAM over HTTP
+    # (peer), and the dead rank's shards from the store (store)
+    totals = {
+        k: sum(e["data"][k] for e in migrated)
+        for k in ("local", "peer", "store")
+    }
+    assert totals["local"] >= 1, totals
+    assert totals["peer"] >= 1, totals
+    assert totals["store"] >= 1, totals
+
+    # ---- every survivor landed on the SAME bit-identical state -------
+    migr_lines = [
+        _lines(tmp, str(rank), "MIGRATED")[0] for rank in survivors
+    ]
+    steps = {parts[1] for parts in migr_lines}
+    digests = {parts[2] for parts in migr_lines}
+    assert len(steps) == 1 and len(digests) == 1, migr_lines
+    for parts in migr_lines:
+        assert parts[3] == "ok", parts
+    # the restore step is the victim's durable kill-step save
+    assert int(next(iter(steps))) == KILL_STEP, migr_lines
+
+    # ---- the dataset completed exactly once across the resize --------
+    _assert_exactly_once(tmp, [str(r) for r in range(N_NODES)])
+
+    # ---- goodput books the outage under `reshard` --------------------
+    report = goodput.reconstruct(events)
+    job = report["job"]
+    assert job["badput_s"].get(Phase.RESHARD, 0.0) > 0.0, job
+    win = next(
+        f for f in report["faults"] if f["cause"] == Phase.RESHARD
+    )
+    assert win["node_id"] == VICTIM, win
+    assert win["recovered_ts"] and win["recovered_ts"] >= win["ts"], win
+
+
+def test_reshard_fallback_drill(tmp_path):
+    """A mid-transition refusal aborts cleanly into restart-the-world:
+    survivors exit rc 7, relaunch is re-enabled for the lost rank, and
+    fresh incarnations finish the dataset exactly-once."""
+    tmp = str(tmp_path)
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    store_dir = os.path.join(tmp, "store")
+    env = _drill_env(journal_path)
+    master_env = dict(env, DLROVER_TPU_RESHARD="1")
+
+    procs = {}
+    try:
+        master = _spawn_master(
+            tmp, master_env, os.path.join(tmp, "state"), 0, "1"
+        )
+        procs["master"] = master
+        port = _master_port(tmp, "1", master)
+
+        for rank in range(N_NODES):
+            extra = {"DRILL_RESHARD_REFUSE": "1"} if rank == 0 else None
+            procs[rank] = _spawn_worker(
+                tmp, _worker_env(env, rank, extra), port, rank,
+                f"{rank}-a", store_dir, os.path.join(tmp, f"ram{rank}"),
+            )
+
+        rc = _wait(procs[VICTIM], 180, "victim (kill expected)", tmp,
+                   [f"worker-{VICTIM}-a.out", "master-1.err"])
+        assert rc == -signal.SIGKILL, rc
+
+        # rank 0 refuses the order; the abort broadcast sends every
+        # survivor down the restart-the-world path it always had
+        survivors = [r for r in range(N_NODES) if r != VICTIM]
+        for rank in survivors:
+            rc = _wait(procs[rank], 300, f"survivor {rank} (fallback)",
+                       tmp, [f"worker-{rank}-a.out", "master-1.err"])
+            assert rc == FALLBACK_RC, (
+                f"survivor {rank} exited rc={rc}, wanted fallback "
+                f"rc={FALLBACK_RC}; " + _tail(tmp, f"worker-{rank}-a.out")
+            )
+
+        # restart the world: fresh incarnations of all four ranks
+        # (RESTART_COUNT=1 gates the injected fault off)
+        for rank in range(N_NODES):
+            procs[f"{rank}-b"] = _spawn_worker(
+                tmp,
+                _worker_env(env, rank,
+                            {"DLROVER_TPU_RESTART_COUNT": "1"}),
+                port, rank, f"{rank}-b",
+                store_dir, os.path.join(tmp, f"ram{rank}"),
+            )
+        for rank in range(N_NODES):
+            rc = _wait(procs[f"{rank}-b"], 300, f"relaunched {rank}",
+                       tmp, [f"worker-{rank}-b.out", "master-1.err"])
+            assert rc == 0, (
+                f"relaunched {rank} exited rc={rc}; "
+                + _tail(tmp, f"worker-{rank}-b.out")
+            )
+        rc = _wait(master, 60, "master", tmp, ["master-1.err"])
+        assert rc == 0, _tail(tmp, "master-1.err")
+    finally:
+        for p in procs.values():
+            _killpg(p, signal.SIGTERM)
+        time.sleep(0.5)
+        for p in procs.values():
+            _killpg(p)
+
+    events = read_journal(journal_path)
+    kinds = [e.get("kind") for e in events]
+    assert "reshard.ordered" in kinds
+    assert "reshard.aborted" in kinds
+    assert "reshard.completed" not in kinds
+    # the master re-enabled relaunch for the lost rank on abort
+    master_err = open(os.path.join(tmp, "master-1.err")).read()
+    assert "Reshard fallback: re-enabling relaunch" in master_err
+
+    # every survivor took the fallback exit; nobody restored twice
+    for rank in (0, 1, 3):
+        assert _lines(tmp, f"{rank}-a", "FALLBACK"), rank
+    # fresh incarnations never saw the stale abort as addressed to them
+    for rank in range(N_NODES):
+        assert _lines(tmp, f"{rank}-b", "FALLBACK") == [], rank
+
+    # exactly-once across the abort AND the restart
+    tags = [f"{r}-a" for r in range(N_NODES)]
+    tags += [f"{r}-b" for r in range(N_NODES)]
+    _assert_exactly_once(tmp, tags)

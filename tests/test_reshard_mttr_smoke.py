@@ -1,0 +1,34 @@
+"""Tier-1 gate on the reshard-in-place MTTR claim.
+
+Runs ``benchmarks/reshard_mttr.py --smoke`` (tiny state, one sample
+per path) and holds the PR 14 acceptance line: an in-process mesh
+transition must beat restart-the-world by >= 5x, and the migrated
+state must be exactly-once (bit-identical, zero digest mismatches).
+The measured evidence at real state sizes lives in RESHARD_r07.json
+(the full tier of the same script).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_reshard_mttr_smoke():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "reshard_mttr.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    res = json.loads(line)
+    assert set(res) == {
+        "reshard_mttr_ms", "restart_mttr_ms", "speedup", "exactly_once"
+    }
+    assert res["exactly_once"] is True
+    assert res["reshard_mttr_ms"] > 0
+    assert res["speedup"] >= 5.0, res

@@ -341,6 +341,59 @@ def test_lookahead_window_drains_exactly_once():
     sc.stop()
 
 
+def test_resize_mid_shard_keeps_exactly_once():
+    """Completion accounting straddles a reshard resize: batch geometry
+    changes with the head shard in flight. Counted in records, the head
+    task completes exactly when its records are consumed — a minibatch
+    counter recomputed at the new size would report it done with the
+    tail unconsumed (lost to exactly-once if the worker then dies)."""
+    mc = LocalMasterClient()
+    sc = ShardingClient(
+        dataset_name="resize-ds", batch_size=8, dataset_size=32,
+        num_minibatches_per_shard=2, master_client=mc,
+    )
+    shard = sc.fetch_shard(max_wait=5.0)
+    assert shard.end - shard.start == 16
+    assert not sc.report_batch_done()  # 8 of 16 records
+    sc.resize(batch_size=4)  # mesh transition re-arms the geometry
+    assert not sc.report_batch_done()  # 12 of 16
+    # 16 of 16: done exactly here. Minibatch counting would see 3 of
+    # ceil(16/4)=4 "minibatches" and hold the fully-consumed task — a
+    # worker death now would requeue it and replay 16 records.
+    assert sc.report_batch_done()
+    shard2 = sc.fetch_shard(max_wait=5.0)
+    assert shard2.end - shard2.start == 16
+    for done in (False, False, False, True):  # clean slate: 4x4 records
+        assert sc.report_batch_done() is done
+    assert sc.fetch_shard(max_wait=5.0) is None
+    sc.stop()
+
+
+def test_resize_mid_chunk_index_stream_exactly_once():
+    """IndexShardingClient with its consumer cursor mid-chunk across a
+    resize: every index of the dataset is handed out exactly once and
+    every shard completion is accepted by the master's ledger."""
+    mc = LocalMasterClient()
+    sc = IndexShardingClient(
+        dataset_name="resize-idx-ds", batch_size=6, dataset_size=48,
+        num_minibatches_per_shard=2, master_client=mc,
+    )
+    seen = []
+    batch = sc.fetch_batch_indices(4)  # cursor now mid-chunk
+    seen.extend(batch.tolist())
+    assert sc.report_batch_done(batch_size=batch.size) in (True, False)
+    sc.resize(batch_size=12)
+    while True:
+        batch = sc.fetch_batch_indices()
+        if batch is None:
+            break
+        seen.extend(batch.tolist())
+        sc.report_batch_done(batch_size=batch.size)
+    assert sorted(seen) == list(range(48))
+    assert not sc._pending_tasks  # every shard reported done
+    sc.stop()
+
+
 def test_lookahead_surfaces_fetch_errors():
     class _Exploding(LocalMasterClient):
         def get_tasks(self, *a, **kw):

@@ -772,6 +772,42 @@ def test_serve_event_names_are_the_canonical_set():
     )
 
 
+#: the full vocabulary of the reshard-in-place transition plane
+#: (ISSUE 14): detection + order lifecycle on the master
+#: (reshard/coordinator.py), adopt/migrate on the worker
+#: (reshard/transition.py). goodput's EVENT_RULES, the reshard drill's
+#: journal asserts and docs/ELASTICITY.md / docs/TELEMETRY.md all match
+#: these names literally — an addition or rename must land everywhere
+#: in the same PR. The closed vocabulary is deliberate: no
+#: reshard.rpc_fallback — the worker's report_reshard RPC degrades
+#: through anomaly.rpc_fallback (rpc="report_reshard") like the other
+#: supervised calls.
+_RESHARD_EVENTS = {
+    "reshard.detected",
+    "reshard.ordered",
+    "reshard.adopted",
+    "reshard.migrated",
+    "reshard.rebalanced",
+    "reshard.completed",
+    "reshard.aborted",
+}
+
+
+def test_reshard_event_names_are_the_canonical_set():
+    """The reshard.* journal vocabulary is closed: every record() of a
+    reshard event uses exactly one of the documented names, and every
+    documented name has a live emitter."""
+    found = {
+        value
+        for _, _, value, kind in _record_call_literals()
+        if kind == "literal" and value.startswith("reshard.")
+    }
+    assert found == _RESHARD_EVENTS, (
+        f"unexpected: {sorted(found - _RESHARD_EVENTS)}, "
+        f"missing emitters for: {sorted(_RESHARD_EVENTS - found)}"
+    )
+
+
 #: the full vocabulary of the control-plane fan-in path (ISSUE 12):
 #: master-side backpressure + journal-lane recovery (control.*) and
 #: the agent-side coalesced reporter (report.*). The swarm bench, the

@@ -119,6 +119,43 @@ def test_sampler_resume_after_world_change():
     assert remaining[0] == 40
 
 
+def test_sampler_set_world_shrink_exactly_once():
+    """4 -> 3 shrink mid-epoch: indices consumed before the resize plus
+    indices consumed by the shrunken world cover the dataset exactly
+    once (the reshard ledger-rebalance contract)."""
+    seen = []
+    old = [ElasticDistributedSampler(24, 4, r, shuffle=False)
+           for r in range(4)]
+    for s in old:
+        batch = next(s.iter_batches(3))  # one in-flight batch per rank
+        seen.extend(batch.tolist())
+    state = old[0].state_dict()
+    assert state["completed_num"] == 12
+    for r in range(3):
+        s = ElasticDistributedSampler(24, 3, r, shuffle=False)
+        s.load_state_dict(state, num_replicas=3, rank=r)
+        for batch in s.iter_batches(3):
+            seen.extend(batch.tolist())
+    assert sorted(seen) == list(range(24))
+
+
+def test_sampler_live_iterator_keeps_old_stride_across_set_world():
+    """A set_world during iteration must not advance completed_num at
+    the NEW stride for indices partitioned under the OLD world — that
+    would mark unconsumed peers' samples complete (shrink) or replay
+    consumed ones (grow)."""
+    s = ElasticDistributedSampler(40, 4, 0, shuffle=False)
+    batches = s.iter_batches(2)
+    next(batches)
+    assert s.completed_num == 8  # 2 indices x old stride 4
+    s.set_world(2, 0)
+    next(batches)  # same live iterator: old-geometry indices
+    assert s.completed_num == 16  # still counted at stride 4
+    # a FRESH iterator partitions the remainder under the new world
+    fresh = np.concatenate(list(s.iter_batches(100)))
+    assert fresh[0] == 16 and fresh.size == (40 - 16) // 2
+
+
 def test_sampler_shuffle_is_epoch_deterministic():
     a = ElasticDistributedSampler(20, 2, 0, shuffle=True, seed=7)
     b = ElasticDistributedSampler(20, 2, 0, shuffle=True, seed=7)
