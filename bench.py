@@ -156,7 +156,8 @@ def _guard_backend_discovery(metric: str, unit: str,
         }))
         raise SystemExit(2)
 
-    t = threading.Thread(target=probe, daemon=True)
+    t = threading.Thread(target=probe, daemon=True,
+                         name="bench-device-probe")
     t.start()
     if not done.wait(timeout_s):
         bail(

@@ -540,7 +540,7 @@ def launch_agent(config: ElasticLaunchConfig,
         # covers a bad replacement once it trains.
         logger.info(
             "Replacement node (relaunch %s): skipping pre-flight "
-            "network check", os.getenv(NodeEnv.RESTART_COUNT),
+            "network check", os.getenv(NodeEnv.RESTART_COUNT, "0"),
         )
     elif config.network_check:
         from dlrover_tpu.agent.elastic.network_check import (

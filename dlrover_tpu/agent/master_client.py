@@ -119,10 +119,12 @@ class ConnectionSupervisor:
         """Register an idempotent re-hello, run (in registration order)
         after every reconnect BEFORE supervised calls retry. Hooks may
         freely call supervised RPCs — supervision is bypassed inside."""
-        self._hooks[name] = fn
+        with self._state_lock:
+            self._hooks[name] = fn
 
     def remove_hook(self, name: str):
-        self._hooks.pop(name, None)
+        with self._state_lock:
+            self._hooks.pop(name, None)
 
     # -------------------------------------------------------------- core
 

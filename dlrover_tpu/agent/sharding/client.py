@@ -209,7 +209,7 @@ class ShardingClient:
             self._prefetch_gauge.set(len(self._ready))
             self._buf_cond.notify_all()
 
-    def _pop_ready(self):
+    def _pop_ready_locked(self):
         """Pop one buffered task, or None; caller holds _buf_cond."""
         if not self._ready:
             return None
@@ -291,7 +291,7 @@ class ShardingClient:
                                               max_wait)
         while True:
             with self._buf_cond:
-                task = self._pop_ready()
+                task = self._pop_ready_locked()
             if task is not None:
                 return self._deliver(task)
             if self._drained:
@@ -322,7 +322,7 @@ class ShardingClient:
     def _fetch_from_lookahead(self, poll_interval, deadline, max_wait):
         with self._buf_cond:
             while True:
-                task = self._pop_ready()
+                task = self._pop_ready_locked()
                 if task is not None:
                     break
                 if self._fetch_error is not None:

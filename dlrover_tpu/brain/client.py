@@ -409,7 +409,7 @@ def _token_from_env() -> Optional[str]:
                 return f.read().strip() or None
         except OSError as e:
             logger.warning("brain token file unreadable: %s", e)
-    return os.getenv("DLROVER_TPU_BRAIN_TOKEN") or None
+    return os.getenv("DLROVER_TPU_BRAIN_TOKEN", "") or None
 
 
 class BrainReporter(StatsReporter):

@@ -150,8 +150,12 @@ class GenericRpcClient:
 
     def call(self, method: str, message, timeout: Optional[float] = None):
         self._ensure_channel()
+        # snapshot under the lock, dial outside it: the RPC itself must
+        # never run under the channel lock (blocking-under-lock)
+        with self._lock:
+            fn = self._callable
         payload = _pack_call(method, message)
-        response = self._callable(payload, timeout=timeout or self.timeout)
+        response = fn(payload, timeout=timeout or self.timeout)
         return comm.deserialize(response)
 
     def close(self):

@@ -32,8 +32,8 @@ def current_process_index() -> Optional[int]:
     global _process_index
     with _proc_lock:
         if _process_index is None:
-            raw = os.getenv("DLROVER_TPU_PROCESS_ID")
-            if raw is not None and raw.strip().lstrip("-").isdigit():
+            raw = os.getenv("DLROVER_TPU_PROCESS_ID", "")
+            if raw.strip().lstrip("-").isdigit():
                 _process_index = int(raw)
         return _process_index
 

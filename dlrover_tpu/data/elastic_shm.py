@@ -104,7 +104,9 @@ class ElasticShmDataLoader:
     ):
         from dlrover_tpu.common.constants import NodeEnv
 
-        master_addr = master_addr or os.environ.get(NodeEnv.MASTER_ADDR)
+        master_addr = (master_addr
+                       or os.environ.get(NodeEnv.MASTER_ADDR, "")
+                       or None)
         producer = _ShardedProducer(
             batch_fn=batch_fn,
             dataset_name=dataset_name,

@@ -80,7 +80,8 @@ class ShmDataLoader:
         for p in self._procs:
             p.start()
         self._watcher = threading.Thread(
-            target=self._close_when_done, daemon=True
+            target=self._close_when_done, daemon=True,
+            name="shm-ring-watcher",
         )
         self._watcher.start()
 
@@ -141,7 +142,9 @@ class DevicePrefetch:
         self._queue: "Queue" = Queue(maxsize=depth)
         self._done = object()
         self._error: Optional[BaseException] = None
-        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread = threading.Thread(
+            target=self._fill, daemon=True, name="prefetch-fill"
+        )
         self._thread.start()
 
     def _put_device(self, batch):

@@ -102,7 +102,8 @@ class RendezvousManager(ABC):
         master's state journal persists it so rounds stay monotonic
         across a master restart (the round number keys the coordinator
         election in the KV store; a reset would reuse stale entries)."""
-        self._round_listener = listener
+        with self._lock:
+            self._round_listener = listener
 
     def restore_round(self, rdzv_round: int):
         """Master-restart restore: resume the round counter; membership
@@ -110,7 +111,7 @@ class RendezvousManager(ABC):
         with self._lock:
             self._rdzv_round = max(self._rdzv_round, int(rdzv_round))
 
-    def _notify_round(self):
+    def _notify_round_locked(self):
         if self._round_listener is None:
             return
         try:
@@ -150,14 +151,16 @@ class RendezvousManager(ABC):
                 pass  # best-effort persistence; never fail the report
 
     def get_rdzv_round(self) -> int:
-        return self._rdzv_round
+        with self._lock:
+            return self._rdzv_round
 
     def add_alive_node(self, node_id: int):
-        self._alive_nodes.add(node_id)
+        with self._lock:
+            self._alive_nodes.add(node_id)
 
     def remove_alive_node(self, node_id: int):
-        self._alive_nodes.discard(node_id)
         with self._lock:
+            self._alive_nodes.discard(node_id)
             if node_id in self._waiting_nodes:
                 del self._waiting_nodes[node_id]
 
@@ -182,7 +185,7 @@ class RendezvousManager(ABC):
             # prunes the node (servicer.rpc_update_node_status), which lets
             # num_nodes_waiting see a spare as a REPLACEMENT for it
             self._alive_nodes.add(node_rank)
-        return self._rdzv_round
+            return self._rdzv_round
 
     def num_nodes_waiting(self) -> int:
         """Number of nodes waiting for a NEW round. Nonzero signals running
@@ -224,7 +227,7 @@ class RendezvousManager(ABC):
                 return max(1, len(self._waiting_nodes))
             return 0
 
-    def _check_rdzv_completed(self):
+    def _check_rdzv_completed_locked(self):
         """Completion rule (parity: rdzv_manager.py:106): complete when
         max_nodes joined, or min_nodes joined and waiting_timeout elapsed
         since last join; truncate world to a node_unit multiple.
@@ -270,7 +273,7 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
 
     def get_comm_world(self, node_rank):
         with self._lock:
-            world = self._check_rdzv_completed()
+            world = self._check_rdzv_completed_locked()
             if world is not None:
                 # every completion starts a NEW round, even with unchanged
                 # membership: restarted processes must re-elect a live
@@ -287,7 +290,7 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
                     "training", self._rdzv_round, self._rdzv_nodes,
                     self._start_rdzv_ts,
                 )
-                self._notify_round()
+                self._notify_round_locked()
             # a node that has re-joined is waiting for the NEXT round —
             # never hand it the stale world it used to belong to
             if (
@@ -337,7 +340,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
 
     def get_comm_world(self, node_rank):
         with self._lock:
-            world = self._check_rdzv_completed()
+            world = self._check_rdzv_completed_locked()
             if world is not None:
                 self._rdzv_round += 1
                 self._rdzv_nodes = dict(sorted(world.items()))
@@ -345,7 +348,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                     "network_check", self._rdzv_round,
                     self._rdzv_nodes, self._start_rdzv_ts,
                 )
-                self._notify_round()
+                self._notify_round_locked()
                 # bounded history, NOT a cycle clear: a new cohort's
                 # check (replacement/restored nodes probing each
                 # other) must not wipe other nodes' verdicts — a

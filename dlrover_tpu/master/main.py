@@ -63,6 +63,12 @@ def _master_host(args, platform: str) -> str:
 
 
 def run(args) -> int:
+    # arm the lock-order watchdog FIRST (no-op unless
+    # DLROVER_TPU_LOCKWATCH=1): the wrap only catches locks created
+    # after install, so it must precede master construction
+    from dlrover_tpu.telemetry import lockwatch
+
+    lockwatch.install()
     job_args = build_job_args(args)
     if job_args.platform == "local":
         from dlrover_tpu.master.local_master import LocalJobMaster

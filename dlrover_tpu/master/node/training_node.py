@@ -27,7 +27,8 @@ class TrainingNodeManager:
 
     @property
     def nodes(self) -> Dict[int, Node]:
-        return self._nodes
+        with self._lock:
+            return self._nodes
 
     def update_nodes(self, nodes: Dict[int, Node]):
         with self._lock:
@@ -36,10 +37,15 @@ class TrainingNodeManager:
             self._node_id_iter = itertools.count(start)
 
     def next_node_id(self) -> int:
+        with self._lock:
+            return self._next_node_id_locked()
+
+    def _next_node_id_locked(self) -> int:
         return next(self._node_id_iter)
 
     def get_node(self, node_id: int) -> Optional[Node]:
-        return self._nodes.get(node_id)
+        with self._lock:
+            return self._nodes.get(node_id)
 
     def add_node(self, node: Node):
         with self._lock:
@@ -78,7 +84,9 @@ class TrainingNodeManager:
         nodes (startup, relaunch-in-flight) count as unfinished, so the
         master does not fail a job before the platform reports the new
         node's status (parity: reference training_node.py:234-241)."""
-        return not self.unfinished_nodes() and bool(self._nodes)
+        with self._lock:
+            has_nodes = bool(self._nodes)
+        return not self.unfinished_nodes() and has_nodes
 
     def scale_up_nodes(self, num: int, resource,
                        max_relaunch_count: Optional[int] = None
@@ -88,7 +96,7 @@ class TrainingNodeManager:
         new_nodes = []
         with self._lock:
             for _ in range(num):
-                nid = self.next_node_id()
+                nid = self._next_node_id_locked()
                 kwargs = {}
                 if max_relaunch_count is not None:
                     kwargs["max_relaunch_count"] = max_relaunch_count

@@ -139,10 +139,13 @@ class ProcessScaler(Scaler):
                     (key, p) for key, p in self._procs.items()
                     if p.poll() is not None
                 ]
-                for key, _ in finished:
+                ended = []
+                for key, proc in finished:
                     self._procs.pop(key, None)
-            for key, proc in finished:
-                node = self._nodes.pop(key, None)
+                    ended.append((proc, self._nodes.pop(key, None)))
+            # status emission (journal + callbacks) happens outside the
+            # lock, on the snapshot taken above
+            for proc, node in ended:
                 if node is None:
                     continue
                 rc = proc.returncode

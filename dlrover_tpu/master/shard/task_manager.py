@@ -70,9 +70,10 @@ class TaskManager:
         """Write-through persistence: every shard-ledger mutation lands
         in the journal before the RPC reply leaves, so a restarted
         master resumes with the doing set the workers actually hold."""
-        self._state_journal = journal
+        with self._lock:
+            self._state_journal = journal
 
-    def _persist(self, dataset_name: str):
+    def _persist_locked(self, dataset_name: str):
         """Persist one dataset's ledger; caller holds self._lock."""
         if self._state_journal is None:
             return
@@ -127,20 +128,25 @@ class TaskManager:
                         "state journal write failed for dataset %s "
                         "params: %s", dataset_name, e,
                     )
-            self._persist(dataset_name)
+            self._persist_locked(dataset_name)
             logger.info(
                 "New dataset %s: size=%d batch=%d type=%s",
                 dataset_name, dataset_size, batch_size, task_type,
             )
 
     def get_dataset(self, name: str) -> Optional[DatasetManger]:
-        return self._datasets.get(name)
+        with self._lock:
+            return self._datasets.get(name)
 
     def reset_dataset(self, name: str):
         with self._lock:
             ds = self._datasets.get(name)
             if ds:
                 ds.reset()
+                # commit-before-reply: a reset that only lived in
+                # memory would resurrect the old ledger on master
+                # restart and re-deliver every shard of the epoch
+                self._persist_locked(name)
 
     # ---------------------------------------------------------------- tasks
 
@@ -182,7 +188,7 @@ class TaskManager:
             dispatched = sum(1 for t in tasks if t.task_id >= 0)
             if dispatched:
                 # group commit: one FileStore mutate for the batch
-                self._persist(dataset_name)
+                self._persist_locked(dataset_name)
         self._dispatch_batch_gauge.labels(dataset=dataset_name).set(
             dispatched
         )
@@ -199,7 +205,7 @@ class TaskManager:
                 raise ValueError(f"unknown dataset {dataset_name}")
             success, doing_task = ds.report_task_status(task_id, success)
             if doing_task is not None:
-                self._persist(dataset_name)
+                self._persist_locked(dataset_name)
             if success and self._speed_monitor and doing_task:
                 self._speed_monitor.add_task_completed(
                     doing_task.node_id, time.time() - doing_task.start_time
@@ -218,7 +224,7 @@ class TaskManager:
                 if recover:
                     ids = recover(node_id)
                     if ids:
-                        self._persist(name)
+                        self._persist_locked(name)
                         logger.info(
                             "Recovered tasks %s of node %s in dataset %s",
                             ids, node_id, name,
@@ -243,7 +249,7 @@ class TaskManager:
                     ids = recover(node_id)
                     if ids:
                         requeued += len(ids)
-                        self._persist(name)
+                        self._persist_locked(name)
                         logger.info(
                             "Relinquished tasks %s of node %s in "
                             "dataset %s", ids, node_id, name,
@@ -252,14 +258,17 @@ class TaskManager:
 
     def finished(self) -> bool:
         """All registered datasets have dispatched and completed all tasks."""
-        if not self._datasets:
-            return False
-        return all(ds.completed() for ds in self._datasets.values())
+        with self._lock:
+            if not self._datasets:
+                return False
+            return all(ds.completed() for ds in self._datasets.values())
 
     def training_started(self) -> bool:
-        return any(ds.doing or ds.todo for ds in self._datasets.values()) or (
-            self.finished()
-        )
+        with self._lock:
+            started = any(
+                ds.doing or ds.todo for ds in self._datasets.values()
+            )
+        return started or self.finished()
 
     # ------------------------------------------------------------ watchdog
 
@@ -291,7 +300,7 @@ class TaskManager:
                             ds.report_task_status(task_id, success=False)
                             requeued = True
                     if requeued:
-                        self._persist(name)
+                        self._persist_locked(name)
             time.sleep(1)
 
     # ----------------------------------------------------------- checkpoint
@@ -321,12 +330,13 @@ class TaskManager:
                 if ds is None:
                     return False
                 ds.restore_checkpoint(checkpoint, keep_doing=keep_doing)
-                self._persist(checkpoint.dataset_name)
+                self._persist_locked(checkpoint.dataset_name)
             return True
         except Exception as e:
             logger.error("Failed to restore shard checkpoint: %s", e)
             return False
 
     def get_dataset_epoch(self, dataset_name: str) -> int:
-        ds = self._datasets.get(dataset_name)
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
         return ds.get_epoch() if ds else 0

@@ -75,7 +75,7 @@ class MasterStateJournal:
         # staged lane mutations, last writer wins per key
         self._pending: Dict[str, Any] = {}
         self._mutex = threading.Lock()
-        self._wake = threading.Condition(self._mutex)
+        self._wake_cv = threading.Condition(self._mutex)
         # serializes actual store commits so a durable flush can't be
         # overtaken by an in-flight lane flush carrying a stale value
         self._commit_lock = threading.Lock()
@@ -113,7 +113,7 @@ class MasterStateJournal:
                 self._events += 1
                 self._commits += 1
             return
-        with self._wake:
+        with self._wake_cv:
             self._pending[key] = value
             self._events += 1
             counter(
@@ -121,7 +121,7 @@ class MasterStateJournal:
                 "state mutations staged on the journal commit lane",
             ).inc()
             if not durable:
-                self._wake.notify()
+                self._wake_cv.notify()
         if durable:
             self.flush()
 
@@ -139,9 +139,9 @@ class MasterStateJournal:
 
     def _flush_loop(self):
         while True:
-            with self._wake:
+            with self._wake_cv:
                 while not self._pending and not self._closed:
-                    self._wake.wait(timeout=1.0)
+                    self._wake_cv.wait(timeout=1.0)
                 if self._closed and not self._pending:
                     return
             if not self._closed:
@@ -183,9 +183,9 @@ class MasterStateJournal:
 
     def close(self):
         """Stop the lane and commit whatever is staged."""
-        with self._wake:
+        with self._wake_cv:
             self._closed = True
-            self._wake.notify_all()
+            self._wake_cv.notify_all()
         if self._flusher is not None:
             self._flusher.join(timeout=5.0)
             self._flusher = None

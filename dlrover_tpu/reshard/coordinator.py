@@ -115,12 +115,14 @@ class TransitionCoordinator:
 
     @property
     def transitions_done(self) -> int:
-        return self._done
+        with self._lock:
+            return self._done
 
     def set_fallback(
         self, fn: Optional[Callable[[TransitionOrder], None]]
     ) -> None:
-        self._fallback_fn = fn
+        with self._lock:
+            self._fallback_fn = fn
 
     # ------------------------------------------------------------- detection
 

@@ -65,7 +65,7 @@ def build_platform(
 
         project = getattr(job_args, "project", "")
         zone = getattr(job_args, "zone", "")
-        if os.getenv("DLROVER_TPU_FAKE_PLATFORM") == "1":
+        if os.getenv("DLROVER_TPU_FAKE_PLATFORM", "0") == "1":
             logger.info("tpu_vm platform using FAKE fleet API")
             api = FakeTpuVmApi(auto_ready=True)
         elif project and zone:
@@ -93,7 +93,7 @@ def build_platform(
             RestK8sApi,
         )
 
-        if os.getenv("DLROVER_TPU_FAKE_PLATFORM") == "1":
+        if os.getenv("DLROVER_TPU_FAKE_PLATFORM", "0") == "1":
             logger.info("gke platform using FAKE pod API")
             api = FakeK8sApi(auto_running=True)
         else:

@@ -151,10 +151,10 @@ class ElasticJobOperator:
         """Register a job (parity: creating the ElasticJob CR).
         ``spec_doc`` is the declarative document job_spec.py parses."""
         JobArgs.from_dict(spec_doc)  # validate early
-        name = name or spec_doc.get("metadata", {}).get("name") or (
-            f"job-{len(self._jobs)}"
-        )
+        name = name or spec_doc.get("metadata", {}).get("name")
         with self._lock:
+            if name is None:
+                name = f"job-{len(self._jobs)}"
             if name in self._jobs and self._jobs[name].phase not in (
                 JobPhase.DELETED,
             ):
@@ -190,7 +190,8 @@ class ElasticJobOperator:
                 job.set_phase(JobPhase.PENDING)
 
     def phase(self, name: str) -> Optional[str]:
-        job = self._jobs.get(name)
+        with self._lock:
+            job = self._jobs.get(name)
         return job.phase if job else None
 
     def status(self) -> Dict[str, Dict]:

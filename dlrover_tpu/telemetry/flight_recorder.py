@@ -56,6 +56,8 @@ __all__ = [
     "dump_flight_record",
     "dump_on_hang",
     "install_signal_hook",
+    "register_section",
+    "unregister_section",
 ]
 
 
@@ -116,6 +118,26 @@ def format_stacks(stacks: Optional[List[Dict[str, Any]]] = None) -> str:
 # -------------------------------------------------------------------- dumps
 
 
+# extra record sections contributed by other subsystems (lockwatch,
+# future watchdogs): name -> zero-arg callable returning a JSON-able
+# value. Registered once at subsystem install time; every dump calls
+# them, and a section that raises becomes {"error": ...} in the record
+# rather than sinking the dump.
+_section_lock = threading.Lock()
+_sections: Dict[str, Any] = {}
+
+
+def register_section(name: str, fn) -> None:
+    """Contribute a named section to every future flight record."""
+    with _section_lock:
+        _sections[name] = fn
+
+
+def unregister_section(name: str) -> None:
+    with _section_lock:
+        _sections.pop(name, None)
+
+
 def dump_flight_record(reason: str,
                        dump_dir: Optional[str] = None,
                        max_spans: int = 512,
@@ -168,6 +190,13 @@ def dump_flight_record(reason: str,
             record["goodput"] = goodput.local_snapshot()
         except Exception as e:
             record["goodput"] = {"error": str(e)}
+        with _section_lock:
+            sections = dict(_sections)
+        for name, fn in sections.items():
+            try:
+                record[name] = fn()
+            except Exception as e:
+                record[name] = {"error": str(e)}
         with open(os.path.join(out, "record.json"), "w") as f:
             json.dump(record, f, default=str, indent=1)
         with open(os.path.join(out, "stacks.txt"), "w") as f:

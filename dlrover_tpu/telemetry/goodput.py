@@ -170,13 +170,17 @@ class PhaseLedger:
         """A training step completed: the cheap per-step hook. Enters
         ``training`` from wherever the process was (also how hang /
         restart windows close: the next step proves recovery)."""
-        if self._phase != Phase.TRAINING:
+        with self._lock:
+            already = self._phase == Phase.TRAINING
+        if not already:
             self.transition(Phase.TRAINING)
 
     def resume(self, ts: Optional[float] = None) -> None:
         """Leave a fault phase (hang/restart) back to the phase it
         interrupted."""
-        self.transition(self._resume_phase, ts=ts)
+        with self._lock:
+            target = self._resume_phase
+        self.transition(target, ts=ts)
 
     def close(self, ts: Optional[float] = None) -> Dict[str, Any]:
         """Final flush at process exit: closes the open interval and
@@ -207,7 +211,8 @@ class PhaseLedger:
 
     @property
     def phase(self) -> str:
-        return self._phase
+        with self._lock:
+            return self._phase
 
     @property
     def start_ts(self) -> float:

@@ -189,7 +189,7 @@ def default_journal() -> EventJournal:
     global _default
     with _default_lock:
         if _default is None:
-            _default = EventJournal(os.getenv(ENV_JOURNAL) or None)
+            _default = EventJournal(os.getenv(ENV_JOURNAL, "") or None)
         return _default
 
 
@@ -202,7 +202,7 @@ def set_default_journal(
         # explicit None test: an EMPTY journal is falsy (__len__), and
         # `journal or ...` would silently discard a fresh file-backed one
         if journal is None:
-            journal = EventJournal(os.getenv(ENV_JOURNAL) or None)
+            journal = EventJournal(os.getenv(ENV_JOURNAL, "") or None)
         _default = journal
         return _default
 

@@ -216,11 +216,15 @@ class ElasticTrainer:
         # SIGTERM mid-run leaves a flight record (all-thread stacks +
         # last spans) before the process dies
         try:
-            from dlrover_tpu.telemetry import flight_recorder
+            from dlrover_tpu.telemetry import flight_recorder, lockwatch
             from dlrover_tpu.telemetry.http import attach_hang_detector
 
             attach_hang_detector(self._hang_detector)
             flight_recorder.install_signal_hook()
+            # runtime lock-order watchdog (no-op unless
+            # DLROVER_TPU_LOCKWATCH=1); late is still useful — the
+            # trainer's own locks are created after this point
+            lockwatch.install()
         except Exception as e:  # telemetry never stops training
             logger.warning("flight-recorder wiring failed: %s", e)
 

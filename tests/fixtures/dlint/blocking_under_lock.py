@@ -1,0 +1,16 @@
+"""Fixture: exactly one blocking-under-lock violation — a sleep inside
+the critical section (the PR 8 report_batch_done bug class)."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ticks = 0
+
+    def poll(self):
+        with self._lock:
+            time.sleep(0.5)  # the violation: blocks every contender
+            self._ticks += 1

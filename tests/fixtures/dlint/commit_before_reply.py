@@ -1,0 +1,14 @@
+"""Fixture: exactly one commit-before-reply violation — a TaskManager
+method that mutates the shard ledger and replies without persisting."""
+
+
+class TaskManager:
+    def __init__(self):
+        self._datasets = {}
+        self._lock = None
+        self._journal = None
+
+    def get_task(self, name, node_id):
+        ds = self._datasets[name]
+        task = ds.get_task(node_id)  # ledger mutation...
+        return task  # ...replies with it only in memory (no persist)

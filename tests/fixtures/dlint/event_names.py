@@ -1,0 +1,5 @@
+"""Fixture: exactly one event-names violation (CamelCase, undotted)."""
+
+
+def emit(record):
+    record("BadEventName", step=1)  # not snake-case dotted

@@ -1,0 +1,6 @@
+"""Fixture: exactly one event-vocabulary violation (an event in the
+closed preempt.* namespace that is not in the canonical set)."""
+
+
+def emit(record):
+    record("preempt.surprise_event", node=0)

@@ -1,0 +1,6 @@
+"""Fixture: exactly one goodput-phases violation (a phase label the
+ledger's PHASES set does not contain)."""
+
+
+def book(ledger, ts):
+    ledger.transition("not_a_real_phase", ts=ts)

@@ -1,0 +1,8 @@
+"""Fixture: exactly one knob-registry violation — an env read with no
+default (crashes or misbehaves differently on an unset fleet)."""
+
+import os
+
+
+def budget():
+    return os.getenv("DLROVER_TPU_FIXTURE_ONLY_KNOB")  # no default

@@ -1,0 +1,17 @@
+"""Fixture: exactly one lock-discipline violation — ``_items`` is
+locked in ``add`` but mutated unlocked in ``drop``."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def add(self, key, value):
+        with self._lock:
+            self._items[key] = value
+
+    def drop(self, key):
+        self._items.pop(key, None)  # the violation: unlocked mutation

@@ -1,0 +1,12 @@
+"""Fixture: exactly one signal-chain violation (bare overwrite that
+neither captures nor restores the prior disposition)."""
+
+import signal
+
+
+def _handler(signum, frame):
+    pass
+
+
+def arm():
+    signal.signal(signal.SIGTERM, _handler)  # clobbers whoever armed first

@@ -1,0 +1,6 @@
+"""Fixture: exactly one span-names violation (spaces, capitals)."""
+
+
+def trace(span):
+    with span("Bad Span Name"):
+        pass

@@ -1,0 +1,26 @@
+"""Fixture: exactly one supervised-rpc violation (a public RPC method
+neither @supervised_rpc-wrapped nor in UNSUPERVISED_RPCS)."""
+
+UNSUPERVISED_RPCS = ("close",)
+
+
+def supervised_rpc(fn):
+    return fn
+
+
+class MasterClient:
+    def __init__(self):
+        self._stub = None
+
+    @supervised_rpc
+    def get_task(self, node_id):
+        return self._call("get_task", node_id=node_id)
+
+    def report_status(self, status):  # the violation: bare RPC
+        return self._call("report_status", status=status)
+
+    def close(self):  # allowlisted: fire-and-forget on shutdown
+        return self._call("close")
+
+    def _call(self, name, **kw):
+        return (name, kw)

@@ -1,0 +1,9 @@
+"""Fixture: exactly one thread-name violation (anonymous thread)."""
+
+import threading
+
+
+def start(work):
+    t = threading.Thread(target=work, daemon=True)  # no name=
+    t.start()
+    return t

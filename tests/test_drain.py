@@ -5,7 +5,6 @@ and a lint that every ``signal.signal`` registration in the tree
 chains the prior disposition instead of clobbering it.
 """
 
-import ast
 import os
 import signal
 import time
@@ -410,58 +409,18 @@ def test_maintenance_event_queues_drain_heartbeat_action():
 # ----------------------------------------------------- signal-chain lint
 
 
-def _signal_registrations(tree):
-    """Yield (call, parent) for every ``signal.signal(...)`` call."""
-    parents = {}
-    for parent in ast.walk(tree):
-        for child in ast.iter_child_nodes(parent):
-            parents[child] = parent
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if (isinstance(f, ast.Attribute) and f.attr == "signal"
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "signal"):
-            yield node, parents.get(node)
-
-
-def _handler_chains_prior(expr) -> bool:
-    """True when the installed handler references a captured prior
-    disposition (``prev``-named variable) or an explicit SIG_DFL /
-    SIG_IGN restore."""
-    for n in ast.walk(expr):
-        if isinstance(n, ast.Name) and "prev" in n.id:
-            return True
-        if isinstance(n, ast.Attribute) and n.attr in ("SIG_DFL",
-                                                       "SIG_IGN"):
-            return True
-    return False
-
-
 def test_every_signal_registration_chains_the_prior_disposition():
     """Handlers must compose: a ``signal.signal`` call either CAPTURES
     the previous disposition (assignment, so the new handler can chain
     it) or RESTORES one (handler expression references prev/SIG_DFL/
     SIG_IGN). A bare overwrite silently disables whichever of the
-    drain coordinator / flight recorder armed first."""
-    violations = []
-    for dirpath, _, files in os.walk(os.path.join(REPO, "dlrover_tpu")):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            tree = ast.parse(open(path).read(), filename=path)
-            for call, parent in _signal_registrations(tree):
-                captured = isinstance(parent, (ast.Assign, ast.AnnAssign))
-                restores = (
-                    len(call.args) >= 2
-                    and _handler_chains_prior(call.args[1])
-                )
-                if not (captured or restores):
-                    rel = os.path.relpath(path, REPO)
-                    violations.append(f"{rel}:{call.lineno}")
-    assert not violations, (
-        "signal.signal call neither captures nor restores the prior "
-        f"disposition: {violations}"
+    drain coordinator / flight recorder armed first. (Enforced by
+    dlint's signal-chain rule — tools/dlint/rules/signals.py — this
+    shim keeps the historical entry point.)"""
+    from tools.dlint.core import lint_repo
+    from tools.dlint.rules import SignalChainRule
+
+    res = lint_repo(rules=[SignalChainRule])
+    assert not res.findings, "\n".join(
+        f"{f.location()}: {f.message}" for f in res.findings
     )

@@ -1,0 +1,256 @@
+"""Lockwatch unit tests (ISSUE 15): the runtime lock-order watchdog.
+
+The load-bearing case seeds a genuine A→B / B→A acquisition-order
+inversion through watched locks and asserts the watchdog journals
+``lockwatch.cycle`` — the runtime twin of what dlint's lock rules
+prove statically. The rest pins the machinery the drill relies on:
+long-hold detection, Condition compatibility (wait() must keep the
+held-stack honest), the install filter (only project-created locks are
+wrapped), and the reentrancy guard.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrover_tpu.telemetry import journal as journal_mod  # noqa: E402
+from dlrover_tpu.telemetry import lockwatch  # noqa: E402
+from dlrover_tpu.telemetry.lockwatch import (  # noqa: E402
+    LockWatch,
+    _ORIG_LOCK,
+    _WatchedLock,
+    _guard,
+)
+
+
+@pytest.fixture()
+def events():
+    """Capture every journal event recorded during the test."""
+    seen = []
+    journal_mod.add_tap(seen.append)
+    try:
+        yield seen
+    finally:
+        journal_mod.remove_tap(seen.append)
+
+
+def _watched(name, watch):
+    return _WatchedLock(_ORIG_LOCK(), name, watch)
+
+
+def _kinds(events):
+    return [e.get("kind") for e in events]
+
+
+# ----------------------------------------------------------------- cycles
+
+
+def test_inversion_journals_cycle(events):
+    """A→B on one path, B→A on another: the second edge closes a cycle
+    and must journal ``lockwatch.cycle`` exactly once."""
+    watch = LockWatch(long_hold_s=60.0)
+    a = _watched("a.py:1", watch)
+    b = _watched("b.py:2", watch)
+
+    with a:
+        with b:  # edge a->b
+            pass
+    with b:
+        with a:  # edge b->a: closes the cycle
+            pass
+
+    cycles = watch.cycles()
+    assert len(cycles) == 1, cycles
+    assert set(cycles[0]) == {"a.py:1", "b.py:2"}, cycles
+
+    recs = [e for e in events if e.get("kind") == "lockwatch.cycle"]
+    assert len(recs) == 1, _kinds(events)
+    data = recs[0]["data"]
+    assert set(data["cycle"]) == {"a.py:1", "b.py:2"}, data
+    assert "->" in data["edge"], data
+    assert data["thread"] == threading.current_thread().name
+
+    # the same inversion again: the cycle was already seen, no re-spam
+    with b:
+        with a:
+            pass
+    assert len([e for e in events
+                if e.get("kind") == "lockwatch.cycle"]) == 1
+
+
+def test_consistent_order_is_silent(events):
+    """A→B taken A→B everywhere is healthy — no cycle, no journal."""
+    watch = LockWatch(long_hold_s=60.0)
+    a = _watched("a.py:1", watch)
+    b = _watched("b.py:2", watch)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert watch.cycles() == []
+    assert "lockwatch.cycle" not in _kinds(events)
+    assert watch.snapshot()["edges"] == {"a.py:1": ["b.py:2"]}
+
+
+def test_cross_thread_inversion(events):
+    """The graph is global: the two halves of the inversion may come
+    from different threads (the realistic deadlock shape)."""
+    watch = LockWatch(long_hold_s=60.0)
+    a = _watched("a.py:1", watch)
+    b = _watched("b.py:2", watch)
+
+    with a:
+        with b:
+            pass
+
+    def other():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=other, name="lockwatch-test-other")
+    t.start()
+    t.join()
+    recs = [e for e in events if e.get("kind") == "lockwatch.cycle"]
+    assert len(recs) == 1, _kinds(events)
+    assert recs[0]["data"]["thread"] == "lockwatch-test-other"
+
+
+# -------------------------------------------------------------- long hold
+
+
+def test_long_hold_journals_once(events):
+    watch = LockWatch(long_hold_s=0.01)
+    a = _watched("slow.py:9", watch)
+    for _ in range(2):
+        with a:
+            time.sleep(0.03)
+    recs = [e for e in events if e.get("kind") == "lockwatch.long_hold"]
+    assert len(recs) == 1, _kinds(events)  # once per lock, not per hold
+    data = recs[0]["data"]
+    assert data["lock"] == "slow.py:9"
+    assert data["held_ms"] >= 10.0, data
+    assert data["threshold_ms"] == 10.0, data
+    snap = watch.snapshot()
+    assert snap["long_holds_ms"]["slow.py:9"] >= 10.0, snap
+
+
+def test_fast_hold_is_silent(events):
+    watch = LockWatch(long_hold_s=60.0)
+    a = _watched("fast.py:3", watch)
+    with a:
+        pass
+    assert "lockwatch.long_hold" not in _kinds(events)
+    assert watch.snapshot()["long_holds_ms"] == {}
+
+
+# -------------------------------------------------------------- condition
+
+
+def test_condition_wait_keeps_stack_honest():
+    """``threading.Condition(watched_lock)``: wait() releases and
+    reacquires through _release_save/_acquire_restore — the held-stack
+    must be empty afterwards and no phantom edges may appear."""
+    watch = LockWatch(long_hold_s=60.0)
+    inner = _watched("cv.py:5", watch)
+    cv = threading.Condition(inner)
+    with cv:
+        cv.wait(timeout=0.01)
+    assert watch._stack() == []
+    assert watch.snapshot()["edges"] == {}
+
+
+def test_rlock_reentry_adds_no_edges():
+    watch = LockWatch(long_hold_s=60.0)
+    import threading as _t
+    r = _WatchedLock(_t.RLock(), "re.py:7", watch)
+    with r:
+        with r:  # re-entry: no self-edge, no cycle
+            pass
+    assert watch.snapshot()["edges"] == {}
+    assert watch.cycles() == []
+    assert watch._stack() == []
+
+
+# ---------------------------------------------------------------- install
+
+
+def test_install_wraps_project_locks_only(events, monkeypatch, tmp_path):
+    """install(force=True) swaps the factories; a lock created by
+    dlrover_tpu code is wrapped, a lock created here (tests/) is not."""
+    monkeypatch.delenv(lockwatch.ENV_LOCKWATCH, raising=False)
+    assert lockwatch.install() is None  # env off, no force: no-op
+    watch = lockwatch.install(force=True)
+    try:
+        assert watch is not None
+        assert lockwatch.current() is watch
+        assert lockwatch.install(force=True) is watch  # idempotent
+        # created from tests/: the caller-frame filter leaves it raw
+        ours = threading.Lock()
+        assert not isinstance(ours, _WatchedLock), ours
+        # created from dlrover_tpu/: wrapped
+        from dlrover_tpu.telemetry.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        wrapped = [
+            v for v in vars(reg).values() if isinstance(v, _WatchedLock)
+        ]
+        assert wrapped, vars(reg)
+        # the flight recorder carries the graph as a section
+        from dlrover_tpu.telemetry import flight_recorder
+
+        out = flight_recorder.dump_flight_record(
+            reason="lockwatch-test", dump_dir=str(tmp_path)
+        )
+        record = json.load(open(os.path.join(out, "record.json")))
+        assert "lockwatch" in record, sorted(record)
+        assert record["lockwatch"] == watch.snapshot()
+    finally:
+        lockwatch.uninstall()
+    assert threading.Lock is _ORIG_LOCK
+    assert lockwatch.current() is None
+    out = flight_recorder.dump_flight_record(
+        reason="lockwatch-test-2", dump_dir=str(tmp_path)
+    )
+    record = json.load(open(os.path.join(out, "record.json")))
+    assert "lockwatch" not in record, sorted(record)
+
+
+def test_enabled_reads_env(monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV_LOCKWATCH, "1")
+    assert lockwatch.enabled()
+    monkeypatch.setenv(lockwatch.ENV_LOCKWATCH, "0")
+    assert not lockwatch.enabled()
+
+
+def test_long_hold_threshold_from_env(monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV_LONG_HOLD_MS, "250")
+    assert LockWatch().long_hold_s == 0.25
+    monkeypatch.delenv(lockwatch.ENV_LONG_HOLD_MS)
+    assert LockWatch().long_hold_s == 0.5  # documented default
+
+
+# ------------------------------------------------------------------ guard
+
+
+def test_reentrancy_guard_skips_watchdog_work():
+    """Watchdog work triggered while reporting (the journal's own locks
+    may be watched) is skipped, not recursed into."""
+    watch = LockWatch(long_hold_s=60.0)
+    a = _watched("g.py:1", watch)
+    b = _watched("g.py:2", watch)
+    _guard.active = True
+    try:
+        with a:
+            with b:
+                pass
+    finally:
+        _guard.active = False
+    assert watch.snapshot()["edges"] == {}  # nothing was observed
+    assert watch._stack() == []

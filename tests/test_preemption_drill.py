@@ -110,6 +110,11 @@ def test_preemption_graceful_drain_drill(tmp_path):
         env,
         DLROVER_TPU_CTX_TASK_PROCESS_TIMEOUT=str(int(TASK_TIMEOUT_S)),
         DLROVER_TPU_METRICS_PORT=str(metrics_port),
+        # arm the runtime lock-order watchdog in the real master under
+        # real chaos (ISSUE 15): any lockwatch.cycle it journals is a
+        # genuine inversion — no assertions change, the journal and
+        # flight records simply carry the lock graph now
+        DLROVER_TPU_LOCKWATCH="1",
     )
     worker_env = dict(
         env,

@@ -13,7 +13,6 @@ listed in ``UNSUPERVISED_RPCS`` — a new RPC added without supervision
 fails the suite, not a production failover.
 """
 
-import ast
 import os
 import threading
 import time
@@ -211,62 +210,19 @@ def test_rpc_survives_master_restart_on_same_port():
 # ----------------------------------------------------------------- lint path
 
 
-def _master_client_methods():
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "dlrover_tpu", "agent", "master_client.py",
-    )
-    tree = ast.parse(open(path).read())
-    cls = next(
-        n for n in tree.body
-        if isinstance(n, ast.ClassDef) and n.name == "MasterClient"
-    )
-    return [n for n in cls.body if isinstance(n, ast.FunctionDef)]
-
-
-def _calls_rpc(fn_node):
-    for node in ast.walk(fn_node):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "_call"
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "self"):
-            return True
-    return False
-
-
-def _decorators(fn_node):
-    names = []
-    for d in fn_node.decorator_list:
-        if isinstance(d, ast.Name):
-            names.append(d.id)
-        elif isinstance(d, ast.Attribute):
-            names.append(d.attr)
-    return names
-
-
 def test_every_public_rpc_is_supervised():
     """Every public MasterClient method that performs an RPC must be
     @supervised_rpc-wrapped or deliberately listed in UNSUPERVISED_RPCS
     — adding an RPC that bypasses reconnect supervision is a test
-    failure here, not a hang in production."""
-    methods = _master_client_methods()
-    assert len(methods) > 20  # the lint is looking at the real class
-    unsupervised = []
-    for fn in methods:
-        if fn.name.startswith("_") or not _calls_rpc(fn):
-            continue
-        if fn.name in UNSUPERVISED_RPCS:
-            assert "supervised_rpc" not in _decorators(fn), (
-                f"{fn.name} is listed UNSUPERVISED but decorated"
-            )
-            continue
-        if "supervised_rpc" not in _decorators(fn):
-            unsupervised.append(fn.name)
-    assert not unsupervised, (
-        f"public MasterClient RPCs without @supervised_rpc: "
-        f"{unsupervised} — wrap them or add to UNSUPERVISED_RPCS "
-        f"with a justification"
+    failure here, not a hang in production. (Enforced by dlint's
+    supervised-rpc rule — tools/dlint/rules/rpc.py — this shim keeps
+    the historical entry point.)"""
+    from tools.dlint.core import lint_repo
+    from tools.dlint.rules import SupervisedRpcRule
+
+    res = lint_repo(rules=[SupervisedRpcRule])
+    assert not res.findings, "\n".join(
+        f"{f.location()}: {f.message}" for f in res.findings
     )
 
 

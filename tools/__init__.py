@@ -1,0 +1,1 @@
+"""Project-native developer tooling (not shipped with the package)."""

@@ -1,0 +1,34 @@
+"""dlint: the project's static-analysis framework (ISSUE 15).
+
+The system's correctness story is a set of *contracts* — closed journal
+vocabularies, capture-or-restore signal handlers, supervised RPCs,
+commit-before-reply ledger persistence, lock discipline — that no
+general-purpose linter knows about. dlint encodes each contract as a
+declarative :class:`~tools.dlint.core.Rule` and checks all of them in a
+single AST traversal per file, so the whole repo lints in seconds and a
+new invariant costs one small class, not another ad-hoc ``ast.walk``
+loop in a test file.
+
+Entry points:
+
+  * ``python -m tools.dlint --check`` — the tier-1 gate (exits nonzero
+    on any finding not in the committed baseline, or any stale baseline
+    entry);
+  * ``python -m tools.dlint --json`` — structured output for CI;
+  * :func:`tools.dlint.engine.lint_repo` — the in-process API the test
+    shims use.
+
+See docs/STATIC_ANALYSIS.md for the rule catalog, the bug class each
+rule encodes, and the baseline workflow.
+"""
+
+from tools.dlint.core import Finding, Rule, lint_files, lint_repo
+from tools.dlint.baseline import load_baseline
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "lint_files",
+    "lint_repo",
+    "load_baseline",
+]

@@ -1,0 +1,133 @@
+"""CLI: ``python -m tools.dlint`` — the repo's static-analysis gate.
+
+Modes
+-----
+(default)            lint the production surface, print every finding
+                     (baselined ones marked), per-rule timings, exit 0.
+--check              the tier-1 gate: exit 1 on any finding missing
+                     from the committed baseline OR any stale baseline
+                     entry (the ratchet — the baseline can only shrink).
+--json               machine output: {findings, baselined, stale,
+                     timings, files, seconds}; composes with --check
+                     (exit code still reflects the gate).
+--rule ID            run a subset (repeatable).
+--update-baseline    regenerate tools/dlint/baseline.json, preserving
+                     existing reason strings; new entries get
+                     "TODO: justify or fix" for the reviewer to see.
+--write-knobs        regenerate docs/KNOBS.md from the code's env reads.
+
+See docs/STATIC_ANALYSIS.md for the rule catalog.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+from tools.dlint.baseline import (
+    BASELINE_PATH,
+    diff_baseline,
+    load_baseline,
+    write_baseline,
+)
+from tools.dlint.core import lint_repo
+
+
+def _print_timings(result) -> None:
+    print(f"\n{result.file_count} files, parse "
+          f"{result.parse_seconds * 1000:.0f}ms")
+    for rule_id in sorted(result.timings,
+                          key=lambda r: -result.timings[r]):
+        print(f"  {rule_id:<24} {result.timings[rule_id] * 1000:7.1f}ms")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dlint",
+        description="project-native static analysis "
+                    "(docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument("--check", action="store_true",
+                    help="gate mode: fail on unbaselined or stale")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="structured JSON on stdout")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule id (repeatable)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="regenerate the committed baseline")
+    ap.add_argument("--write-knobs", action="store_true",
+                    help="regenerate docs/KNOBS.md")
+    args = ap.parse_args(argv)
+
+    if args.write_knobs:
+        from tools.dlint.rules.knobs import write_knobs_md
+
+        print(f"wrote {write_knobs_md()}")
+        return 0
+
+    t0 = time.perf_counter()
+    result = lint_repo(rules=args.rule)
+    elapsed = time.perf_counter() - t0
+
+    if args.update_baseline:
+        entries = write_baseline(result.findings)
+        todo = sum(1 for e in entries.values()
+                   if e["reason"].startswith("TODO"))
+        print(f"wrote {BASELINE_PATH.relative_to(BASELINE_PATH.parents[2])}"
+              f": {len(entries)} entries ({todo} with TODO reasons)")
+        return 0
+
+    baseline = load_baseline()
+    if args.rule:
+        # a subset run must not call untouched baseline entries stale
+        active = set(args.rule)
+        baseline = {fp: e for fp, e in baseline.items()
+                    if e["rule"] in active}
+    new, stale = diff_baseline(result.findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in result.findings],
+            "new": [f.fingerprint for f in new],
+            "baselined": sorted(
+                f.fingerprint for f in result.findings
+                if f.fingerprint in baseline
+            ),
+            "stale": stale,
+            "timings": result.timings,
+            "files": result.file_count,
+            "seconds": round(elapsed, 3),
+        }, indent=1))
+    else:
+        for f in result.findings:
+            mark = " [baselined]" if f.fingerprint in baseline else ""
+            print(f"{f.location()}: {f.rule}: {f.message} "
+                  f"[{f.fingerprint}]{mark}")
+        if not result.findings:
+            print("clean: no findings")
+        _print_timings(result)
+
+    if args.check:
+        problems = []
+        if new:
+            problems.append(f"{len(new)} finding(s) not in baseline")
+        if stale:
+            problems.append(
+                f"{len(stale)} stale baseline entr(y/ies): "
+                + ", ".join(stale)
+            )
+        if problems:
+            if not args.as_json:
+                print("\nFAIL: " + "; ".join(problems))
+                print("fix the code, or (justified only) "
+                      "`python -m tools.dlint --update-baseline` and "
+                      "fill in the reason")
+            return 1
+        if not args.as_json:
+            print(f"\nOK: gate clean in {elapsed:.2f}s "
+                  f"({len(result.findings)} baselined finding(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Grandfathered findings, committed with reasons.
+
+The baseline is the escape hatch that lets a new rule land with real
+teeth: every pre-existing violation that is *justified* (e.g. the shard
+ledger's commit-before-reply journal write is blocking-under-lock BY
+DESIGN) gets an entry here, keyed by the finding's line-independent
+fingerprint, with a human reason string. ``--check`` fails on any
+finding NOT in the baseline (the ratchet) and on any baseline entry
+with no live finding (stale entries must be deleted with the code they
+excused — the baseline can only shrink; tests assert the count).
+"""
+
+import json
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from tools.dlint.core import Finding
+
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Path = BASELINE_PATH) -> Dict[str, dict]:
+    if not path.exists():
+        return {}
+    return json.loads(path.read_text())
+
+
+def diff_baseline(
+    findings: List[Finding], baseline: Dict[str, dict]
+) -> Tuple[List[Finding], List[str]]:
+    """Returns (new findings not excused, stale fingerprints)."""
+    live = {f.fingerprint for f in findings}
+    new = [f for f in findings if f.fingerprint not in baseline]
+    stale = sorted(fp for fp in baseline if fp not in live)
+    return new, stale
+
+
+def write_baseline(findings: List[Finding],
+                   path: Path = BASELINE_PATH) -> Dict[str, dict]:
+    """Regenerate the baseline from the current findings, preserving
+    reason strings for fingerprints that already had one. New entries
+    get reason "TODO: justify or fix" — a committed TODO is itself a
+    finding for a reviewer."""
+    prior = load_baseline(path)
+    out: Dict[str, dict] = {}
+    for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line)):
+        entry = {
+            "rule": f.rule,
+            "path": f.path,
+            "anchor": f.anchor,
+            "reason": prior.get(f.fingerprint, {}).get(
+                "reason", "TODO: justify or fix"
+            ),
+        }
+        out[f.fingerprint] = entry
+    path.write_text(json.dumps(out, indent=1, sort_keys=True) + "\n")
+    return out

@@ -1,0 +1,38 @@
+"""Rule registry: every contract dlint enforces, in catalog order.
+
+docs/STATIC_ANALYSIS.md documents each rule, the bug class it encodes
+and the PR that motivated it. Adding a rule = one class + one entry
+here + one fixture under tests/fixtures/dlint/ + a catalog row.
+"""
+
+from tools.dlint.rules.events import (
+    EventNameRule,
+    EventVocabularyRule,
+    SpanNameRule,
+)
+from tools.dlint.rules.phases import GoodputPhaseRule
+from tools.dlint.rules.signals import SignalChainRule
+from tools.dlint.rules.rpc import SupervisedRpcRule
+from tools.dlint.rules.threads import ThreadNameRule
+from tools.dlint.rules.locks import (
+    BlockingUnderLockRule,
+    LockDisciplineRule,
+)
+from tools.dlint.rules.reply import CommitBeforeReplyRule
+from tools.dlint.rules.knobs import KnobRegistryRule
+
+ALL_RULES = [
+    EventNameRule,
+    EventVocabularyRule,
+    SpanNameRule,
+    GoodputPhaseRule,
+    SignalChainRule,
+    SupervisedRpcRule,
+    ThreadNameRule,
+    LockDisciplineRule,
+    BlockingUnderLockRule,
+    CommitBeforeReplyRule,
+    KnobRegistryRule,
+]
+
+__all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

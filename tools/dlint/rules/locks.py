@@ -1,0 +1,339 @@
+"""Lock contracts: consistency of guarding, and no blocking work under
+a lock (ISSUE 15 tentpole passes).
+
+``lock-discipline`` encodes the invariant the codebase already follows
+at its 58 lock sites but nothing enforced: an attribute initialized in
+``__init__``, *mutated* after construction and accessed from two or
+more methods where ANY access runs under ``with self._lock`` is shared
+mutable state — so EVERY access must be locked. A single unlocked site
+is a torn-read/lost-update waiting for fleet-scale traffic.
+
+``blocking-under-lock`` encodes the PR 8 ``report_batch_done`` bug
+class: an RPC, ``time.sleep``, file I/O or queue wait inside a ``with
+<lock>`` body in the master/servicer/ledger/serving modules stalls
+every other thread contending that lock — the exact convoy the
+control-plane scale work (PR 12) exists to avoid. The fix pattern is
+PR 12's ``_monitor_heartbeats``: snapshot under the lock, do the slow
+work outside. Justified exceptions (e.g. commit-before-reply journal
+writes) live in the baseline with a reason.
+
+Both rules are heuristic where they must be (nested functions defer
+execution, so a ``with`` wrapping a closure *definition* does not
+protect its *body*) and conservative where they can be (a method that
+manually ``.acquire()``s a lock counts as fully locked).
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.dlint.core import FileContext, Rule
+
+_LOCK_NAME = re.compile(r"(lock|mutex|cv|cond)", re.IGNORECASE)
+
+#: container-mutator method names: a call of one of these on an
+#: attribute is a mutation of the attribute's value
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "update", "pop", "popleft",
+    "popitem", "remove", "discard", "clear", "extend", "insert",
+    "setdefault", "put", "put_nowait",
+})
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    """Does this with-item expression look like a project lock?"""
+    if isinstance(expr, ast.Attribute):
+        return bool(_LOCK_NAME.search(expr.attr))
+    if isinstance(expr, ast.Name):
+        return bool(_LOCK_NAME.search(expr.id))
+    return False
+
+
+def _enclosing_function(ctx: FileContext,
+                        node: ast.AST) -> Optional[ast.AST]:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return anc
+    return None
+
+
+def _locked_here(ctx: FileContext, node: ast.AST) -> bool:
+    """True when a lock-like ``with`` encloses ``node`` *within its
+    nearest enclosing function* (a with around a nested function
+    definition does not protect the nested body at call time)."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return False
+        if isinstance(anc, ast.With) and any(
+            _is_lock_expr(item.context_expr) for item in anc.items
+        ):
+            return True
+    return False
+
+
+class _Access:
+    __slots__ = ("method", "line", "locked", "mutation", "const_store")
+
+    def __init__(self, method: str, line: int, locked: bool,
+                 mutation: bool, const_store: bool):
+        self.method = method
+        self.line = line
+        self.locked = locked
+        self.mutation = mutation
+        self.const_store = const_store
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    title = "shared mutable attributes are locked at every access"
+    interest = (ast.ClassDef,)
+    targets = ("dlrover_tpu/",)
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        assert isinstance(node, ast.ClassDef)
+        methods = [
+            n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        init = next((m for m in methods if m.name == "__init__"), None)
+        if init is None:
+            return
+        init_attrs = self._init_attrs(init)
+        if not init_attrs:
+            return
+        has_lock = any(_LOCK_NAME.search(a) for a in init_attrs)
+        if not has_lock:
+            return
+        collected: Dict[str, List[_Access]] = {}
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            # the repo's convention: a ``*_locked`` method is called
+            # with the lock already held (enforced by review; the
+            # watchdog catches violations at runtime). Manual
+            # .acquire() in a method counts as whole-method locked.
+            held = (m.name.endswith("_locked")
+                    or self._manually_acquires(m))
+            for attr, acc in self._attr_accesses(ctx, m, init_attrs):
+                if held:
+                    acc.locked = True
+                collected.setdefault(attr, []).append(acc)
+        for attr in sorted(init_attrs):
+            if _LOCK_NAME.search(attr):
+                continue  # the lock itself (and friends)
+            recs = collected.get(attr, [])
+            if not recs:
+                continue
+            methods_touching = {r.method for r in recs}
+            if len(methods_touching) < 2:
+                continue
+            if not any(r.locked for r in recs):
+                continue  # never guarded: not lock-disciplined state
+            muts = [r for r in recs if r.mutation]
+            if not muts:
+                continue  # read-only after __init__: immutable config
+            if all(m.const_store for m in muts):
+                # flag-style publication (self._stop = True): a single
+                # GIL-atomic constant store with no compound invariant
+                continue
+            unlocked = sorted(
+                (r for r in recs if not r.locked),
+                key=lambda r: r.line,
+            )
+            if not unlocked:
+                continue
+            sites = ", ".join(
+                f"{r.method}:{r.line}" for r in unlocked[:5]
+            )
+            extra = (
+                f" (+{len(unlocked) - 5} more)" if len(unlocked) > 5
+                else ""
+            )
+            self.report(
+                ctx.relpath, unlocked[0].line,
+                f"{node.name}.{attr} is guarded by a lock in some "
+                f"methods but accessed unlocked at {sites}{extra} — "
+                "lock every access, or snapshot under the lock and "
+                "work on the copy",
+                anchor=f"{node.name}.{attr}",
+            )
+
+    # ------------------------------------------------------------ helpers
+
+    @staticmethod
+    def _init_attrs(init: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for n in ast.walk(init):
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.ctx, ast.Store)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"):
+                out.add(n.attr)
+        return out
+
+    @staticmethod
+    def _manually_acquires(fn: ast.AST) -> bool:
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "acquire"
+                    and isinstance(n.func.value, ast.Attribute)
+                    and _LOCK_NAME.search(n.func.value.attr)):
+                return True
+        return False
+
+    def _attr_accesses(
+        self, ctx: FileContext, method: ast.AST, init_attrs: Set[str]
+    ) -> List[Tuple[str, _Access]]:
+        out: List[Tuple[str, _Access]] = []
+        for n in ast.walk(method):
+            if not (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"
+                    and n.attr in init_attrs):
+                continue
+            parent = ctx.parents.get(n)
+            mutation = False
+            const_store = False
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                mutation = True
+                if (isinstance(parent, ast.Assign)
+                        and isinstance(parent.value, ast.Constant)):
+                    const_store = True
+            elif isinstance(parent, ast.Subscript) and isinstance(
+                parent.ctx, (ast.Store, ast.Del)
+            ):
+                mutation = True  # self.x[k] = v / del self.x[k]
+            elif (isinstance(parent, ast.Attribute)
+                  and parent.attr in _MUTATORS):
+                grand = ctx.parents.get(parent)
+                if isinstance(grand, ast.Call) and grand.func is parent:
+                    mutation = True  # self.x.append(...)
+            elif isinstance(parent, ast.AugAssign) and parent.target is n:
+                mutation = True
+            acc = _Access(method.name, n.lineno,
+                          _locked_here(ctx, n), mutation, const_store)
+            out.append((n.attr, acc))
+        return out
+
+
+#: call shapes that block: (predicate description, matcher)
+_STR_JOIN_PREFIXES = ("os.path.join", "posixpath.join", "ntpath.join")
+
+
+class BlockingUnderLockRule(Rule):
+    id = "blocking-under-lock"
+    title = "no RPC / sleep / file I/O / queue wait under a lock"
+    interest = (ast.With,)
+    #: the contended control-plane surfaces: master (servicer, shard
+    #: ledger, state journal, rendezvous), serving router, agent
+    #: client/reporter, reshard coordinator
+    targets = (
+        "dlrover_tpu/master/",
+        "dlrover_tpu/serving/",
+        "dlrover_tpu/agent/",
+        "dlrover_tpu/reshard/",
+    )
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._reported: Set[Tuple[int, str]] = set()
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        assert isinstance(node, ast.With)
+        lock_texts = [
+            ast.unparse(item.context_expr)
+            for item in node.items
+            if _is_lock_expr(item.context_expr)
+        ]
+        if not lock_texts:
+            return
+        for call in self._body_calls(node):
+            why = self._blocking_reason(call, ctx)
+            if why is None:
+                continue
+            call_text = ast.unparse(call.func)
+            key = (call.lineno, call_text)
+            if key in self._reported:
+                continue  # already reported from an outer lock-with
+            self._reported.add(key)
+            fn = _enclosing_function(ctx, node)
+            fn_name = getattr(fn, "name", "<module>")
+            self.report(
+                ctx.relpath, call.lineno,
+                f"{why} `{call_text}(...)` under `with "
+                f"{lock_texts[0]}` in {fn_name} — move it outside the "
+                "critical section or snapshot and release first "
+                "(PR 8 report_batch_done bug class)",
+                anchor=f"{fn_name}:{call_text}",
+            )
+
+    # ------------------------------------------------------------ helpers
+
+    @staticmethod
+    def _body_calls(with_node: ast.With) -> List[ast.Call]:
+        """Every Call in the with body, skipping nested function /
+        lambda bodies (deferred execution escapes the lock)."""
+        out: List[ast.Call] = []
+        stack: List[ast.AST] = list(with_node.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        out.sort(key=lambda c: (c.lineno, c.col_offset))
+        return out
+
+    def _blocking_reason(self, call: ast.Call,
+                         ctx: FileContext) -> Optional[str]:
+        f = call.func
+        text = ast.unparse(f)
+        if text == "time.sleep" or text.endswith(".sleep"):
+            return "sleep"
+        if text == "open":
+            return "file I/O"
+        if text in ("os.fsync", "os.fdatasync", "os.replace"):
+            return "file I/O"
+        if text.startswith("subprocess."):
+            return "subprocess"
+        if isinstance(f, ast.Attribute):
+            recv = ast.unparse(f.value)
+            low = recv.lower()
+            if f.attr == "join":
+                if isinstance(f.value, ast.Constant):
+                    return None  # "sep".join(...)
+                if any(text.startswith(p) for p in _STR_JOIN_PREFIXES):
+                    return None
+                if "thread" in low or "proc" in low:
+                    return "thread join"
+                return None  # plain .join: almost always a string join
+            if f.attr in ("wait", "wait_for"):
+                if self._receiver_is_held_lock(call, ctx, recv):
+                    return None  # Condition.wait releases its own lock
+                return "wait"
+            if f.attr in ("get", "put", "get_nowait_blocking"):
+                if "queue" in low or low.endswith("_q"):
+                    return "queue wait"
+                return None
+            if low.endswith("client") or low.endswith("stub"):
+                return "RPC"
+        return None
+
+    @staticmethod
+    def _receiver_is_held_lock(call: ast.Call, ctx: FileContext,
+                               recv: str) -> bool:
+        """``self._cv.wait()`` inside ``with self._cv:`` is the
+        condition-variable idiom, not a foreign blocking wait."""
+        for anc in ctx.ancestors(call):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    if ast.unparse(item.context_expr) == recv:
+                        return True
+        return False

@@ -1,0 +1,145 @@
+"""Commit-before-reply on the shard ledger (PRs 6, 8, 12).
+
+The exactly-once argument for shard delivery rests on ONE invariant:
+every ledger mutation is persisted through the state journal BEFORE the
+RPC reply leaves the master. If a reply could escape with the mutation
+only in memory, a master crash between the two would re-deliver (or
+lose) shards. This rule holds ``TaskManager`` to it statically:
+
+  * a method that mutates a dataset ledger (``get_task`` /
+    ``report_task_status`` / ``recover_tasks_of_node`` /
+    ``restore_checkpoint`` / ``reset``) must also call
+    ``self._persist_locked(...)``;
+  * no ``return`` may sit between the last mutation and the next
+    persist (line-order approximation of "every return path reaches a
+    persist" — the TaskManager style keeps mutation and persist in the
+    same ``with self._lock`` block, so line order IS path order there);
+  * servicer ``rpc_*`` methods must not reach around the TaskManager
+    into ledger internals (``.todo`` / ``.doing`` / ``._datasets``) —
+    the persist discipline lives in TaskManager and bypassing it
+    silently skips the journal.
+"""
+
+import ast
+from typing import List, Optional
+
+from tools.dlint.core import FileContext, Rule
+
+#: calls that mutate a dataset ledger
+_LEDGER_MUTATORS = frozenset({
+    "get_task", "report_task_status", "recover_tasks_of_node",
+    "restore_checkpoint", "reset",
+})
+#: local-alias call names for getattr-resolved mutators
+#: (``recover = getattr(ds, "recover_tasks_of_node", None)``)
+_ALIAS_MUTATORS = frozenset({"recover"})
+
+_LEDGER_INTERNALS = frozenset({"todo", "doing", "_datasets"})
+
+
+def _is_persist_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute)
+            and f.attr in ("_persist_locked", "save_dataset_checkpoint")
+            and not isinstance(f.value, ast.Constant))
+
+
+def _is_mutator_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _LEDGER_MUTATORS:
+        return True
+    if isinstance(f, ast.Name) and f.id in _ALIAS_MUTATORS:
+        return True
+    return False
+
+
+class CommitBeforeReplyRule(Rule):
+    id = "commit-before-reply"
+    title = "shard-ledger mutations persist before any reply leaves"
+    interest = (ast.FunctionDef,)
+    targets = (
+        "dlrover_tpu/master/shard/task_manager.py",
+        "dlrover_tpu/master/servicer.py",
+    )
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        assert isinstance(node, ast.FunctionDef)
+        if ctx.relpath.endswith("servicer.py"):
+            self._check_servicer(node, ctx)
+        else:
+            self._check_task_manager(node, ctx)
+
+    # ---------------------------------------------------------- servicer
+
+    def _check_servicer(self, fn: ast.FunctionDef,
+                        ctx: FileContext) -> None:
+        if not fn.name.startswith("rpc_"):
+            return
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Attribute)
+                    and n.attr in _LEDGER_INTERNALS):
+                self.report(
+                    ctx.relpath, n.lineno,
+                    f"servicer {fn.name} touches ledger internal "
+                    f".{n.attr} directly — mutations must go through "
+                    "TaskManager so the commit-before-reply journal "
+                    "write cannot be skipped",
+                    anchor=f"{fn.name}:{n.attr}",
+                )
+
+    # ------------------------------------------------------ task manager
+
+    def _check_task_manager(self, fn: ast.FunctionDef,
+                            ctx: FileContext) -> None:
+        # only methods of TaskManager itself (skip nested defs —
+        # ast.walk from the engine hands us every FunctionDef)
+        cls = self._owning_class(ctx, fn)
+        if cls is None or cls.name != "TaskManager":
+            return
+        muts: List[int] = []
+        persists: List[int] = []
+        returns: List[int] = []
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                if _is_persist_call(n):
+                    persists.append(n.lineno)
+                elif _is_mutator_call(n):
+                    muts.append(n.lineno)
+            elif isinstance(n, ast.Return):
+                returns.append(n.lineno)
+        if not muts:
+            return
+        if not persists:
+            self.report(
+                ctx.relpath, muts[0],
+                f"TaskManager.{fn.name} mutates the shard ledger but "
+                "never calls self._persist_locked(...) — a master restart "
+                "would resume from a stale ledger (commit-before-"
+                "reply, PR 6)",
+                anchor=f"{fn.name}:no-persist",
+            )
+            return
+        for r in sorted(returns):
+            before = [m for m in muts if m < r]
+            if not before:
+                continue
+            last_mut = max(before)
+            if not any(last_mut <= p <= r for p in persists):
+                self.report(
+                    ctx.relpath, r,
+                    f"TaskManager.{fn.name} can return at line {r} "
+                    f"after a ledger mutation (line {last_mut}) "
+                    "without persisting — every return path must "
+                    "reach self._persist_locked(...) first",
+                    anchor=f"{fn.name}:return-{r - last_mut}",
+                )
+
+    @staticmethod
+    def _owning_class(ctx: FileContext,
+                      fn: ast.FunctionDef) -> Optional[ast.ClassDef]:
+        for anc in ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None  # nested def, not a method
+        return None

@@ -1,0 +1,105 @@
+"""Reconnect-supervision coverage (PR 6).
+
+Every public ``MasterClient`` method that performs an RPC must be
+``@supervised_rpc``-wrapped or deliberately listed in
+``UNSUPERVISED_RPCS`` — an RPC that bypasses reconnect supervision is a
+lint failure here, not a hang when the master restarts in production.
+The UNSUPERVISED_RPCS allowlist is read from the module's own AST so
+the lint and the runtime can never disagree about its contents.
+"""
+
+import ast
+from typing import List, Optional
+
+from tools.dlint.core import FileContext, Rule
+
+
+def _calls_rpc(fn_node: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn_node):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_call"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            return True
+    return False
+
+
+def _decorators(fn_node: ast.FunctionDef) -> List[str]:
+    names = []
+    for d in fn_node.decorator_list:
+        if isinstance(d, ast.Name):
+            names.append(d.id)
+        elif isinstance(d, ast.Attribute):
+            names.append(d.attr)
+        elif isinstance(d, ast.Call):
+            names.extend(_decorators_of_expr(d.func))
+    return names
+
+
+def _decorators_of_expr(expr: ast.AST) -> List[str]:
+    if isinstance(expr, ast.Name):
+        return [expr.id]
+    if isinstance(expr, ast.Attribute):
+        return [expr.attr]
+    return []
+
+
+class SupervisedRpcRule(Rule):
+    id = "supervised-rpc"
+    title = "public MasterClient RPCs ride the reconnect supervisor"
+    interest = ()  # operates on the one file's module structure
+    targets = ("dlrover_tpu/agent/master_client.py",)
+
+    def end_file(self, ctx: FileContext) -> None:
+        cls: Optional[ast.ClassDef] = next(
+            (n for n in ctx.tree.body
+             if isinstance(n, ast.ClassDef) and n.name == "MasterClient"),
+            None,
+        )
+        if cls is None:
+            self.report(
+                ctx.relpath, 1,
+                "no MasterClient class found — did the client move? "
+                "(update SupervisedRpcRule.targets)",
+                anchor="coverage",
+            )
+            return
+        allowlist = self._unsupervised_allowlist(ctx.tree)
+        methods = [
+            n for n in cls.body if isinstance(n, ast.FunctionDef)
+        ]
+        for fn in methods:
+            if fn.name.startswith("_") or not _calls_rpc(fn):
+                continue
+            decorated = "supervised_rpc" in _decorators(fn)
+            if fn.name in allowlist:
+                if decorated:
+                    self.report(
+                        ctx.relpath, fn.lineno,
+                        f"{fn.name} is listed in UNSUPERVISED_RPCS but "
+                        "decorated @supervised_rpc — drop one",
+                        anchor=f"rpc:{fn.name}",
+                    )
+                continue
+            if not decorated:
+                self.report(
+                    ctx.relpath, fn.lineno,
+                    f"public MasterClient RPC {fn.name} without "
+                    "@supervised_rpc — wrap it or add it to "
+                    "UNSUPERVISED_RPCS with a justification",
+                    anchor=f"rpc:{fn.name}",
+                )
+
+    @staticmethod
+    def _unsupervised_allowlist(tree: ast.AST) -> frozenset:
+        for node in getattr(tree, "body", []):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "UNSUPERVISED_RPCS"
+                            for t in node.targets)):
+                try:
+                    return frozenset(ast.literal_eval(node.value))
+                except (ValueError, TypeError):
+                    return frozenset()
+        return frozenset()

@@ -1,0 +1,55 @@
+"""Signal-handler composition contract (ISSUE 9).
+
+The drain coordinator and the flight recorder both arm SIGTERM; they
+compose only because every ``signal.signal`` call either CAPTURES the
+previous disposition (assignment, so the new handler can chain it) or
+RESTORES one (handler expression references a ``prev``-named variable
+or SIG_DFL/SIG_IGN). A bare overwrite silently disables whichever armed
+first — a bug that only shows up when a preemption and a hang land in
+the same incarnation.
+"""
+
+import ast
+
+from tools.dlint.core import FileContext, Rule
+
+
+def _handler_chains_prior(expr: ast.AST) -> bool:
+    """True when the installed handler references a captured prior
+    disposition (``prev``-named variable) or an explicit SIG_DFL /
+    SIG_IGN restore."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and "prev" in n.id:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in ("SIG_DFL",
+                                                       "SIG_IGN"):
+            return True
+    return False
+
+
+class SignalChainRule(Rule):
+    id = "signal-chain"
+    title = "signal.signal captures or restores the prior disposition"
+    interest = (ast.Call,)
+    targets = ("dlrover_tpu/",)
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        assert isinstance(node, ast.Call)
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "signal"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "signal"):
+            return
+        parent = ctx.parents.get(node)
+        captured = isinstance(parent, (ast.Assign, ast.AnnAssign))
+        restores = (
+            len(node.args) >= 2 and _handler_chains_prior(node.args[1])
+        )
+        if not (captured or restores):
+            self.report(
+                ctx.relpath, node.lineno,
+                "signal.signal call neither captures nor restores the "
+                "prior disposition — handlers must compose (see "
+                "docs/FAULT_TOLERANCE.md)",
+                anchor="signal.signal",
+            )
