@@ -29,6 +29,15 @@ retry-after and then land — zero dropped heartbeats, master still
 responsive. Delivery is proven end-to-end: the master's recorded
 (incarnation, seq) per reporter must equal the client's last acked seq.
 
+With ``--relays N`` (ISSUE 16) a fourth phase stands up N in-process
+AggregatorRelays fronting the master's report lane: agents report to
+their relay, relays terminate + re-delta + forward one coalesced
+report_relay_batch per interval. Delivery is proven over BOTH hops
+(agent acked seq == relay downstream seq; relay upstream seq == the
+master ledger's seq), and the relay master CPU per delivered interval
+is compared against the direct batched phase — the sublinearity
+evidence for the hierarchical fan-in tier.
+
 Prints ONE JSON line (BENCH conventions):
 
   value                 batched fan-in throughput (agent-intervals/s)
@@ -38,10 +47,15 @@ Prints ONE JSON line (BENCH conventions):
   *_master_cpu_s        master process CPU over the timed window
   sheds / dropped       main batched phase (expected 0 / 0)
   shed_phase_*          the low-limit phase (sheds > 0, dropped == 0)
+  relay_*               the relay-tier phase (--relays > 0): two-hop
+                        delivery (relay_phase_dropped == 0) + master
+                        CPU per thousand delivered agent-intervals,
+                        relay tier vs direct batched
 
 Run:  JAX_PLATFORMS=cpu python benchmarks/master_swarm.py \
-          [--agents 1000] [--threads 16] [--duration 6] [--steps 10]
-      --smoke shrinks the run for the tier-1 suite.
+          [--agents 1000] [--threads 16] [--duration 6] [--steps 10] \
+          [--relays 32]
+      --smoke shrinks the run for the tier-1 suite (forces --relays 2).
 """
 
 import argparse
@@ -209,9 +223,11 @@ def _percentile(sorted_vals, q: float) -> float:
 
 def _drive(master: MasterProc, mode: str, agents: int, threads: int,
            duration: float, steps_per_interval: int,
-           retry_cap: float = 0.5) -> dict:
+           retry_cap: float = 0.5, addrs=None) -> dict:
     """Hammer the master with interval-equivalent cycles until the
-    deadline; returns throughput + latency + delivery accounting."""
+    deadline; returns throughput + latency + delivery accounting.
+    ``addrs`` (relay tier) routes agent ``a`` to ``addrs[a % len]``
+    instead of the master directly."""
     from dlrover_tpu.agent.status_reporter import DeltaTracker
     from dlrover_tpu.common import comm
     from dlrover_tpu.common.grpc_utils import GenericRpcClient
@@ -288,26 +304,36 @@ def _drive(master: MasterProc, mode: str, agents: int, threads: int,
             cycles[rank] += 1
 
     def worker(rank: int):
-        cli = GenericRpcClient(master.addr, timeout=30.0)
+        clis = {}
+
+        def cli_for(a: int) -> GenericRpcClient:
+            addr = addrs[a % len(addrs)] if addrs else master.addr
+            cli = clis.get(addr)
+            if cli is None:
+                cli = GenericRpcClient(addr, timeout=30.0)
+                clis[addr] = cli
+            return cli
+
         mine = [a for a in range(agents) if a % threads == rank]
         try:
             # warmup pass (untimed): channel setup + each agent's
             # initial full=True report — the timed window measures the
             # steady-state fan-in a fleet runs at for hours
             for a in mine:
-                one_cycle(cli, rank, a, timed=False)
+                one_cycle(cli_for(a), rank, a, timed=False)
             warm_barrier.wait(timeout=120.0)
             start_evt.wait()
             deadline = time.monotonic() + duration
             while time.monotonic() < deadline:
                 for a in mine:
-                    one_cycle(cli, rank, a, timed=True)
+                    one_cycle(cli_for(a), rank, a, timed=True)
                     if time.monotonic() >= deadline:
                         break
         except Exception as e:  # surfaces in the result, fails the run
             errors.append(f"{mode} worker {rank}: {e!r}")
         finally:
-            cli.close()
+            for cli in clis.values():
+                cli.close()
 
     pool = [
         threading.Thread(target=worker, args=(i,), daemon=True)
@@ -351,6 +377,66 @@ def _dropped(res: dict, master_stats: dict) -> int:
     return dropped
 
 
+def _relay_dropped(res: dict, chain: dict, master_stats: dict) -> int:
+    """Two-hop delivery proof for the relay tier: the seq the relay
+    acked each agent must match the relay's downstream ledger, AND the
+    relay's last master-acked upstream seq must match the master's
+    ledger for that agent. Either mismatch is a dropped interval."""
+    reporters = master_stats.get("reporters", {})
+    dropped = 0
+    for a, seq in res["acked_seq"].items():
+        link = chain.get(("worker", a))
+        if link is None or link["downstream_seq"] != seq:
+            dropped += 1
+            continue
+        if reporters.get(f"worker:{a}", -1) != link["upstream_seq"]:
+            dropped += 1
+    return dropped
+
+
+def _run_relay_phase(ns) -> dict:
+    """Phase 4 (``--relays R``): the hierarchical fan-in tier. Agents
+    report to in-process AggregatorRelays (round-robin by id); relays
+    terminate, re-delta and forward coalesced batches — master cost
+    scales with R, not with agents."""
+    from dlrover_tpu.agent.relay import AggregatorRelay
+
+    m = MasterProc(ns.agents, window=ns.window, persist_interval=0.0)
+    relays = []
+    try:
+        for r in range(ns.relays):
+            relay = AggregatorRelay(
+                m.addr, relay_id=r, port=0, interval=0.25,
+            )
+            relay.start()
+            relays.append(relay)
+        addrs = [f"localhost:{relay.port}" for relay in relays]
+        res = _drive(m, "batched", ns.agents, ns.threads, ns.duration,
+                     ns.steps, addrs=addrs)
+        # flush: every fresh slot forwards before the books close
+        chain = {}
+        rstats = []
+        for relay in relays:
+            relay.stop(flush=True)
+            chain.update(relay.delivery_snapshot())
+            rstats.append(relay.stats())
+        relays = []
+    finally:
+        for relay in relays:  # only on error paths
+            relay.stop(flush=False, grace=0.0)
+        master_stats = m.stop()
+    res["relay_dropped"] = _relay_dropped(res, chain, master_stats)
+    res["forwarded_batches"] = sum(
+        s["forwarded_batches"] for s in rstats
+    )
+    res["forwarded_reports"] = sum(
+        s["forwarded_reports"] for s in rstats
+    )
+    res["upstream_sheds"] = sum(s["upstream_sheds"] for s in rstats)
+    res["master_stats"] = master_stats
+    return res
+
+
 # --------------------------------------------------------------------- main
 
 
@@ -376,6 +462,9 @@ def main() -> int:
     p.add_argument("--min_speedup", type=float, default=None,
                    help="acceptance gate on vs_baseline (default 10 "
                         "full / 2 smoke)")
+    p.add_argument("--relays", type=int, default=0,
+                   help="aggregator relay tier size for phase 4 "
+                        "(0 = skip; --smoke forces 2)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny run for the tier-1 suite")
     ns = p.parse_args()
@@ -388,6 +477,7 @@ def main() -> int:
         ns.agents = min(ns.agents, 64)
         ns.threads = min(ns.threads, 8)
         ns.duration = min(ns.duration, 1.5)
+        ns.relays = 2 if ns.relays == 0 else min(ns.relays, 2)
     min_speedup = ns.min_speedup
     if min_speedup is None:
         min_speedup = 2.0 if ns.smoke else 10.0
@@ -433,6 +523,11 @@ def main() -> int:
         shed_stats = m.stop()
     shed_dropped = _dropped(shed, shed_stats)
 
+    # phase 4 — hierarchical fan-in (optional): same agents behind R
+    # aggregator relays; sublinearity shows as relay-phase master CPU
+    # tracking R instead of the agent count
+    relay = _run_relay_phase(ns) if ns.relays > 0 else None
+
     jstats = batched_stats.get("journal", {})
     events = jstats.get("events", 0)
     commits = max(1, jstats.get("commits", 0))
@@ -442,6 +537,8 @@ def main() -> int:
         if unary["intervals_per_s"] else 0.0
     )
     errors = unary["errors"] + batched["errors"] + shed["errors"]
+    if relay is not None:
+        errors = errors + relay["errors"]
     ok = (
         not errors
         and dropped == 0
@@ -452,6 +549,12 @@ def main() -> int:
         and coalesce >= min_coalesce
         and batched["p99_ms"] < 1000.0
     )
+    if relay is not None:
+        ok = ok and (
+            relay["relay_dropped"] == 0
+            and relay["forwarded_batches"] > 0
+            and relay["p99_ms"] < 1000.0
+        )
     result = {
         "metric": "control_plane_fanin_throughput",
         "value": round(batched["intervals_per_s"], 1),
@@ -482,6 +585,29 @@ def main() -> int:
         "smoke": bool(ns.smoke),
         "ok": ok,
     }
+    if relay is not None:
+        # sublinearity evidence: master CPU per thousand delivered
+        # agent-intervals, relay tier vs direct batched
+        relay_cycles = max(1, relay["cycles"])
+        batched_cycles = max(1, batched["cycles"])
+        result.update({
+            "relays": ns.relays,
+            "relay_intervals_per_s":
+                round(relay["intervals_per_s"], 1),
+            "relay_p50_ms": round(relay["p50_ms"], 3),
+            "relay_p99_ms": round(relay["p99_ms"], 3),
+            "relay_master_cpu_s": round(relay["master_cpu_s"], 2),
+            "relay_master_cpu_s_per_kinterval": round(
+                relay["master_cpu_s"] / (relay_cycles / 1000.0), 3
+            ),
+            "direct_master_cpu_s_per_kinterval": round(
+                batched["master_cpu_s"] / (batched_cycles / 1000.0), 3
+            ),
+            "relay_phase_dropped": relay["relay_dropped"],
+            "relay_forwarded_batches": relay["forwarded_batches"],
+            "relay_forwarded_reports": relay["forwarded_reports"],
+            "relay_upstream_sheds": relay["upstream_sheds"],
+        })
     if errors:
         result["errors"] = errors[:5]
     print(json.dumps(result))

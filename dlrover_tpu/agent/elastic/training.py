@@ -251,17 +251,33 @@ class ElasticTrainingAgent:
         fleet's reports back in phase. The reporter degrades to the
         legacy ``report_heartbeat`` rpc by itself against a master
         that predates the batched path."""
+        from dlrover_tpu.agent.relay import ENV_RELAY_ADDR
         from dlrover_tpu.agent.status_reporter import StatusReporter
 
+        # hierarchical fan-in (ISSUE 16): when the launcher assigned a
+        # relay, the REPORT lane gets its own client pointed at it with
+        # the real master as failover fallback — every other RPC stays
+        # on self._client, agent -> master direct
+        report_client = self._client
+        relay_addr = os.environ.get(ENV_RELAY_ADDR, "")
+        master_addr = getattr(self._client, "master_addr", "")
+        if relay_addr and master_addr and relay_addr != master_addr:
+            report_client = MasterClient(
+                relay_addr,
+                node_id=getattr(self._client, "_node_id", 0),
+                node_type=getattr(self._client, "_node_type", "worker"),
+                fallback_addr=master_addr,
+            )
         self._status_reporter = StatusReporter(
-            self._client, interval,
+            report_client, interval,
             incarnation=self._restart_count,
             on_action=self._handle_master_action,
         )
-        # a replaced master has no delta baseline for this agent; it
-        # will reply resync=True on first contact, but re-sending full
-        # proactively on reconnect saves that round-trip
-        add_hook = getattr(self._client, "add_reconnect_hook", None)
+        # a replaced master (or a relay->direct failover) has no delta
+        # baseline for this agent; it will reply resync=True on first
+        # contact, but re-sending full proactively on reconnect saves
+        # that round-trip
+        add_hook = getattr(report_client, "add_reconnect_hook", None)
         if add_hook is not None:
             add_hook(
                 "report-resync",

@@ -51,6 +51,14 @@ ENV_BACKOFF_CAP = "DLROVER_TPU_MASTER_RECONNECT_BACKOFF_MAX"
 BACKOFF_BASE = 0.25
 DEFAULT_BACKOFF_CAP = 15.0
 
+#: relay-tier failover (ISSUE 16): when the client's primary address is
+#: an aggregator relay and it stays unreachable this long, the
+#: supervisor re-points the channel at the fallback (direct-master)
+#: address and keeps probing — the relay tier degrades to PR 12's
+#: direct fan-in, it never partitions agents from the master.
+ENV_RELAY_FAILOVER = "DLROVER_TPU_RELAY_FAILOVER_S"
+DEFAULT_RELAY_FAILOVER = 10.0
+
 #: public MasterClient methods deliberately NOT supervised (the AST lint
 #: in tests/test_reconnect_supervisor.py enforces this list is the only
 #: gap): ``ping`` IS the supervisor's liveness probe and its contract is
@@ -95,7 +103,9 @@ class ConnectionSupervisor:
     call retries."""
 
     def __init__(self, client: GenericRpcClient, node_desc: str = "",
-                 reconnect_timeout: Optional[float] = None):
+                 reconnect_timeout: Optional[float] = None,
+                 fallback_addr: Optional[str] = None,
+                 failover_after: Optional[float] = None):
         self._client = client
         self._node_desc = node_desc
         if reconnect_timeout is None:
@@ -107,6 +117,18 @@ class ConnectionSupervisor:
         self._backoff_cap = float(
             os.getenv(ENV_BACKOFF_CAP, "") or DEFAULT_BACKOFF_CAP
         )
+        # relay -> direct-master failover: when set, an outage longer
+        # than failover_after re-points the channel at fallback_addr
+        # (once); the normal probe/re-hello machinery then reconnects
+        self._fallback_addr = fallback_addr
+        if failover_after is None:
+            failover_after = float(
+                os.getenv(ENV_RELAY_FAILOVER, "")
+                or DEFAULT_RELAY_FAILOVER
+            )
+        self._failover_after = failover_after
+        self._failed_over = False
+        self._reset_pending = False
         self._hooks: Dict[str, Callable[[], None]] = {}
         self._state_lock = threading.Lock()
         self._connected = True
@@ -201,14 +223,56 @@ class ConnectionSupervisor:
             node=self._node_desc,
         )
 
+    def _maybe_fail_over(self):
+        """Relay tier: after ``_failover_after`` seconds of outage,
+        re-point the channel at the direct-master fallback (once). The
+        channel swap happens OUTSIDE the state lock — it closes a gRPC
+        channel — and the racing probe that follows is idempotent."""
+        with self._state_lock:
+            if (self._fallback_addr is None or self._failed_over
+                    or self._connected
+                    or time.time() - self._lost_at
+                    < self._failover_after):
+                return
+            self._failed_over = True
+            fallback = self._fallback_addr
+        logger.warning(
+            "relay at %s unreachable for %.1fs — failing over to "
+            "master at %s", self._client.addr,
+            self._failover_after, fallback,
+        )
+        record(
+            "relay.failover",
+            node=self._node_desc,
+            relay_addr=self._client.addr,
+            master_addr=fallback,
+            after_s=self._failover_after,
+        )
+        self._client.reset(fallback)
+
     def _try_reconnect(self) -> bool:
         """Probe the master; on success run re-hello hooks and flip back
         to connected. Serialized: concurrent stranded threads wait on
         the lock and see _connected already True."""
+        self._maybe_fail_over()
+        if self._reset_pending:
+            # A channel that watched its server die can wedge in
+            # TRANSIENT_FAILURE far past any configured backoff: a
+            # fresh channel (and raw TCP) reaches the restarted master
+            # instantly while this one keeps failing every RPC without
+            # dialing. After a failed probe, re-dial on a brand-new
+            # channel. Outside the state lock — reset() closes a gRPC
+            # channel; the flag race is benign (an extra reset just
+            # recreates an idle channel).
+            self._reset_pending = False
+            reset = getattr(self._client, "reset", None)
+            if reset is not None:
+                reset(self._client.addr)
         with self._state_lock:
             if self._connected:
                 return True
             if not self._raw_ping():
+                self._reset_pending = True
                 return False
             self._local.bypass = True
             try:
@@ -254,7 +318,13 @@ class MasterClient:
 
     def __init__(self, master_addr: str, node_id: int, node_type: str,
                  timeout: float = 30.0,
-                 reconnect_timeout: Optional[float] = None):
+                 reconnect_timeout: Optional[float] = None,
+                 fallback_addr: Optional[str] = None,
+                 failover_after: Optional[float] = None):
+        """``master_addr`` may be an aggregator relay (ISSUE 16); then
+        ``fallback_addr`` is the real master and the supervisor fails
+        over relay -> direct after ``failover_after`` seconds of
+        outage."""
         self._client = GenericRpcClient(master_addr, timeout=timeout)
         self._node_id = node_id
         self._node_type = node_type
@@ -263,6 +333,8 @@ class MasterClient:
             self._client,
             node_desc=f"{node_type}-{node_id}",
             reconnect_timeout=reconnect_timeout,
+            fallback_addr=fallback_addr,
+            failover_after=failover_after,
         )
 
     def add_reconnect_hook(self, name: str, fn: Callable[[], None]):
@@ -479,6 +551,23 @@ class MasterClient:
                 raise
             logger.warning("report_node_status unsupported: %s", e)
             record("report.rpc_fallback", rpc="report_node_status",
+                   error=str(e)[:200])
+            return None
+
+    @supervised_rpc
+    def report_relay_batch(self, batch: comm.RelayBatchReport):
+        """An aggregator relay's coalesced upstream interval
+        (agent/relay.py): its agents' re-delta'd reports in one call.
+        Returns the :class:`~dlrover_tpu.common.comm.RelayBatchAck`, or
+        ``None`` when the master predates the RPC — the relay then
+        degrades to forwarding per-agent ``report_node_status`` calls."""
+        try:
+            return self._call("report_relay_batch", self._fill(batch))
+        except Exception as e:
+            if is_connection_error(e):
+                raise
+            logger.warning("report_relay_batch unsupported: %s", e)
+            record("report.rpc_fallback", rpc="report_relay_batch",
                    error=str(e)[:200])
             return None
 

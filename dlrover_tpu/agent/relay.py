@@ -1,0 +1,440 @@
+"""Aggregator relay — the middle tier of the hierarchical fan-in
+(ISSUE 16 tentpole c).
+
+At 10k agents even a sharded, event-loop master is doing 10k RPC
+round-trips per interval. The 100k-GPU HSDP result (PAPERS.md) shows
+the scaling move: put an aggregation tier between agents and master so
+master load grows with RELAY count, not world size. One relay fronts K
+agents (``DLROVER_TPU_RELAY_FANOUT``):
+
+* **downstream** it terminates its agents' ``report_node_status``
+  deltas with the exact master-side bookkeeping
+  (:class:`~dlrover_tpu.master.ingest.ReporterLedger`): ack
+  immediately, merge the sections into a per-agent state slot, answer
+  ``resync=True`` when the relay lost the agent's baseline (relay
+  restart) so the agent resends full — the agent cannot tell a relay
+  from a master;
+* **upstream** it re-deltas each agent's merged state against its own
+  last-acked-by-master baseline via the agent-side
+  :class:`~dlrover_tpu.agent.status_reporter.DeltaTracker` — the same
+  change detectors, thresholds and full/resync machinery — and
+  forwards ONE :class:`~dlrover_tpu.common.comm.RelayBatchReport` per
+  interval carrying only the agents that reported since the last
+  forward. Sub-reports keep their ORIGINAL reporter identity, so the
+  master's per-agent ledger (the exactly-once proof) is tier-agnostic;
+* the master's piggybacked actions ride back the same path with one
+  interval of latency: each batch-ack entry's ``action`` parks in the
+  agent's slot and is delivered on that agent's next report ack;
+* when a relay DIES, its agents' ConnectionSupervisors fail over to
+  the direct master address after ``DLROVER_TPU_RELAY_FAILOVER_S``
+  (master_client.py) and the standard reconnect re-hello resends full
+  state — the relay tier degrades to PR 12's direct fan-in, it never
+  partitions agents from the master.
+
+The relay only fronts the report lane; every other RPC (rendezvous,
+checkpoint consensus, shards) stays agent -> master direct. It answers
+``ping`` itself — the agents' supervisors probe RELAY liveness, and a
+live relay whose own master link is down rides its upstream
+supervisor, invisible to agents.
+"""
+
+import argparse
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.grpc_utils import GenericRpcServer
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.ingest import ReporterLedger
+from dlrover_tpu.telemetry import record
+
+#: agents per relay — launchers and the swarm bench size the tier as
+#: ceil(agents / fanout)
+ENV_RELAY_FANOUT = "DLROVER_TPU_RELAY_FANOUT"
+DEFAULT_RELAY_FANOUT = 256
+
+#: upstream forward cadence (seconds)
+ENV_RELAY_INTERVAL = "DLROVER_TPU_RELAY_INTERVAL"
+DEFAULT_RELAY_INTERVAL = 1.0
+
+#: where agents find their relay (set by the launcher); empty = no
+#: relay tier, agents report direct (agent/elastic/training.py)
+ENV_RELAY_ADDR = "DLROVER_TPU_RELAY_ADDR"
+
+
+def relay_fanout() -> int:
+    return int(
+        os.environ.get(ENV_RELAY_FANOUT, "0")
+    ) or DEFAULT_RELAY_FANOUT
+
+
+class _AgentSlot:
+    """One fronted agent: merged last-known state + the upstream
+    delta tracker. Mutated under the relay lock; the tracker is only
+    ever driven by the forward thread."""
+
+    __slots__ = (
+        "tracker", "timestamp", "step", "step_ts", "pid",
+        "goodput_fields", "resource", "host", "final", "fresh",
+        "pending_action", "upstream_seq",
+    )
+
+    def __init__(self, tracker):
+        self.tracker = tracker
+        self.timestamp = 0.0
+        self.step: Optional[int] = None
+        self.step_ts = 0.0
+        self.pid = 0
+        self.goodput_fields: Optional[Dict] = None
+        self.resource: Optional[Tuple[float, int]] = None
+        self.host = ""
+        self.final = False
+        self.fresh = False
+        self.pending_action = ""
+        #: last upstream seq the MASTER acked for this agent — the
+        #: bench's delivery-chain proof reads it
+        self.upstream_seq = -1
+
+
+class AggregatorRelay:
+    """One relay process/instance fronting up to K agents."""
+
+    def __init__(self, master_addr: str, relay_id: int = 0,
+                 port: int = 0, interval: Optional[float] = None,
+                 ledger_cap: Optional[int] = None,
+                 rpc_timeout: float = 30.0):
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        self.relay_id = relay_id
+        if interval is None:
+            interval = float(
+                os.environ.get(ENV_RELAY_INTERVAL, "0")
+            ) or DEFAULT_RELAY_INTERVAL
+        self._interval = max(0.05, interval)
+        self._lock = threading.Lock()
+        self._slots: Dict[Tuple[str, int], _AgentSlot] = {}
+        self._ledger = (
+            ReporterLedger(cap=ledger_cap) if ledger_cap
+            else ReporterLedger()
+        )
+        self._upstream = MasterClient(
+            master_addr, node_id=relay_id, node_type="relay",
+            timeout=rpc_timeout,
+        )
+        #: None = undecided, False = master predates the batch RPC —
+        #: forward per-agent report_node_status instead
+        self._batch_supported: Optional[bool] = None
+        self._stopped = threading.Event()
+        self._kick = threading.Event()
+        self._flush_on_stop = True
+        self._thread: Optional[threading.Thread] = None
+        self._server = GenericRpcServer(self.handle, port=port)
+        self.port = self._server.port
+        # observability (read by the bench after stop; single-writer
+        # forward thread, so plain ints suffice)
+        self.forwarded_batches = 0
+        self.forwarded_reports = 0
+        self.upstream_sheds = 0
+        self.downstream_reports = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        self._server.start()
+        self._thread = threading.Thread(
+            target=self._run, name=f"relay-forward-{self.relay_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        record(
+            "relay.started", relay_id=self.relay_id, port=self.port,
+            interval_s=self._interval,
+        )
+
+    def stop(self, flush: bool = True, grace: float = 0.5):
+        """``flush=False`` is the crash drill: drop everything pending
+        (agents re-deliver through failover + resync)."""
+        self._flush_on_stop = flush
+        self._stopped.set()
+        self._kick.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self._server.stop(grace)
+        record(
+            "relay.stopped", relay_id=self.relay_id, flushed=flush,
+            forwarded=self.forwarded_reports,
+        )
+
+    def kill(self):
+        """Simulate relay death for failover drills: stop serving
+        without flushing upstream state."""
+        self.stop(flush=False, grace=0.0)
+
+    # ----------------------------------------------------------- downstream
+
+    def handle(self, method: str, message):
+        if method == "report_node_status":
+            return self._terminate_report(message)
+        if method == "report_heartbeat":
+            return self._terminate_heartbeat(message)
+        if method == "ping":
+            # relay liveness: the agents' supervisors probe THIS
+            return comm.Response(success=True)
+        raise ValueError(
+            f"relay does not front RPC {method} — call the master "
+            "directly"
+        )
+
+    def _slot_for_locked(self, key: Tuple[str, int],
+                         incarnation: int) -> _AgentSlot:
+        """Lock held by caller (repo convention: ``*_locked``). A new
+        incarnation replaces the slot: its delta baselines describe a
+        dead process."""
+        from dlrover_tpu.agent.status_reporter import DeltaTracker
+
+        slot = self._slots.get(key)
+        if slot is None or slot.tracker._incarnation != incarnation:
+            slot = _AgentSlot(DeltaTracker(incarnation=incarnation))
+            self._slots[key] = slot
+        return slot
+
+    def _terminate_report(
+        self, req: comm.NodeStatusReport
+    ) -> comm.NodeStatusAck:
+        key = (req.node_type, req.node_id)
+        resync = self._ledger.observe(
+            key, req.incarnation, req.seq, req.full, req.timestamp
+        )
+        with self._lock:
+            slot = self._slot_for_locked(key, req.incarnation)
+            slot.timestamp = req.timestamp
+            if req.has_step:
+                slot.step = req.step
+                slot.step_ts = req.step_ts
+                slot.pid = req.pid
+            if req.has_goodput:
+                slot.goodput_fields = {
+                    "goodput_phases": dict(req.goodput_phases),
+                    "goodput_elapsed_s": req.goodput_elapsed_s,
+                    "goodput_start_ts": req.goodput_start_ts,
+                    "goodput_phase": req.goodput_phase,
+                }
+                slot.pid = req.pid
+            if req.has_resource:
+                slot.resource = (req.cpu_percent, req.memory_mb)
+            if req.host:
+                slot.host = req.host
+            if req.final:
+                slot.final = True
+            slot.fresh = True
+            action = slot.pending_action
+            slot.pending_action = ""
+            self.downstream_reports += 1
+        return comm.NodeStatusAck(
+            accepted=True, action=action, resync=resync,
+            acked_seq=req.seq,
+        )
+
+    def _terminate_heartbeat(self, req) -> comm.HeartbeatResponse:
+        """Legacy lane for degraded reporters: liveness still flows."""
+        key = (req.node_type, req.node_id)
+        with self._lock:
+            slot = self._slot_for_locked(key, 0)
+            slot.timestamp = req.timestamp
+            slot.fresh = True
+            action = slot.pending_action
+            slot.pending_action = ""
+            self.downstream_reports += 1
+        return comm.HeartbeatResponse(action=action)
+
+    # ------------------------------------------------------------- upstream
+
+    def _run(self):
+        while not self._stopped.is_set():
+            self._kick.wait(self._interval)
+            self._kick.clear()
+            if self._stopped.is_set():
+                break
+            self._forward_once()
+        if self._flush_on_stop:
+            self._forward_once()
+
+    def _compose_batch(self):
+        """Snapshot fresh slots under the lock, compose outside it
+        (compose runs change detectors — keep it off the ack path)."""
+        with self._lock:
+            fresh = [
+                (key, slot) for key, slot in self._slots.items()
+                if slot.fresh
+            ]
+            for _key, slot in fresh:
+                slot.fresh = False
+            snapshots = [
+                (
+                    key, slot, slot.timestamp, slot.step, slot.step_ts,
+                    slot.pid, slot.goodput_fields, slot.resource,
+                    slot.host, slot.final,
+                )
+                for key, slot in fresh
+            ]
+        reports, slots = [], []
+        for (key, slot, ts, step, step_ts, pid, goodput, resource,
+             host, final) in snapshots:
+            report = slot.tracker.compose(
+                ts, step=step, step_ts=step_ts, pid=pid,
+                goodput_fields=goodput, resource=resource, host=host,
+                final=final,
+            )
+            # the sub-report travels under the AGENT's identity: the
+            # master's ledger must stay keyed by original reporter
+            report.node_type, report.node_id = key
+            reports.append(report)
+            slots.append((key, slot))
+        return reports, slots
+
+    def _forward_once(self):
+        reports, slots = self._compose_batch()
+        if not reports:
+            return
+        try:
+            if self._batch_supported is False:
+                acks = self._forward_individually(reports)
+            else:
+                acks = self._forward_batch(reports)
+        except Exception as e:
+            record(
+                "relay.forward_failed", relay_id=self.relay_id,
+                reports=len(reports), error=str(e)[:200],
+            )
+            logger.warning(
+                "relay %d upstream forward failed (%d reports): %s",
+                self.relay_id, len(reports), e,
+            )
+            with self._lock:
+                for _key, slot in slots:
+                    slot.fresh = True  # recompose next interval
+            return
+        self._commit_acks(slots, reports, acks)
+
+    def _forward_batch(self, reports) -> List[comm.NodeStatusAck]:
+        batch = comm.RelayBatchReport(
+            reports=reports, relay_incarnation=0,
+        )
+        attempts = 0
+        while True:
+            ack = self._upstream.report_relay_batch(batch)
+            if ack is None:
+                # master predates the batch RPC: degrade permanently
+                self._batch_supported = False
+                return self._forward_individually(reports)
+            self._batch_supported = True
+            if ack.accepted:
+                self.forwarded_batches += 1
+                self.forwarded_reports += len(reports)
+                return ack.acks
+            # batch-level shed: same payload, honored retry-after.
+            # Bounded: a master that sheds forever is a forward
+            # failure — the slots re-mark fresh and next interval
+            # recomposes (the trackers never committed).
+            self.upstream_sheds += 1
+            attempts += 1
+            if attempts >= 10:
+                raise RuntimeError(
+                    f"master shed the relay batch {attempts} times"
+                )
+            if self._stopped.is_set() and not self._flush_on_stop:
+                return []
+            time.sleep(ack.retry_after_s or 0.5)
+
+    def _forward_individually(self, reports) -> List[comm.NodeStatusAck]:
+        """Mixed-fleet fallback: the coalescing is lost but delivery
+        survives against a PR 12 master."""
+        acks = []
+        for r in reports:
+            ack = self._upstream._supervisor.call(
+                "report_node_status",
+                lambda r=r: self._upstream._client.call(
+                    "report_node_status", r
+                ),
+            )
+            acks.append(ack)
+            self.forwarded_reports += 1
+        return acks
+
+    def _commit_acks(self, slots, reports, acks):
+        for (key, slot), report, ack in zip(slots, reports, acks):
+            if ack is None or not ack.accepted:
+                with self._lock:
+                    slot.fresh = True
+                continue
+            # forward-thread-only state: tracker + upstream_seq
+            slot.tracker.commit(report)
+            slot.upstream_seq = ack.acked_seq
+            if ack.resync:
+                # the MASTER lost this agent's baseline (restart):
+                # resend full from the relay's merged state next time
+                slot.tracker.request_full()
+            if ack.action:
+                with self._lock:
+                    slot.pending_action = ack.action
+            if slot.final:
+                with self._lock:
+                    self._slots.pop(key, None)
+                self._ledger.evict(key)
+
+    # -------------------------------------------------------------- views
+
+    def delivery_snapshot(self) -> Dict[Tuple[str, int], Dict[str, int]]:
+        """Per-agent delivery chain for the bench's zero-drop proof:
+        the seq the relay acked downstream vs the seq the master acked
+        upstream."""
+        down = self._ledger.snapshot()
+        with self._lock:
+            return {
+                key: {
+                    "downstream_seq": down.get(key, (-1, -1))[1],
+                    "upstream_seq": slot.upstream_seq,
+                }
+                for key, slot in self._slots.items()
+            }
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            agents = len(self._slots)
+            downstream = self.downstream_reports
+        return {
+            "relay_id": self.relay_id,
+            "agents": agents,
+            "downstream_reports": downstream,
+            "forwarded_batches": self.forwarded_batches,
+            "forwarded_reports": self.forwarded_reports,
+            "upstream_sheds": self.upstream_sheds,
+        }
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="dlrover-tpu aggregator relay (ISSUE 16)"
+    )
+    parser.add_argument("--master_addr", required=True)
+    parser.add_argument("--relay_id", type=int, default=0)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--interval", type=float, default=None)
+    ns = parser.parse_args()
+    relay = AggregatorRelay(
+        ns.master_addr, relay_id=ns.relay_id, port=ns.port,
+        interval=ns.interval,
+    )
+    relay.start()
+    print(f"PORT {relay.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        relay.stop()
+
+
+if __name__ == "__main__":
+    main()

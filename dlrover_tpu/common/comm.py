@@ -592,6 +592,33 @@ class NodeStatusAck(BaseMessage):
 
 
 @dataclass
+class RelayBatchReport(BaseRequest):
+    """An aggregator relay's coalesced upstream interval (ISSUE 16):
+    one RPC carrying its agents' re-delta'd NodeStatusReports. The
+    relay's own identity rides the BaseRequest node fields; each
+    sub-report keeps its ORIGINAL reporter identity, so the master's
+    per-agent ledger (the exactly-once proof) is tier-agnostic."""
+
+    reports: List[NodeStatusReport] = field(default_factory=list)
+    #: relay restart count — diagnostics only; per-agent delta state
+    #: rides each sub-report's own (incarnation, seq)
+    relay_incarnation: int = -1
+
+
+@dataclass
+class RelayBatchAck(BaseMessage):
+    """Reply to RelayBatchReport. ``accepted=False`` is a batch-level
+    shed (no sub-report applied — retry the SAME batch after
+    ``retry_after_s``); otherwise ``acks`` aligns with
+    ``reports`` by index and each entry carries that agent's
+    resync/action/acked_seq exactly as a direct report would."""
+
+    accepted: bool = True
+    retry_after_s: float = 0.0
+    acks: List[NodeStatusAck] = field(default_factory=list)
+
+
+@dataclass
 class ModelInfo(BaseRequest):
     param_count: int = 0
     flops_per_step: float = 0.0

@@ -528,6 +528,7 @@ class DistributedJobMaster:
                 logger.warning("goodput summary failed: %s", e)
         goodput_mod.set_job_provider(None)
         self._server.stop(grace=1.0)
+        self.servicer.close()  # ingest shard executors
         if self.state_journal is not None:
             # drain the group-commit lane: everything staged lands in
             # one final transaction before the process exits

@@ -6,9 +6,9 @@ envelope (common/grpc_utils.py); each public ``rpc_*`` method here is one
 RPC from the reference service (elastic_training.proto:243-299).
 """
 
+import asyncio
 import json
 import os
-import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -19,13 +19,19 @@ from dlrover_tpu.common.constants import (
     TaskType,
     TrainingExceptionLevel,
 )
-from dlrover_tpu.common.grpc_utils import GenericRpcServer
+from dlrover_tpu.common.grpc_utils import AsyncRpcServer, GenericRpcServer
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.elastic_training.kv_store_service import (
     KVStoreService,
 )
+from dlrover_tpu.master.ingest import IngestPlane
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
 from dlrover_tpu.telemetry import counter, histogram, record, tracing
+
+#: event-loop front end for the report lane (AsyncRpcServer); "0"
+#: falls back to the all-threaded GenericRpcServer — same wire, same
+#: semantics, one knob to bisect a regression
+ENV_ASYNC_INGEST = "DLROVER_TPU_ASYNC_INGEST"
 
 #: sub-millisecond KV polls up to multi-second shard waits
 _RPC_BUCKETS = (
@@ -92,25 +98,12 @@ class MasterServicer:
         self._max_rollbacks = int(
             os.environ.get("DLROVER_TPU_MAX_ROLLBACKS", "3")
         )
-        # --- batched report path (ISSUE 12) -------------------------
-        # delta baseline per reporter: (incarnation, seq) last applied.
-        # A reporter we've never seen (master restart) gets resync=True
-        # so its next report is full — deltas against a baseline the
-        # master lost would silently drop state.
-        self._reporters = {}
-        self._reporters_lock = threading.Lock()
-        # bounded admission: when this many report_node_status handlers
-        # are already in flight, shed the call with retry-after instead
-        # of queueing it into collapse. Kept under the gRPC pool size so
-        # shard/rendezvous RPCs always have threads left.
-        self._report_inflight = 0
-        self._report_inflight_limit = int(
-            os.environ.get("DLROVER_TPU_REPORT_INFLIGHT_LIMIT", "48")
-        )
-        self._report_retry_after = float(
-            os.environ.get("DLROVER_TPU_REPORT_RETRY_AFTER", "0.5")
-        )
-        self._last_shed_log = 0.0
+        # --- batched report path (ISSUE 12 -> 16) -------------------
+        # per-reporter delta state (acked-seq ledger, resync, bounded
+        # admission, eviction) now lives in the sharded ingest plane:
+        # N independent slices, no cross-shard locks, one apply lane
+        # per shard under the event-loop front end.
+        self._ingest = IngestPlane()
         # method -> (requests counter child, latency histogram child):
         # binding the labelled children once keeps the registry walk
         # off the per-RPC dispatch path
@@ -126,16 +119,30 @@ class MasterServicer:
             if self._job_manager else []
         )
 
+    # ---------------------------------------------------- ingest-plane views
+
+    @property
+    def _reporters(self) -> Dict[Tuple[str, int], Tuple[int, int]]:
+        """Merged (incarnation, seq) ledger view across ingest shards —
+        the pre-shard attribute's read surface (bench delivery proof,
+        ledger tests) kept as a property."""
+        return self._ingest.reporters()
+
+    @property
+    def _report_inflight_limit(self) -> int:
+        return self._ingest.inflight_limit
+
+    @_report_inflight_limit.setter
+    def _report_inflight_limit(self, limit: int):
+        self._ingest.inflight_limit = limit
+
+    def close(self):
+        """Release ingest-plane executors (master shutdown)."""
+        self._ingest.close()
+
     # ------------------------------------------------------------- dispatch
 
-    def handle(self, method: str, message):
-        fn = getattr(self, f"rpc_{method}", None)
-        if fn is None:
-            counter(
-                "dlrover_rpc_errors_total",
-                "RPCs that raised in the servicer", ["method"],
-            ).labels(method=method).inc()
-            raise ValueError(f"unknown RPC method {method}")
+    def _bound_metrics(self, method: str) -> Tuple[object, object]:
         bound = self._method_metrics.get(method)
         if bound is None:
             bound = (
@@ -151,7 +158,17 @@ class MasterServicer:
                 ).labels(method=method),
             )
             self._method_metrics[method] = bound
-        requests_c, latency_h = bound
+        return bound
+
+    def handle(self, method: str, message):
+        fn = getattr(self, f"rpc_{method}", None)
+        if fn is None:
+            counter(
+                "dlrover_rpc_errors_total",
+                "RPCs that raised in the servicer", ["method"],
+            ).labels(method=method).inc()
+            raise ValueError(f"unknown RPC method {method}")
+        requests_c, latency_h = self._bound_metrics(method)
         requests_c.inc()
         t0 = time.perf_counter()
         try:
@@ -711,47 +728,16 @@ class MasterServicer:
         backed on the ack. Bounded admission: past the in-flight limit
         the call is shed un-applied with a retry-after — the agent
         retries the SAME payload, so load degrades latency, not
-        delivery."""
-        with self._reporters_lock:
-            if self._report_inflight >= self._report_inflight_limit:
-                counter(
-                    "dlrover_report_shed_total",
-                    "batched reports shed with retry-after",
-                ).inc()
-                now = time.monotonic()
-                if now - self._last_shed_log > 1.0:
-                    self._last_shed_log = now
-                    record(
-                        "control.load_shed",
-                        inflight=self._report_inflight,
-                        limit=self._report_inflight_limit,
-                        retry_after_s=self._report_retry_after,
-                    )
-                return comm.NodeStatusAck(
-                    accepted=False,
-                    retry_after_s=self._report_retry_after,
-                )
-            self._report_inflight += 1
-        try:
-            return self._apply_node_status(req)
-        finally:
-            with self._reporters_lock:
-                self._report_inflight -= 1
+        delivery. Ledger, admission and resync live in the sharded
+        ingest plane (ISSUE 16); this is the threaded lane."""
+        return self._ingest.report(req, self._apply_status_sections)
 
-    def _apply_node_status(
-        self, req: comm.NodeStatusReport
-    ) -> comm.NodeStatusAck:
-        key = (req.node_type, req.node_id)
-        resync = False
-        with self._reporters_lock:
-            last = self._reporters.get(key)
-            if not req.full and (
-                last is None or last[0] != req.incarnation
-            ):
-                # unknown reporter (master restarted) or stale baseline
-                # (new incarnation): deltas don't apply — ask for full
-                resync = True
-            self._reporters[key] = (req.incarnation, req.seq)
+    def _apply_status_sections(self, req: comm.NodeStatusReport) -> str:
+        """Fan one report's sections out to the shared consumers;
+        returns the piggy-backed action. The per-reporter bookkeeping
+        (ledger/resync/eviction) is the ingest plane's job — this is
+        purely the section application, shared by both lanes and the
+        relay batch path."""
         action = ""
         if self._job_manager:
             action = self._job_manager.collect_node_heartbeat(
@@ -781,10 +767,129 @@ class MasterServicer:
                 req.node_type, req.node_id, req.cpu_percent,
                 req.memory_mb, [],
             )
-        return comm.NodeStatusAck(
-            accepted=True, action=action, resync=resync,
-            acked_seq=req.seq,
-        )
+        return action
+
+    # -------------------------------------------- event-loop ingest (hot)
+
+    def _ingest_apply(self, req: comm.NodeStatusReport,
+                      shard) -> comm.NodeStatusAck:
+        """Apply one admitted report on its shard executor, with the
+        same metrics/tracing the threaded dispatch would have added
+        (the hot lane bypasses handle())."""
+        requests_c, latency_h = self._bound_metrics("report_node_status")
+        requests_c.inc()
+        t0 = time.perf_counter()
+        try:
+            with tracing.span("rpc.report_node_status"):
+                return self._ingest.apply(
+                    req, self._apply_status_sections, shard=shard
+                )
+        except Exception:
+            counter(
+                "dlrover_rpc_errors_total",
+                "RPCs that raised in the servicer", ["method"],
+            ).labels(method="report_node_status").inc()
+            raise
+        finally:
+            latency_h.observe(time.perf_counter() - t0)
+
+    async def ingest_report_async(
+        self, req: comm.NodeStatusReport
+    ) -> comm.NodeStatusAck:
+        """The event-loop hot lane: admission and the shed ack cost no
+        thread; an admitted report applies on its shard's single-thread
+        executor, so per-shard application is serial and the in-flight
+        count covers queued work — overload (e.g. a write-through
+        journal) still sheds instead of queueing into collapse."""
+        shard = self._ingest.shard_of(req.node_type, req.node_id)
+        if not shard.try_admit():
+            return self._ingest.shed_ack(shard)
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                shard.executor, self._ingest_apply, req, shard
+            )
+        finally:
+            shard.release()
+
+    # ------------------------------------------------ relay batch ingest
+
+    def _admit_relay_groups(self, reports):
+        """Group a relay batch by ingest shard and admit ALL-OR-NOTHING
+        (one in-flight slot per involved shard, not per sub-report — a
+        312-report batch is one unit of work per shard, and partial
+        admission would shed most of every batch against a per-agent
+        sized limit). Returns (groups, admitted_shards) or (None, None)
+        after releasing everything when any shard is saturated."""
+        groups: Dict[object, list] = {}
+        for i, r in enumerate(reports):
+            shard = self._ingest.shard_of(r.node_type, r.node_id)
+            groups.setdefault(shard, []).append((i, r))
+        admitted = []
+        for shard in groups:
+            if shard.try_admit():
+                admitted.append(shard)
+                continue
+            for s in admitted:
+                s.release()
+            shard.note_shed(self._ingest.retry_after)
+            return None, None
+        return groups, admitted
+
+    def rpc_report_relay_batch(
+        self, req: comm.RelayBatchReport
+    ) -> comm.RelayBatchAck:
+        """Threaded lane for an aggregator relay's coalesced batch:
+        every sub-report is a normal NodeStatusReport that went through
+        the relay's upstream DeltaTracker; acks align by index."""
+        groups, admitted = self._admit_relay_groups(req.reports)
+        if groups is None:
+            return comm.RelayBatchAck(
+                accepted=False, retry_after_s=self._ingest.retry_after,
+            )
+        try:
+            acks = [None] * len(req.reports)
+            for shard, items in groups.items():
+                for i, r in items:
+                    acks[i] = self._ingest.apply(
+                        r, self._apply_status_sections, shard=shard
+                    )
+            return comm.RelayBatchAck(accepted=True, acks=acks)
+        finally:
+            for s in admitted:
+                s.release()
+
+    async def ingest_relay_batch_async(
+        self, req: comm.RelayBatchReport
+    ) -> comm.RelayBatchAck:
+        """Event-loop lane for relay batches: per-shard groups apply
+        concurrently, each serial on its own shard executor."""
+        groups, admitted = self._admit_relay_groups(req.reports)
+        if groups is None:
+            return comm.RelayBatchAck(
+                accepted=False, retry_after_s=self._ingest.retry_after,
+            )
+        loop = asyncio.get_running_loop()
+
+        def apply_group(shard, items):
+            return [
+                (i, self._ingest_apply(r, shard)) for i, r in items
+            ]
+
+        try:
+            results = await asyncio.gather(*[
+                loop.run_in_executor(
+                    shard.executor, apply_group, shard, items
+                )
+                for shard, items in groups.items()
+            ])
+        finally:
+            for s in admitted:
+                s.release()
+        acks = [None] * len(req.reports)
+        for group in results:
+            for i, ack in group:
+                acks[i] = ack
+        return comm.RelayBatchAck(accepted=True, acks=acks)
 
     def rpc_report_model_info(self, req: comm.ModelInfo) -> comm.Response:
         if self._job_metric_collector:
@@ -928,5 +1033,15 @@ def create_master_service(
         request_router=request_router,
         transition_coordinator=transition_coordinator,
     )
-    server = GenericRpcServer(servicer.handle, port=port)
+    use_async = os.environ.get(ENV_ASYNC_INGEST, "1") != "0"
+    if use_async:
+        server = AsyncRpcServer(
+            servicer.handle, port=port,
+            hot_handlers={
+                "report_node_status": servicer.ingest_report_async,
+                "report_relay_batch": servicer.ingest_relay_batch_async,
+            },
+        )
+    else:
+        server = GenericRpcServer(servicer.handle, port=port)
     return server, servicer

@@ -1,0 +1,13 @@
+"""Fixture: exactly one no-blocking-in-async violation — a sync sleep
+inside an async handler stalls the event loop and every in-flight RPC
+scheduled on it."""
+
+import asyncio
+import time
+
+
+class Dispatcher:
+    async def dispatch(self, request):
+        await asyncio.sleep(0)  # fine: awaited, yields the loop
+        time.sleep(0.5)  # the violation: blocks the whole loop
+        return request

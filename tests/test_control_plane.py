@@ -408,7 +408,9 @@ def test_swarm_bench_smoke():
     """The swarm bench's tier-1 smoke tier end to end: a real gRPC
     master per phase, batched beats unary, the journal coalesces, the
     shed phase actually sheds, and NO agent's last-acked seq diverges
-    from the master's ledger — zero dropped heartbeats."""
+    from the master's ledger — zero dropped heartbeats. --smoke also
+    forces a 2-relay aggregator tier (ISSUE 16): two-hop delivery must
+    hold (relay_phase_dropped == 0) with real coalesced forwarding."""
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                DLROVER_TPU_METRICS_PORT="off")
     out = subprocess.run(
@@ -425,3 +427,8 @@ def test_swarm_bench_smoke():
     assert result["shed_phase_sheds"] > 0
     assert result["dropped"] == 0
     assert result["shed_phase_dropped"] == 0
+    # the relay tier (--smoke forces --relays 2)
+    assert result["relays"] == 2
+    assert result["relay_phase_dropped"] == 0
+    assert result["relay_forwarded_batches"] > 0
+    assert result["relay_forwarded_reports"] > 0

@@ -47,6 +47,7 @@ EXPECTED_ANCHORS = {
     "thread-name": "Thread",
     "lock-discipline": "Ledger._items",
     "blocking-under-lock": "poll:time.sleep",
+    "no-blocking-in-async": "dispatch:time.sleep",
     "commit-before-reply": "get_task:no-persist",
     "knob-registry": "default:DLROVER_TPU_FIXTURE_ONLY_KNOB",
 }

@@ -64,14 +64,21 @@ def test_disabled_span_is_shared_noop_and_allocation_free():
                 pass
 
     run(100)  # warm caches/freelists
-    gc.collect()
-    before = sys.getallocatedblocks()
-    run(2000)
-    gc.collect()
-    after = sys.getallocatedblocks()
     # allocation-free: a couple of blocks of slack for interpreter
-    # noise, nothing proportional to the 2000 calls
-    assert after - before <= 4
+    # noise, nothing proportional to the 2000 calls. Noise from
+    # unrelated threads is strictly additive, so best-of-3 keeps the
+    # invariant sharp (a real per-call allocation taints every trial)
+    # without failing on a stray background wakeup mid-measurement.
+    deltas = []
+    for _ in range(3):
+        gc.collect()
+        before = sys.getallocatedblocks()
+        run(2000)
+        gc.collect()
+        deltas.append(sys.getallocatedblocks() - before)
+        if min(deltas) <= 4:
+            break
+    assert min(deltas) <= 4, deltas
     assert len(tracing.tail(10)) == 0  # and nothing was recorded
 
 

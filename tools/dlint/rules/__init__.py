@@ -18,6 +18,7 @@ from tools.dlint.rules.locks import (
     BlockingUnderLockRule,
     LockDisciplineRule,
 )
+from tools.dlint.rules.eventloop import NoBlockingInAsyncRule
 from tools.dlint.rules.reply import CommitBeforeReplyRule
 from tools.dlint.rules.knobs import KnobRegistryRule
 
@@ -31,6 +32,7 @@ ALL_RULES = [
     ThreadNameRule,
     LockDisciplineRule,
     BlockingUnderLockRule,
+    NoBlockingInAsyncRule,
     CommitBeforeReplyRule,
     KnobRegistryRule,
 ]

@@ -1,0 +1,94 @@
+"""Event-loop discipline: no blocking work in ``async def`` bodies
+(ISSUE 16).
+
+The async ingest front end (common/grpc_utils.py ``AsyncRpcServer``)
+replaced thread-per-RPC with ONE event loop for the hot report path.
+That inverts the blocking calculus: under the thread pool a stray
+``time.sleep`` stalled one RPC; on the loop it stalls EVERY in-flight
+RPC — at 10k agents, the whole control plane. The contract:
+
+* an ``async def`` body never calls a synchronous blocker directly —
+  ``time.sleep``, ``open``/fsync-class file I/O, ``subprocess.*``, a
+  bare ``<lock>.acquire()``, or a sync RPC (receiver named
+  ``*client``/``*stub``, the blocking-under-lock convention);
+* awaited expressions are exempt (``await asyncio.sleep`` yields, it
+  doesn't block), and so are nested function bodies — they execute
+  later, usually on an executor (``run_in_executor`` is exactly how
+  the ingest plane offloads its blocking section application).
+"""
+
+import ast
+from typing import Optional
+
+from tools.dlint.core import FileContext, Rule
+from tools.dlint.rules.locks import _LOCK_NAME
+
+
+class NoBlockingInAsyncRule(Rule):
+    id = "no-blocking-in-async"
+    title = "async def bodies never block the event loop"
+    interest = (ast.AsyncFunctionDef,)
+    targets = ("dlrover_tpu/",)
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        assert isinstance(node, ast.AsyncFunctionDef)
+        for call in self._direct_calls(node):
+            if isinstance(ctx.parents.get(call), ast.Await):
+                continue  # awaited = cooperatively scheduled
+            why = self._blocking_reason(call)
+            if why is None:
+                continue
+            call_text = ast.unparse(call.func)
+            self.report(
+                ctx.relpath, call.lineno,
+                f"{why} `{call_text}(...)` inside `async def "
+                f"{node.name}` blocks the event loop (and every "
+                "in-flight RPC with it) — await an async equivalent "
+                "or offload via loop.run_in_executor",
+                anchor=f"{node.name}:{call_text}",
+            )
+
+    # ------------------------------------------------------------ helpers
+
+    @staticmethod
+    def _direct_calls(fn: ast.AsyncFunctionDef):
+        """Calls in the coroutine body itself; nested def/lambda bodies
+        execute later (typically on an executor), and nested async
+        defs get their own visit."""
+        out = []
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        out.sort(key=lambda c: (c.lineno, c.col_offset))
+        return out
+
+    @staticmethod
+    def _blocking_reason(call: ast.Call) -> Optional[str]:
+        f = call.func
+        text = ast.unparse(f)
+        if text == "sleep" or text.endswith(".sleep"):
+            return "sync sleep"
+        if text == "open":
+            return "file I/O"
+        if text in ("os.fsync", "os.fdatasync", "os.replace"):
+            return "file I/O"
+        if text.startswith("subprocess."):
+            return "subprocess"
+        if isinstance(f, ast.Attribute):
+            recv = ast.unparse(f.value)
+            low = recv.lower()
+            if f.attr == "acquire" and _LOCK_NAME.search(recv):
+                return "bare lock acquire"
+            if f.attr in ("call", "wait", "wait_for", "result") and (
+                low.endswith("client") or low.endswith("stub")
+            ):
+                return "sync RPC"
+            if low.endswith("client") or low.endswith("stub"):
+                return "sync RPC"
+        return None

@@ -111,6 +111,14 @@ VOCABULARY = {
         "ckpt.shard_refetch",
         "ckpt.topology_restore",
     })),
+    # ISSUE 16: the aggregator relay tier (agent/relay.py) and the
+    # agents' relay -> direct-master failover (master_client.py)
+    "relay": (("relay",), frozenset({
+        "relay.started",
+        "relay.stopped",
+        "relay.forward_failed",
+        "relay.failover",
+    })),
     # ISSUE 15: the runtime lock-order watchdog
     # (telemetry/lockwatch.py) — cycle = potential deadlock in the
     # acquisition-order graph, long_hold = critical section over the
