@@ -103,6 +103,7 @@ def run_master(ns) -> int:
     from dlrover_tpu.master.state_journal import (
         build_master_state_journal,
     )
+    from dlrover_tpu.telemetry.fleet import FleetAggregator
     from dlrover_tpu.telemetry.goodput import GoodputAggregator
 
     journal = build_master_state_journal(
@@ -126,9 +127,10 @@ def run_master(ns) -> int:
         persist_fn=journal.save_goodput,
         persist_interval=ns.persist_interval,
     )
+    fleet_agg = FleetAggregator()
     server, servicer = create_master_service(
         0, job_manager=jm, speed_monitor=speed,
-        goodput_aggregator=goodput,
+        goodput_aggregator=goodput, fleet_aggregator=fleet_agg,
     )
     server.start()
     print(f"PORT {server.port}", flush=True)
@@ -142,6 +144,7 @@ def run_master(ns) -> int:
             for (t, i), (_inc, seq) in servicer._reporters.items()
         },
         "final_step": getattr(speed, "_global_step", 0),
+        "fleet": fleet_agg.snapshot(),
     }
     print("STATS " + json.dumps(stats), flush=True)
     return 0
@@ -223,15 +226,23 @@ def _percentile(sorted_vals, q: float) -> float:
 
 def _drive(master: MasterProc, mode: str, agents: int, threads: int,
            duration: float, steps_per_interval: int,
-           retry_cap: float = 0.5, addrs=None) -> dict:
+           retry_cap: float = 0.5, addrs=None, fleet=False) -> dict:
     """Hammer the master with interval-equivalent cycles until the
     deadline; returns throughput + latency + delivery accounting.
     ``addrs`` (relay tier) routes agent ``a`` to ``addrs[a % len]``
-    instead of the master directly."""
+    instead of the master directly. ``fleet`` attaches a per-agent
+    metric digest to every report (the ISSUE 17 roll-up lane) and
+    accounts its wire bytes against the bare delta's."""
     from dlrover_tpu.agent.status_reporter import DeltaTracker
     from dlrover_tpu.common import comm
     from dlrover_tpu.common.grpc_utils import GenericRpcClient
+    from dlrover_tpu.telemetry.fleet import DigestCollector
 
+    collectors = (
+        {a: DigestCollector() for a in range(agents)} if fleet else None
+    )
+    delta_bytes = [[] for _ in range(threads)]
+    digest_bytes = [[] for _ in range(threads)]
     lat = [[] for _ in range(threads)]
     cycles = [0] * threads
     sheds = [0] * threads
@@ -281,6 +292,24 @@ def _drive(master: MasterProc, mode: str, agents: int, threads: int,
             )
             rep.node_id = a
             rep.node_type = "worker"
+            if collectors is not None:
+                coll = collectors[a]
+                # synthetic step timings: 3 distinct durations keeps
+                # the sketch at steady-state bucket count
+                for k in range(steps_per_interval):
+                    coll.observe("step", 0.04 * (1 + (steps[a] + k) % 3))
+                coll.incr("steps", steps_per_interval)
+                digest = coll.compose()
+                if timed:
+                    # the wire-overhead claim: digest bytes vs the
+                    # bare steady-state delta it rides on
+                    delta_bytes[rank].append(len(comm.serialize(rep)))
+                    digest_bytes[rank].append(len(json.dumps(
+                        digest, separators=(",", ":"),
+                    )))
+                if digest:
+                    rep.has_metrics = True
+                    rep.metrics = digest
             landed = False
             while not landed:
                 t0 = time.perf_counter()
@@ -289,6 +318,8 @@ def _drive(master: MasterProc, mode: str, agents: int, threads: int,
                     lat[rank].append(time.perf_counter() - t0)
                 if ack.accepted:
                     trackers[a].commit(rep)
+                    if collectors is not None and rep.has_metrics:
+                        collectors[a].commit()
                     acked_seq[a] = rep.seq
                     landed = True
                 else:
@@ -363,6 +394,14 @@ def _drive(master: MasterProc, mode: str, agents: int, threads: int,
         "sheds": sum(sheds),
         "acked_seq": acked_seq,
         "errors": errors,
+        "delta_bytes_avg": (
+            sum(x for c in delta_bytes for x in c)
+            / max(1, sum(len(c) for c in delta_bytes))
+        ),
+        "digest_bytes_avg": (
+            sum(x for c in digest_bytes for x in c)
+            / max(1, sum(len(c) for c in digest_bytes))
+        ),
     }
 
 
@@ -437,6 +476,41 @@ def _run_relay_phase(ns) -> dict:
     return res
 
 
+def _run_fleet_phase(ns) -> dict:
+    """Phase 5 (``--fleet``): the observability roll-up lane. The same
+    relay-tier topology as phase 4, but every report carries a metric
+    digest; relays PRE-MERGE their agents' digests into one per
+    interval, and the master's FleetAggregator serves fleet quantiles
+    with ZERO per-agent scrapes (no agent even runs an HTTP endpoint
+    here — DLROVER_TPU_METRICS_PORT is off for the whole swarm)."""
+    from dlrover_tpu.agent.relay import AggregatorRelay
+
+    m = MasterProc(ns.agents, window=ns.window, persist_interval=0.0)
+    relays = []
+    try:
+        for r in range(max(1, ns.relays)):
+            relay = AggregatorRelay(
+                m.addr, relay_id=r, port=0, interval=0.25,
+            )
+            relay.start()
+            relays.append(relay)
+        n_relays = len(relays)
+        addrs = [f"localhost:{relay.port}" for relay in relays]
+        res = _drive(m, "batched", ns.agents, ns.threads, ns.duration,
+                     ns.steps, addrs=addrs, fleet=True)
+        for relay in relays:
+            relay.stop(flush=True)
+        relays = []
+    finally:
+        for relay in relays:  # only on error paths
+            relay.stop(flush=False, grace=0.0)
+        master_stats = m.stop()
+    fleet_doc = master_stats.get("fleet", {})
+    res["fleet"] = fleet_doc
+    res["fleet_relays"] = n_relays
+    return res
+
+
 # --------------------------------------------------------------------- main
 
 
@@ -465,6 +539,10 @@ def main() -> int:
     p.add_argument("--relays", type=int, default=0,
                    help="aggregator relay tier size for phase 4 "
                         "(0 = skip; --smoke forces 2)")
+    p.add_argument("--fleet", action="store_true",
+                   help="phase 5: digest roll-ups through the relay "
+                        "tier, fleet quantiles with zero agent "
+                        "scrapes (--smoke forces it on)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny run for the tier-1 suite")
     ns = p.parse_args()
@@ -478,6 +556,7 @@ def main() -> int:
         ns.threads = min(ns.threads, 8)
         ns.duration = min(ns.duration, 1.5)
         ns.relays = 2 if ns.relays == 0 else min(ns.relays, 2)
+        ns.fleet = True
     min_speedup = ns.min_speedup
     if min_speedup is None:
         min_speedup = 2.0 if ns.smoke else 10.0
@@ -528,6 +607,10 @@ def main() -> int:
     # tracking R instead of the agent count
     relay = _run_relay_phase(ns) if ns.relays > 0 else None
 
+    # phase 5 — fleet roll-ups (optional): digests ride the same
+    # stream; the master answers quantiles nobody scraped for
+    fleet = _run_fleet_phase(ns) if ns.fleet else None
+
     jstats = batched_stats.get("journal", {})
     events = jstats.get("events", 0)
     commits = max(1, jstats.get("commits", 0))
@@ -539,6 +622,8 @@ def main() -> int:
     errors = unary["errors"] + batched["errors"] + shed["errors"]
     if relay is not None:
         errors = errors + relay["errors"]
+    if fleet is not None:
+        errors = errors + fleet["errors"]
     ok = (
         not errors
         and dropped == 0
@@ -554,6 +639,25 @@ def main() -> int:
             relay["relay_dropped"] == 0
             and relay["forwarded_batches"] > 0
             and relay["p99_ms"] < 1000.0
+        )
+    if fleet is not None:
+        fdoc = fleet["fleet"]
+        step_series = fdoc.get("series", {}).get("step", {})
+        digest_ratio = (
+            fleet["digest_bytes_avg"] / fleet["delta_bytes_avg"]
+            if fleet["delta_bytes_avg"] else float("inf")
+        )
+        ok = ok and (
+            # quantiles materialized at the master with zero scrapes
+            step_series.get("count", 0) > 0
+            and step_series.get("p99_ms", 0.0) > 0.0
+            and fdoc.get("counters", {}).get("steps", 0) > 0
+            # relay pre-merge: the master saw ONE digest source per
+            # RELAY, not one per agent
+            and 0 < fdoc.get("sources", 0) <= fleet["fleet_relays"]
+            # the roll-up must stay cheap on the wire: at most 2x the
+            # bare steady-state delta it piggybacks on
+            and digest_ratio <= 2.0
         )
     result = {
         "metric": "control_plane_fanin_throughput",
@@ -607,6 +711,26 @@ def main() -> int:
             "relay_forwarded_batches": relay["forwarded_batches"],
             "relay_forwarded_reports": relay["forwarded_reports"],
             "relay_upstream_sheds": relay["upstream_sheds"],
+        })
+    if fleet is not None:
+        fdoc = fleet["fleet"]
+        step_series = fdoc.get("series", {}).get("step", {})
+        result.update({
+            "fleet_agent_scrapes": 0,  # structural: no agent endpoint
+            "fleet_sources": fdoc.get("sources", 0),
+            "fleet_digests": fdoc.get("digests", 0),
+            "fleet_steps_counter":
+                fdoc.get("counters", {}).get("steps", 0),
+            "fleet_step_count": step_series.get("count", 0),
+            "fleet_step_p50_ms": step_series.get("p50_ms", 0.0),
+            "fleet_step_p99_ms": step_series.get("p99_ms", 0.0),
+            "fleet_delta_bytes_avg": round(fleet["delta_bytes_avg"], 1),
+            "fleet_digest_bytes_avg":
+                round(fleet["digest_bytes_avg"], 1),
+            "fleet_digest_ratio": round(
+                fleet["digest_bytes_avg"]
+                / max(1.0, fleet["delta_bytes_avg"]), 3
+            ),
         })
     if errors:
         result["errors"] = errors[:5]

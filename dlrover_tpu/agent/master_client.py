@@ -38,7 +38,7 @@ from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName, TaskType
 from dlrover_tpu.common.grpc_utils import GenericRpcClient
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.telemetry import counter, record
+from dlrover_tpu.telemetry import counter, fleet, record, tracing
 
 #: hard reconnect deadline (seconds) — how long a worker rides out a
 #: master outage before giving up. Default covers a pod reschedule plus
@@ -248,6 +248,10 @@ class ConnectionSupervisor:
             master_addr=fallback,
             after_s=self._failover_after,
         )
+        counter(
+            "dlrover_relay_failovers_total",
+            "relay -> direct-master failovers taken by this process",
+        ).inc()
         self._client.reset(fallback)
 
     def _try_reconnect(self) -> bool:
@@ -346,7 +350,13 @@ class MasterClient:
         self._supervisor.remove_hook(name)
 
     def _call(self, method: str, message):
-        return self._client.call(method, message)
+        t0 = time.perf_counter()
+        try:
+            return self._client.call(method, message)
+        finally:
+            # fleet roll-up (ISSUE 17): RPC latency rides the digest
+            # instead of requiring a per-agent scrape
+            fleet.observe("rpc", time.perf_counter() - t0)
 
     def _fill(self, req: comm.BaseRequest):
         req.node_id = self._node_id
@@ -913,13 +923,18 @@ class LocalMasterClient:
         )
 
     # signature in lockstep with MasterClient.get_task: ShardingClient
-    # calls either through the same code path
+    # calls either through the same code path. The rpc.* span mirrors
+    # the remote servicer's handle() so a trace reads the same shape
+    # whether the master is local or remote — and since the "RPC" is a
+    # plain call, the caller's trace context flows through the shared
+    # contextvar with no metadata plumbing at all.
     def get_task(self, dataset_name: str,
                  incarnation: int = -1) -> comm.Task:
-        task = self._task_manager.get_dataset_task(
-            self._node_type, self._node_id, dataset_name,
-            incarnation=incarnation,
-        )
+        with tracing.span("rpc.get_task"):
+            task = self._task_manager.get_dataset_task(
+                self._node_type, self._node_id, dataset_name,
+                incarnation=incarnation,
+            )
         return comm.Task(
             task_id=task.task_id, task_type=task.task_type,
             shard=comm.Shard(
@@ -930,10 +945,11 @@ class LocalMasterClient:
 
     def get_tasks(self, dataset_name: str, max_tasks: int = 1,
                   incarnation: int = -1) -> List[comm.Task]:
-        tasks = self._task_manager.get_dataset_tasks(
-            self._node_type, self._node_id, dataset_name,
-            max_tasks=max_tasks, incarnation=incarnation,
-        )
+        with tracing.span("rpc.get_tasks"):
+            tasks = self._task_manager.get_dataset_tasks(
+                self._node_type, self._node_id, dataset_name,
+                max_tasks=max_tasks, incarnation=incarnation,
+            )
         return [
             comm.Task(
                 task_id=t.task_id, task_type=t.task_type,
@@ -947,9 +963,10 @@ class LocalMasterClient:
         ]
 
     def report_task_result(self, dataset_name, task_id, err_message=""):
-        accepted = self._task_manager.report_dataset_task(
-            dataset_name, task_id, not err_message
-        )
+        with tracing.span("rpc.report_task_result"):
+            accepted = self._task_manager.report_dataset_task(
+                dataset_name, task_id, not err_message
+            )
         return comm.Response(success=bool(accepted))
 
     def get_dataset_epoch(self, dataset_name: str) -> int:

@@ -48,7 +48,10 @@ from dlrover_tpu.common import comm
 from dlrover_tpu.common.grpc_utils import GenericRpcServer
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.ingest import ReporterLedger
-from dlrover_tpu.telemetry import record
+from dlrover_tpu.telemetry import (
+    counter, fleet, gauge, histogram, record, tracing,
+)
+from dlrover_tpu.telemetry.http import start_metrics_server
 
 #: agents per relay — launchers and the swarm bench size the tier as
 #: ceil(agents / fanout)
@@ -78,7 +81,7 @@ class _AgentSlot:
     __slots__ = (
         "tracker", "timestamp", "step", "step_ts", "pid",
         "goodput_fields", "resource", "host", "final", "fresh",
-        "pending_action", "upstream_seq",
+        "pending_action", "upstream_seq", "trace_ctx",
     )
 
     def __init__(self, tracker):
@@ -96,6 +99,10 @@ class _AgentSlot:
         #: last upstream seq the MASTER acked for this agent — the
         #: bench's delivery-chain proof reads it
         self.upstream_seq = -1
+        #: trace context carried by the agent's last report — the
+        #: forward span adopts one of these so the worker -> relay ->
+        #: master chain stays causal (ISSUE 17)
+        self.trace_ctx: Optional[Tuple[str, str]] = None
 
 
 class AggregatorRelay:
@@ -126,23 +133,50 @@ class AggregatorRelay:
         #: None = undecided, False = master predates the batch RPC —
         #: forward per-agent report_node_status instead
         self._batch_supported: Optional[bool] = None
+        # pre-merged fleet digest (ISSUE 17): agents' per-report metric
+        # digests fold into ONE wire dict here, so the master sees one
+        # summary per relay per interval regardless of fanout. Same
+        # loss-free contract as the agent's DigestCollector: compose
+        # drains pending -> in-flight, a failed forward keeps in-flight
+        # for the next compose, only an accepted forward clears it.
+        # Both dicts are guarded by ``self._lock``.
+        self._pending_digest: Dict = {}
+        self._inflight_digest: Dict = {}
         self._stopped = threading.Event()
         self._kick = threading.Event()
         self._flush_on_stop = True
         self._thread: Optional[threading.Thread] = None
         self._server = GenericRpcServer(self.handle, port=port)
         self.port = self._server.port
+        self._metrics_server = None
         # observability (read by the bench after stop; single-writer
         # forward thread, so plain ints suffice)
         self.forwarded_batches = 0
         self.forwarded_reports = 0
         self.upstream_sheds = 0
         self.downstream_reports = 0
+        # relays were observability blind spots (ISSUE 17): export the
+        # tier's own vitals through the standard registry
+        self._agents_gauge = gauge(
+            "dlrover_relay_agents",
+            "agents currently terminated by this relay",
+        )
+        self._forward_latency = histogram(
+            "dlrover_relay_forward_latency_seconds",
+            "relay upstream forward latency (compose + RPC + commit)",
+        )
+        self._forward_failures = counter(
+            "dlrover_relay_forward_failures_total",
+            "relay upstream forwards that failed (retried next interval)",
+        )
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self):
         self._server.start()
+        # same DLROVER_TPU_METRICS_PORT contract as master/agents
+        # ("off" disables; bind failure never takes the relay down)
+        self._metrics_server = start_metrics_server()
         self._thread = threading.Thread(
             target=self._run, name=f"relay-forward-{self.relay_id}",
             daemon=True,
@@ -162,6 +196,9 @@ class AggregatorRelay:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
         self._server.stop(grace)
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
         record(
             "relay.stopped", relay_id=self.relay_id, flushed=flush,
             forwarded=self.forwarded_reports,
@@ -229,6 +266,11 @@ class AggregatorRelay:
             if req.final:
                 slot.final = True
             slot.fresh = True
+            # grpc_utils installed the agent's trace context for this
+            # handler; park it so the next forward chains under it
+            slot.trace_ctx = tracing.current_context()
+            if req.has_metrics and req.metrics:
+                fleet.merge_digest(self._pending_digest, req.metrics)
             action = slot.pending_action
             slot.pending_action = ""
             self.downstream_reports += 1
@@ -265,6 +307,7 @@ class AggregatorRelay:
         """Snapshot fresh slots under the lock, compose outside it
         (compose runs change detectors — keep it off the ack path)."""
         with self._lock:
+            self._agents_gauge.set(len(self._slots))
             fresh = [
                 (key, slot) for key, slot in self._slots.items()
                 if slot.fresh
@@ -279,6 +322,16 @@ class AggregatorRelay:
                 )
                 for key, slot in fresh
             ]
+            # drain pending -> in-flight; a retried/failed forward's
+            # digest is still in-flight and re-merges here losslessly
+            if self._pending_digest:
+                fleet.merge_digest(
+                    self._inflight_digest, self._pending_digest
+                )
+                self._pending_digest = {}
+            digest: Dict = {}
+            if self._inflight_digest:
+                fleet.merge_digest(digest, self._inflight_digest)
         reports, slots = [], []
         for (key, slot, ts, step, step_ts, pid, goodput, resource,
              host, final) in snapshots:
@@ -292,35 +345,61 @@ class AggregatorRelay:
             report.node_type, report.node_id = key
             reports.append(report)
             slots.append((key, slot))
-        return reports, slots
+        return reports, slots, digest
 
     def _forward_once(self):
-        reports, slots = self._compose_batch()
+        reports, slots, digest = self._compose_batch()
         if not reports:
             return
+        # adopt the freshest carried agent context: the relay's forward
+        # span becomes the child of a worker report span and the parent
+        # of the master's rpc.report_relay_batch span — the causal
+        # chain ISSUE 17's chaos drill asserts
+        ctx = None
+        for _key, slot in slots:
+            if slot.trace_ctx is not None:
+                ctx = slot.trace_ctx
+        t0 = time.perf_counter()
         try:
-            if self._batch_supported is False:
-                acks = self._forward_individually(reports)
-            else:
-                acks = self._forward_batch(reports)
-        except Exception as e:
-            record(
-                "relay.forward_failed", relay_id=self.relay_id,
-                reports=len(reports), error=str(e)[:200],
-            )
-            logger.warning(
-                "relay %d upstream forward failed (%d reports): %s",
-                self.relay_id, len(reports), e,
-            )
-            with self._lock:
-                for _key, slot in slots:
-                    slot.fresh = True  # recompose next interval
-            return
-        self._commit_acks(slots, reports, acks)
+            with tracing.trace_context(*(ctx or (None, None))), \
+                    tracing.span("relay.forward", {
+                        "relay": self.relay_id, "reports": len(reports),
+                    }):
+                try:
+                    if self._batch_supported is False:
+                        acks = self._forward_individually(reports)
+                    else:
+                        acks = self._forward_batch(reports, digest)
+                except Exception as e:
+                    self._forward_failures.inc()
+                    record(
+                        "relay.forward_failed", relay_id=self.relay_id,
+                        reports=len(reports), error=str(e)[:200],
+                    )
+                    logger.warning(
+                        "relay %d upstream forward failed (%d reports): %s",
+                        self.relay_id, len(reports), e,
+                    )
+                    with self._lock:
+                        for _key, slot in slots:
+                            slot.fresh = True  # recompose next interval
+                    return
+                self._commit_acks(slots, reports, acks)
+                if digest:
+                    # the master applied the in-flight digest (or an
+                    # old master that can't consume it acked the
+                    # fallback — either way retrying it would
+                    # double-count)
+                    with self._lock:
+                        self._inflight_digest = {}
+        finally:
+            self._forward_latency.observe(time.perf_counter() - t0)
 
-    def _forward_batch(self, reports) -> List[comm.NodeStatusAck]:
+    def _forward_batch(self, reports,
+                       digest: Optional[Dict] = None
+                       ) -> List[comm.NodeStatusAck]:
         batch = comm.RelayBatchReport(
-            reports=reports, relay_incarnation=0,
+            reports=reports, relay_incarnation=0, digest=digest or {},
         )
         attempts = 0
         while True:

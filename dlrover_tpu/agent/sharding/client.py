@@ -26,7 +26,7 @@ import numpy as np
 from dlrover_tpu.agent.master_client import get_master_client
 from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.telemetry import counter, gauge, record
+from dlrover_tpu.telemetry import counter, fleet, gauge, record
 
 #: default ceiling on one fetch_shard WAIT poll. The master's task
 #: watchdog requeues a dead peer's shard within its task timeout
@@ -151,6 +151,15 @@ class ShardingClient:
     # ------------------------------------------------------------ dispatch
 
     def _request_tasks(self, n: int):
+        # fleet roll-up (ISSUE 17): shard-dispatch round-trip latency
+        # rides the digest; a WAIT answer still costs a round trip
+        t0 = time.perf_counter()
+        try:
+            return self._request_tasks_once(n)
+        finally:
+            fleet.observe("dispatch", time.perf_counter() - t0)
+
+    def _request_tasks_once(self, n: int):
         """One master round-trip for up to ``n`` shards.
 
         Returns a list of real tasks (empty = dataset exhausted), or

@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import fleet
 from dlrover_tpu.telemetry.journal import record
 
 #: fractional interval jitter (0.2 = ±20%)
@@ -255,6 +256,15 @@ class StatusReporter:
             goodput_fields=goodput_mod.report_fields(),
             resource=self._resource_fn() if self._resource_fn else None,
         )
+        # fleet roll-up (ISSUE 17): the metric digest rides the same
+        # delta contract — compose drains into in-flight, a shed retry
+        # reuses this payload, commit() below clears in-flight only
+        # once the master acked
+        if fleet.digests_enabled():
+            digest = fleet.default_collector().compose()
+            if digest:
+                report.has_metrics = True
+                report.metrics = digest
         shed_streak = 0
         while not self._stopped.is_set():
             self.sent += 1
@@ -270,6 +280,8 @@ class StatusReporter:
             if ack.accepted:
                 self.acked += 1
                 self._tracker.commit(report)
+                if report.has_metrics:
+                    fleet.default_collector().commit()
                 if ack.resync:
                     self.resyncs += 1
                     record("report.resync", seq=report.seq)

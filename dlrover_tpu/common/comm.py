@@ -574,6 +574,12 @@ class NodeStatusReport(BaseRequest):
     has_resource: bool = False
     cpu_percent: float = 0.0
     memory_mb: int = 0
+    #: fleet metric digest (ISSUE 17): counter deltas + mergeable
+    #: histogram sketches since the last ACKED report
+    #: (telemetry/fleet.py wire format). Sparse: omitted entirely when
+    #: the process produced no samples this interval.
+    has_metrics: bool = False
+    metrics: Dict = field(default_factory=dict)
 
 
 @dataclass
@@ -603,6 +609,11 @@ class RelayBatchReport(BaseRequest):
     #: relay restart count — diagnostics only; per-agent delta state
     #: rides each sub-report's own (incarnation, seq)
     relay_incarnation: int = -1
+    #: pre-merged metric digest across this relay's agents for the
+    #: interval (ISSUE 17): the master folds ONE mergeable summary per
+    #: relay instead of K per-agent digests. Sub-reports carry no
+    #: per-agent digest when this is set.
+    digest: Dict = field(default_factory=dict)
 
 
 @dataclass
@@ -796,5 +807,10 @@ class ServeStats(BaseMessage):
     workers: int = 0
     p50_ms: float = 0.0
     p99_ms: float = 0.0
+    #: attributed split of the same latency window (ISSUE 17): time in
+    #: queue awaiting the winning lease vs time on the worker — the
+    #: autoscaler/SLO evaluator's "would one more replica help?" signal
+    queue_wait_p99_ms: float = 0.0
+    model_time_p99_ms: float = 0.0
     sealed: bool = False
     drained: bool = False

@@ -23,6 +23,7 @@ from grpc import aio as grpc_aio
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import GRPC
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import tracing
 
 SERVICE_NAME = "dlrover_tpu.Master"
 METHOD_NAME = "call"
@@ -49,6 +50,22 @@ def _unpack_call(payload: bytes):
             f"unsupported wire version {doc.get('v')!r}"
         )
     return doc["m"], comm._decode(doc.get("d"))
+
+def _trace_from_metadata(context):
+    """Extract the caller's trace context from gRPC invocation metadata.
+
+    Returns ``(trace_id, span_id)`` or ``(None, None)``; never raises —
+    a garbled header from an old or foreign client must not fail the
+    RPC it decorates."""
+    try:
+        metadata = context.invocation_metadata() or ()
+    except Exception:
+        return None, None
+    for item in metadata:
+        if item[0] == tracing.TRACE_METADATA_KEY:
+            return tracing.parse_traceparent(item[1])
+    return None, None
+
 
 _GRPC_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
@@ -142,7 +159,9 @@ class GenericRpcServer:
             logger.warning("rejected malformed RPC: %s", e)
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         try:
-            result = self._handler(method, message)
+            tid, sid = _trace_from_metadata(context)
+            with tracing.trace_context(tid, sid):
+                result = self._handler(method, message)
             return comm.serialize(result)
         except Exception as e:
             logger.exception("RPC dispatch failed: %s", e)
@@ -250,12 +269,20 @@ class AsyncRpcServer:
             logger.warning("rejected malformed RPC: %s", e)
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         try:
+            tid, sid = _trace_from_metadata(context)
             hot = self._hot.get(method)
             if hot is not None:
-                result = await hot(message)
+                with tracing.trace_context(tid, sid):
+                    result = await hot(message)
             else:
+                # contextvars do not cross run_in_executor; re-install
+                # the caller's trace context on the pool thread so cold
+                # handlers' spans still parent to the remote caller
+                def _run_cold():
+                    with tracing.trace_context(tid, sid):
+                        return self._handler(method, message)
                 result = await asyncio.get_running_loop().run_in_executor(
-                    self._pool, self._handler, method, message
+                    self._pool, _run_cold
                 )
             return comm.serialize(result)
         except Exception as e:
@@ -324,7 +351,14 @@ class GenericRpcClient:
         with self._lock:
             fn = self._callable
         payload = _pack_call(method, message)
-        response = fn(payload, timeout=timeout or self.timeout)
+        tp = tracing.traceparent()
+        response = fn(
+            payload,
+            timeout=timeout or self.timeout,
+            metadata=(
+                ((tracing.TRACE_METADATA_KEY, tp),) if tp else None
+            ),
+        )
         return comm.deserialize(response)
 
     def reset(self, addr: str):

@@ -43,7 +43,7 @@ from typing import Optional
 
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.telemetry import counter, gauge, record
+from dlrover_tpu.telemetry import counter, gauge, record, tracing
 
 #: KV-store key the master broadcasts rollback orders under; every
 #: worker polls it so ranks that did not detect the anomaly still
@@ -266,23 +266,32 @@ class TrainingSentinel:
             try:
                 order = json.loads(raw.decode())
                 self._adopt_order(
-                    int(order["id"]), int(order["step"])
+                    int(order["id"]), int(order["step"]),
+                    trace=str(order.get("trace", "")),
                 )
             except (ValueError, KeyError) as e:
                 logger.warning("bad rollback order %r: %s", raw, e)
         return self._pending_rollback
 
-    def _adopt_order(self, rollback_id: int, step: int) -> None:
+    def _adopt_order(self, rollback_id: int, step: int,
+                     trace: str = "") -> None:
         if rollback_id <= self._seen_rollback_id:
             return
         self._seen_rollback_id = rollback_id
         self._pending_rollback = {"id": rollback_id, "step": step}
         # opens the rollback badput phase on this rank's ledger even
-        # when the anomaly was detected elsewhere
-        record(
-            "rollback.ordered", rollback_id=rollback_id, step=step,
-            node_rank=self._node_rank,
-        )
+        # when the anomaly was detected elsewhere. The carried trace
+        # (stamped at cut time in the servicer) chains this rank's
+        # adoption under the initiating anomaly RPC (ISSUE 17).
+        with tracing.trace_context(
+            *tracing.parse_traceparent(trace)
+        ), tracing.span("rollback.adopt", {
+            "rollback": rollback_id, "rank": self._node_rank,
+        }):
+            record(
+                "rollback.ordered", rollback_id=rollback_id, step=step,
+                node_rank=self._node_rank,
+            )
 
     def pending_rollback(self) -> Optional[dict]:
         return self._pending_rollback

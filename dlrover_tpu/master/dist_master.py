@@ -45,7 +45,11 @@ from dlrover_tpu.master.stats import (
 )
 from dlrover_tpu.telemetry import goodput as goodput_mod
 from dlrover_tpu.telemetry import record
-from dlrover_tpu.telemetry.http import start_metrics_server
+from dlrover_tpu.telemetry.fleet import FleetAggregator, SLOEvaluator
+from dlrover_tpu.telemetry.http import (
+    set_fleet_provider,
+    start_metrics_server,
+)
 
 #: how long the servicer stays up after the last data task completes:
 #: must cover a full WAIT-poll cycle of the sharding client (0.5s)
@@ -229,6 +233,25 @@ class DistributedJobMaster:
                     1,
                 ),
             )
+        # fleet observability plane (ISSUE 17): digest roll-ups land in
+        # the time-series store; SLO objectives (DLROVER_TPU_SLO) read
+        # the store's built-in quantiles plus these registered signals.
+        # Attribution providers answer "what blew the objective":
+        # step/goodput blame the goodput ledger's dominant badput
+        # cause, serve latency splits queue-wait vs model-time.
+        self.fleet_aggregator = FleetAggregator(slo=SLOEvaluator())
+        slo = self.fleet_aggregator.slo
+        slo.register_signal(
+            "goodput_percent", self._slo_goodput_percent,
+            attribution=self._slo_goodput_cause,
+        )
+        slo.register_signal(
+            "serve_p99_ms", self._slo_serve_p99,
+            attribution=self._slo_serve_cause,
+        )
+        slo.register_signal(
+            "step_p99_ms", attribution=self._slo_goodput_cause,
+        )
         self._server, self.servicer = create_master_service(
             port,
             task_manager=self.task_manager,
@@ -243,6 +266,7 @@ class DistributedJobMaster:
             goodput_aggregator=self.goodput_aggregator,
             request_router=self.request_router,
             transition_coordinator=self.transition_coordinator,
+            fleet_aggregator=self.fleet_aggregator,
         )
         self.port = self._server.port
         self._exit_code = 0
@@ -401,6 +425,8 @@ class DistributedJobMaster:
         # /goodput on this master serves the job-level aggregation
         # (and refreshes the goodput gauges on every read)
         goodput_mod.set_job_provider(self._goodput_summary)
+        # /fleet serves the roll-up plane's snapshot (ISSUE 17)
+        set_fleet_provider(self.fleet_aggregator.snapshot)
         # Prometheus /metrics + /journal (telemetry/http.py);
         # DLROVER_TPU_METRICS_PORT pins the port, "off" disables
         self._metrics_server = start_metrics_server()
@@ -459,6 +485,14 @@ class DistributedJobMaster:
                     # abort watchdog: an order still open past the
                     # timeout falls back to restart-the-world
                     self.transition_coordinator.check_abort()
+                if self.fleet_aggregator.slo is not None:
+                    # digest ingest ticks the evaluator on its own;
+                    # this beat covers jobs with no digest traffic
+                    # (e.g. serving-only) so registered signals like
+                    # serve_p99_ms still fire slo.violated
+                    self.fleet_aggregator.slo.evaluate(
+                        self.fleet_aggregator
+                    )
                 if self.job_manager.is_job_failed():
                     # critical-node fast-fail (dist_job_manager
                     # mark_job_failed): don't limp at reduced capacity
@@ -508,6 +542,43 @@ class DistributedJobMaster:
         goodput_mod.export_metrics(summary)
         return summary
 
+    # ------------------------------------------------------- SLO signals
+
+    def _slo_goodput_percent(self):
+        job = self.goodput_aggregator.summary().get("job") or {}
+        if not job.get("procs"):
+            return None  # no ledgers yet: nothing to hold an SLO on
+        return float(job.get("goodput_percent") or 0.0)
+
+    def _slo_goodput_cause(self):
+        """The goodput ledger's dominant badput cause — the attributed
+        'why' on slo.violated for step/goodput objectives."""
+        job = self.goodput_aggregator.summary().get("job") or {}
+        badput = job.get("badput_s") or {}
+        if not any(badput.values()):
+            return {}
+        cause = max(badput, key=badput.get)
+        return {
+            "cause": cause,
+            "badput_s": round(float(badput.get(cause, 0.0)), 3),
+        }
+
+    def _slo_serve_p99(self):
+        stats = self.request_router.stats()
+        if not stats.get("completed"):
+            return None
+        return float(stats.get("p99_ms") or 0.0)
+
+    def _slo_serve_cause(self):
+        stats = self.request_router.stats()
+        qw = float(stats.get("queue_wait_p99_ms") or 0.0)
+        mt = float(stats.get("model_time_p99_ms") or 0.0)
+        return {
+            "cause": "model_time" if mt > qw else "queue_wait",
+            "queue_wait_p99_ms": round(qw, 3),
+            "model_time_p99_ms": round(mt, 3),
+        }
+
     def stop(self):
         if self.serve_autoscaler is not None:
             self.serve_autoscaler.stop()
@@ -527,6 +598,7 @@ class DistributedJobMaster:
             except Exception as e:
                 logger.warning("goodput summary failed: %s", e)
         goodput_mod.set_job_provider(None)
+        set_fleet_provider(None)
         self._server.stop(grace=1.0)
         self.servicer.close()  # ingest shard executors
         if self.state_journal is not None:

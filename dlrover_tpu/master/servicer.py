@@ -57,6 +57,7 @@ class MasterServicer:
         goodput_aggregator=None,
         request_router=None,
         transition_coordinator=None,
+        fleet_aggregator=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -74,6 +75,10 @@ class MasterServicer:
         # reshard-in-place (reshard/coordinator.py); None falls back
         # to restart-the-world for every scale event
         self._transition_coordinator = transition_coordinator
+        # fleet observability plane (ISSUE 17): digest roll-ups +
+        # time-series store + SLO evaluation; None on masters that
+        # predate it (digests are then acked and dropped)
+        self._fleet = fleet_aggregator
         # injectable so the master can wire a journal-backed store that
         # survives a master restart (master/state_journal.py)
         self._kv_store = kv_store or KVStoreService()
@@ -564,6 +569,9 @@ class MasterServicer:
         self._rollback_id += 1
         order = {
             "id": self._rollback_id, "step": int(req.last_good_step),
+            # chains every rank's adoption under the initiating
+            # report_anomaly RPC span (ISSUE 17)
+            "trace": tracing.traceparent() or "",
         }
         self._active_rollback = order
         self._rollback_ranks.add(rank)
@@ -767,20 +775,30 @@ class MasterServicer:
                 req.node_type, req.node_id, req.cpu_percent,
                 req.memory_mb, [],
             )
+        if self._fleet is not None:
+            self._fleet.observe_report(req)
+            if req.has_metrics and req.metrics:
+                self._fleet.observe_digest(
+                    req.metrics,
+                    source=f"{req.node_type}-{req.node_id}",
+                )
         return action
 
     # -------------------------------------------- event-loop ingest (hot)
 
     def _ingest_apply(self, req: comm.NodeStatusReport,
-                      shard) -> comm.NodeStatusAck:
+                      shard, ctx=None) -> comm.NodeStatusAck:
         """Apply one admitted report on its shard executor, with the
         same metrics/tracing the threaded dispatch would have added
-        (the hot lane bypasses handle())."""
+        (the hot lane bypasses handle()). ``ctx`` is the caller's trace
+        context, re-installed here because contextvars do not cross the
+        run_in_executor hop."""
         requests_c, latency_h = self._bound_metrics("report_node_status")
         requests_c.inc()
         t0 = time.perf_counter()
         try:
-            with tracing.span("rpc.report_node_status"):
+            with tracing.trace_context(*(ctx or (None, None))), \
+                    tracing.span("rpc.report_node_status"):
                 return self._ingest.apply(
                     req, self._apply_status_sections, shard=shard
                 )
@@ -806,7 +824,8 @@ class MasterServicer:
             return self._ingest.shed_ack(shard)
         try:
             return await asyncio.get_running_loop().run_in_executor(
-                shard.executor, self._ingest_apply, req, shard
+                shard.executor, self._ingest_apply, req, shard,
+                tracing.current_context(),
             )
         finally:
             shard.release()
@@ -853,10 +872,19 @@ class MasterServicer:
                     acks[i] = self._ingest.apply(
                         r, self._apply_status_sections, shard=shard
                     )
+            self._consume_relay_digest(req)
             return comm.RelayBatchAck(accepted=True, acks=acks)
         finally:
             for s in admitted:
                 s.release()
+
+    def _consume_relay_digest(self, req: comm.RelayBatchReport):
+        """Fold a relay's pre-merged digest — ONE summary per relay per
+        interval, however many agents it fronts."""
+        if self._fleet is not None and req.digest:
+            self._fleet.observe_digest(
+                req.digest, source=f"relay-{req.node_id}",
+            )
 
     async def ingest_relay_batch_async(
         self, req: comm.RelayBatchReport
@@ -870,18 +898,25 @@ class MasterServicer:
             )
         loop = asyncio.get_running_loop()
 
-        def apply_group(shard, items):
+        def apply_group(shard, items, ctx):
             return [
-                (i, self._ingest_apply(r, shard)) for i, r in items
+                (i, self._ingest_apply(r, shard, ctx)) for i, r in items
             ]
 
         try:
-            results = await asyncio.gather(*[
-                loop.run_in_executor(
-                    shard.executor, apply_group, shard, items
-                )
-                for shard, items in groups.items()
-            ])
+            # the hot lane bypasses handle(): give the batch its own
+            # span so the relay's forward span parents it and the
+            # worker -> relay -> master chain closes here
+            with tracing.span(
+                "rpc.report_relay_batch", {"reports": len(req.reports)}
+            ):
+                ctx = tracing.current_context()
+                results = await asyncio.gather(*[
+                    loop.run_in_executor(
+                        shard.executor, apply_group, shard, items, ctx
+                    )
+                    for shard, items in groups.items()
+                ])
         finally:
             for s in admitted:
                 s.release()
@@ -889,6 +924,7 @@ class MasterServicer:
         for group in results:
             for i, ack in group:
                 acks[i] = ack
+        self._consume_relay_digest(req)
         return comm.RelayBatchAck(accepted=True, acks=acks)
 
     def rpc_report_model_info(self, req: comm.ModelInfo) -> comm.Response:
@@ -1016,6 +1052,7 @@ def create_master_service(
     goodput_aggregator=None,
     request_router=None,
     transition_coordinator=None,
+    fleet_aggregator=None,
 ):
     """Build the gRPC server around a MasterServicer
     (parity: servicer.py:478)."""
@@ -1032,6 +1069,7 @@ def create_master_service(
         goodput_aggregator=goodput_aggregator,
         request_router=request_router,
         transition_coordinator=transition_coordinator,
+        fleet_aggregator=fleet_aggregator,
     )
     use_async = os.environ.get(ENV_ASYNC_INGEST, "1") != "0"
     if use_async:

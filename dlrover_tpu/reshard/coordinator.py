@@ -38,7 +38,7 @@ from dlrover_tpu.reshard.order import (
     TRANSITION_ORDER_KEY,
     TransitionOrder,
 )
-from dlrover_tpu.telemetry import gauge, record
+from dlrover_tpu.telemetry import gauge, record, tracing
 
 
 def reshard_enabled() -> bool:
@@ -198,7 +198,14 @@ class TransitionCoordinator:
         return order
 
     def _open_locked(self, order: TransitionOrder) -> None:
-        self._broadcast(order)
+        # the cut span roots the transition's causal chain: its
+        # traceparent rides the order over KV, and every survivor's
+        # adoption span parents back here (ISSUE 17)
+        with tracing.span("reshard.order_cut", {
+            "order": order.id, "kind": order.kind,
+        }):
+            order.trace = tracing.traceparent() or ""
+            self._broadcast(order)
         record(
             # `kind` is the event name's slot in record(); the order
             # kind travels as order_kind

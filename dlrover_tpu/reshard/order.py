@@ -49,6 +49,10 @@ class TransitionOrder:
     joined: List[int] = field(default_factory=list)
     aborted_id: int = 0        # for KIND_ABORT: the order it cancels
     reason: str = ""
+    #: traceparent ("trace_id-span_id") stamped at cut time so every
+    #: rank's adoption span chains under the master's order_cut span
+    #: (ISSUE 17); empty when tracing is off. Old decoders drop it.
+    trace: str = ""
 
     def new_index(self, old_rank: int) -> Optional[int]:
         """The rank's position in the new world, or None when it is

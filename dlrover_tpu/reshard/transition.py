@@ -36,7 +36,7 @@ from dlrover_tpu.reshard.order import (
     TRANSITION_ORDER_KEY,
     TransitionOrder,
 )
-from dlrover_tpu.telemetry import counter, record
+from dlrover_tpu.telemetry import counter, record, tracing
 
 
 def _moves_counter():
@@ -150,12 +150,20 @@ class MeshTransition:
             return
         self._pending = order
         self._adopted_at = time.time()
-        record(
-            "reshard.adopted", order_id=order.id,
-            order_kind=order.kind,
-            new_index=new_index, world_size=order.world_size,
-            node_rank=self._node_rank,
-        )
+        # adopt under the order's carried trace context: cut ->
+        # broadcast -> per-rank adoption reads as ONE chain in
+        # `dump --trace` even though it crossed the KV store
+        with tracing.trace_context(
+            *tracing.parse_traceparent(order.trace)
+        ), tracing.span("reshard.adopt", {
+            "order": order.id, "rank": self._node_rank,
+        }):
+            record(
+                "reshard.adopted", order_id=order.id,
+                order_kind=order.kind,
+                new_index=new_index, world_size=order.world_size,
+                node_rank=self._node_rank,
+            )
 
     # ------------------------------------------------------------ reporting
 

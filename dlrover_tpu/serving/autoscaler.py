@@ -125,6 +125,12 @@ class ServingAutoScaler:
         )
         queue_depth = int(stats.get("queue_depth", 0))
         p99_ms = float(stats.get("p99_ms", 0.0))
+        # attributed latency (ISSUE 17 / ROADMAP 3b): the router splits
+        # the same window into queue wait (submit -> winning lease) and
+        # model time (lease -> complete). Stats from an older router
+        # lack the keys and read 0.0, keeping the legacy behavior.
+        queue_wait_ms = float(stats.get("queue_wait_p99_ms", 0.0))
+        model_ms = float(stats.get("model_time_p99_ms", 0.0))
         target = current
         reason = ""
         if stats.get("sealed") and not queue_depth:
@@ -132,6 +138,19 @@ class ServingAutoScaler:
         if queue_depth > self._queue_high and current < self._max:
             target, reason = current + 1, "queue_depth"
         elif p99_ms > self._p99_high_ms and current < self._max:
+            if model_ms > self._p99_high_ms and model_ms > queue_wait_ms:
+                # the replica ITSELF blew the budget: one more replica
+                # cannot shorten a model-time-dominated p99 — hold, and
+                # journal the attribution so the operator sees why the
+                # pool did not grow
+                record(
+                    "serve.autoscale_held", cause="model_time",
+                    p99_ms=round(p99_ms, 3),
+                    model_time_p99_ms=round(model_ms, 3),
+                    queue_wait_p99_ms=round(queue_wait_ms, 3),
+                    replicas=current,
+                )
+                return None
             target, reason = current + 1, "p99_latency"
         elif (queue_depth == 0 and p99_ms < self._p99_high_ms / 4
               and current > self._min and not stats.get("in_flight")):
@@ -142,6 +161,8 @@ class ServingAutoScaler:
             "serve.autoscale", reason=reason, replicas=current,
             target=target, queue_depth=queue_depth,
             p99_ms=round(p99_ms, 3),
+            queue_wait_p99_ms=round(queue_wait_ms, 3),
+            model_time_p99_ms=round(model_ms, 3),
         )
         counter(
             "dlrover_serve_autoscale_total",

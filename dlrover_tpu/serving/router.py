@@ -110,6 +110,12 @@ class RequestRouter:
         #: (node_type, node_id) -> newest incarnation seen leasing
         self._incarnations: Dict[Tuple[str, int], int] = {}
         self._latencies: deque = deque(maxlen=_LATENCY_WINDOW)
+        # attributed split of the same window (ISSUE 17): queue wait
+        # (submit -> winning lease) vs model time (lease -> complete).
+        # The SLO evaluator reads it to say WHICH side blew the p99 —
+        # capacity (scale out) or the model itself (scaling won't help)
+        self._queue_waits: deque = deque(maxlen=_LATENCY_WINDOW)
+        self._model_times: deque = deque(maxlen=_LATENCY_WINDOW)
         self._submitted = 0
         self._rejected = 0
         self._duplicates = 0
@@ -266,10 +272,20 @@ class RequestRouter:
                     "Duplicate serve completions rejected",
                 ).inc()
                 return False
-            latency = max(0.0, time.time() - pending.submit_ts)
+            now = time.time()
+            latency = max(0.0, now - pending.submit_ts)
             del self._pending[req_id]
             self._done[req_id] = _Done(payload, worker, latency)
             self._latencies.append(latency)
+            # the WINNING lease's timestamps: a redelivered request
+            # attributes its wait up to the lease that answered
+            if pending.lease_ts:
+                self._queue_waits.append(
+                    max(0.0, pending.lease_ts - pending.submit_ts)
+                )
+                self._model_times.append(
+                    max(0.0, now - pending.lease_ts)
+                )
         counter(
             "dlrover_serve_responses_total",
             "Serve responses stored (exactly-once completions)",
@@ -383,6 +399,8 @@ class RequestRouter:
     def stats(self) -> Dict:
         with self._lock:
             lat = list(self._latencies)
+            waits = list(self._queue_waits)
+            model = list(self._model_times)
             leased = sum(
                 1 for p in self._pending.values() if p.worker is not None
             )
@@ -399,6 +417,12 @@ class RequestRouter:
             }
         out["p50_ms"] = round(self._percentile(lat, 0.50) * 1000.0, 3)
         out["p99_ms"] = round(self._percentile(lat, 0.99) * 1000.0, 3)
+        out["queue_wait_p99_ms"] = round(
+            self._percentile(waits, 0.99) * 1000.0, 3
+        )
+        out["model_time_p99_ms"] = round(
+            self._percentile(model, 0.99) * 1000.0, 3
+        )
         out["drained"] = self.finished()
         return out
 

@@ -20,7 +20,14 @@ generic events. ``--json`` emits the report as JSON.
 span tracing (``DLROVER_TPU_TRACE_DIR`` — one ``spans-<host>-<pid>.
 jsonl`` per process) and the output is ONE merged Chrome trace-event
 JSON covering every process, loadable in Perfetto / chrome://tracing
-(``-o merged.json`` writes a file; default stdout).
+(``-o merged.json`` writes a file; default stdout). A multi-hour
+trace is unloadable whole, so ``--trace`` composes filters applied
+BEFORE the merge: ``--since <ts>`` (unix seconds or
+``YYYY-MM-DD[ HH:MM:SS]``) keeps spans starting at/after the stamp,
+``--step N..M`` (or a single ``N``; open ends allowed, ``100..``)
+keeps spans stamped with a global step in the range, ``--proc <id>``
+keeps one process (matches the JAX process index or the OS pid).
+Cross-process flow arrows are recomputed over the surviving spans.
 
 Example::
 
@@ -86,16 +93,80 @@ def render(events: List[Dict], kind: Optional[str] = None,
     return "\n".join(lines)
 
 
-def dump_trace(path: str, out: str = "") -> int:
+def _parse_since(text: str) -> float:
+    """``--since`` value -> unix seconds. Accepts a raw float or a
+    local wall-clock stamp (the format the timeline mode prints)."""
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d"):
+        try:
+            return time.mktime(time.strptime(text, fmt))
+        except ValueError:
+            continue
+    raise ValueError(
+        f"--since {text!r}: want unix seconds or YYYY-MM-DD[ HH:MM:SS]"
+    )
+
+
+def _parse_step_range(text: str):
+    """``"N..M"`` -> (N, M); ``"N"`` -> (N, N); open ends (``"N.."``,
+    ``"..M"``) -> None on that side."""
+    if ".." in text:
+        lo, _, hi = text.partition("..")
+        return (int(lo) if lo else None, int(hi) if hi else None)
+    v = int(text)
+    return (v, v)
+
+
+def filter_spans(records: List[Dict], since: Optional[float] = None,
+                 steps=None, proc: Optional[int] = None) -> List[Dict]:
+    """Apply the --trace filters to raw span records (seconds-valued
+    ``ts``). ``--step`` drops spans with no step stamp — a range query
+    asks for the training timeline, unstamped setup spans are noise."""
+    out = []
+    for rec in records:
+        if since is not None and float(rec.get("ts", 0.0)) < since:
+            continue
+        if steps is not None:
+            step = rec.get("step")
+            if step is None or step < 0:
+                continue
+            lo, hi = steps
+            if (lo is not None and step < lo) \
+                    or (hi is not None and step > hi):
+                continue
+        if proc is not None and rec.get("proc") != proc \
+                and rec.get("pid") != proc:
+            continue
+        out.append(rec)
+    return out
+
+
+def dump_trace(path: str, out: str = "",
+               since: Optional[float] = None, steps=None,
+               proc: Optional[int] = None) -> int:
     """Merge a span-trace directory (or one span file) into a single
-    Chrome trace JSON; deterministic for fixed inputs."""
+    Chrome trace JSON; deterministic for fixed inputs. Filters run on
+    the raw records, so flow arrows only connect surviving spans."""
     from dlrover_tpu.telemetry import tracing
 
     try:
-        trace = tracing.merge_trace_dir(path)
+        records = tracing.read_trace_dir(path)
     except OSError as e:
         print(f"cannot read {path}: {e}", file=sys.stderr)
         return 2
+    total = len(records)
+    if since is not None or steps is not None or proc is not None:
+        records = filter_spans(
+            records, since=since, steps=steps, proc=proc
+        )
+        print(
+            f"-- filters kept {len(records)}/{total} spans",
+            file=sys.stderr,
+        )
+    trace = tracing.chrome_trace(records)
     events = trace["traceEvents"]
     spans = [e for e in events if e.get("ph") == "X"]
     pids = sorted({e["pid"] for e in spans})
@@ -144,9 +215,39 @@ def main(argv=None) -> int:
         help="with --trace: write the merged trace here (default "
         "stdout)",
     )
+    ap.add_argument(
+        "--since", default=None,
+        help="with --trace: keep spans starting at/after this time "
+        "(unix seconds or YYYY-MM-DD[ HH:MM:SS], local)",
+    )
+    ap.add_argument(
+        "--step", default=None, dest="step_range",
+        help="with --trace: keep spans stamped with a global step in "
+        "N..M (single N, open ends '100..' / '..200' allowed)",
+    )
+    ap.add_argument(
+        "--proc", default=None, type=int,
+        help="with --trace: keep one process (JAX process index or "
+        "OS pid)",
+    )
     args = ap.parse_args(argv)
     if args.as_trace:
-        return dump_trace(args.journal, args.out)
+        try:
+            since = (
+                _parse_since(args.since)
+                if args.since is not None else None
+            )
+            steps = (
+                _parse_step_range(args.step_range)
+                if args.step_range is not None else None
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        return dump_trace(
+            args.journal, args.out, since=since, steps=steps,
+            proc=args.proc,
+        )
     try:
         events = read_journal(args.journal)
     except OSError as e:

@@ -15,6 +15,13 @@ hitting every host). Routes:
     local process's phase snapshot, plus the job-level aggregation
     (goodput %, badput by cause, MTTR/MTBF) when this process is the
     master;
+  * ``GET /fleet`` / ``GET /fleet.json`` — the master's fleet
+    observability plane (telemetry/fleet.py): per-series quantiles
+    rolled up from relay-carried digests, per-host breakdown, top-k
+    stragglers, counters and SLO state — text summary or the raw
+    snapshot document. 404 until a provider is attached
+    (:func:`set_fleet_provider`), i.e. on agents and on masters that
+    predate the plane;
   * ``GET /healthz``  — liveness probe. With a hang detector attached
     (:func:`attach_hang_detector`) a stalled training loop turns the
     probe into 503 + ``{"status": "degraded", "stalled_for": ...}`` so
@@ -58,6 +65,7 @@ __all__ = [
     "attach_hang_detector",
     "set_health_check",
     "set_shard_provider",
+    "set_fleet_provider",
 ]
 
 # -------------------------------------------------------------- health state
@@ -127,6 +135,85 @@ def _current_shard_provider(server):
         return _shard_provider
 
 
+# The fleet plane attaches the same way: the FleetAggregator lives on
+# the master object, the server wherever the process started one.
+
+_fleet_lock = threading.Lock()
+_fleet_provider = None  # () -> dict (FleetAggregator.snapshot document)
+
+
+def set_fleet_provider(fn) -> None:
+    """Install the process-wide fleet snapshot provider backing
+    ``/fleet``: a zero-arg callable returning the snapshot document
+    (:meth:`~dlrover_tpu.telemetry.fleet.FleetAggregator.snapshot`).
+    None clears it."""
+    global _fleet_provider
+    with _fleet_lock:
+        _fleet_provider = fn
+
+
+def _current_fleet_provider():
+    with _fleet_lock:
+        return _fleet_provider
+
+
+def _format_fleet_text(doc) -> str:
+    """Human-first rendering of the fleet snapshot: the view an
+    operator curls during an incident."""
+    lines = ["# fleet observability plane"]
+    lines.append(
+        "sources=%d digests=%d store_bytes=%d" % (
+            doc.get("sources", 0), doc.get("digests", 0),
+            doc.get("store_bytes", 0),
+        )
+    )
+    series = doc.get("series") or {}
+    if series:
+        lines.append("")
+        lines.append("## series (current window)")
+        for name in sorted(series):
+            s = series[name]
+            lines.append(
+                "%-12s n=%-8d p50=%.1fms p90=%.1fms p99=%.1fms "
+                "max=%.1fms" % (
+                    name, s.get("count", 0), s.get("p50_ms", 0.0),
+                    s.get("p90_ms", 0.0), s.get("p99_ms", 0.0),
+                    s.get("max_ms", 0.0),
+                )
+            )
+    counters = doc.get("counters") or {}
+    if counters:
+        lines.append("")
+        lines.append("## counters")
+        for name in sorted(counters):
+            lines.append("%-32s %d" % (name, counters[name]))
+    stragglers = doc.get("stragglers") or []
+    if stragglers:
+        lines.append("")
+        lines.append("## stragglers (top-%d behind)" % len(stragglers))
+        for h in stragglers:
+            lines.append(
+                "%-24s step=%-10d behind=%d" % (
+                    h.get("host", "?"), h.get("step", -1),
+                    h.get("behind", 0),
+                )
+            )
+    slo = doc.get("slo")
+    if slo:
+        lines.append("")
+        lines.append("## slo")
+        for name in sorted(slo):
+            obj = slo[name]
+            lines.append(
+                "%-20s %s %s value=%s %s" % (
+                    name, obj.get("op"), obj.get("target"),
+                    obj.get("value"),
+                    "VIOLATED" if obj.get("violated") else "ok",
+                )
+            )
+    return "\n".join(lines) + "\n"
+
+
 def _current_health():
     with _health_lock:
         check = _health_check
@@ -148,9 +235,9 @@ _JOURNAL_TAIL_MAX = 4096
 _FILE_TAIL_BYTES = 256 * 1024
 
 
-def _tail_journal_file(path, n, kind=None):
-    """Last ``n`` parsed events from the end of a JSONL journal file,
-    reading at most ``_FILE_TAIL_BYTES``. Never raises."""
+def _tail_one_file(path):
+    """Parsed events from the last ``_FILE_TAIL_BYTES`` of one JSONL
+    file. Never raises."""
     try:
         with open(path, "rb") as f:
             f.seek(0, 2)
@@ -171,6 +258,20 @@ def _tail_journal_file(path, n, kind=None):
             events.append(json.loads(line))
         except (json.JSONDecodeError, UnicodeDecodeError):
             continue
+    return events
+
+
+def _tail_journal_file(path, n, kind=None):
+    """Last ``n`` parsed events from the end of a JSONL journal file,
+    reading at most ``_FILE_TAIL_BYTES`` per file. When the current
+    file is short of ``n`` (e.g. rotation just happened), the rotated
+    predecessor ``<path>.1`` fills the head — the tail reads across the
+    rotation boundary (ENV_JOURNAL_MAX_MB). Never raises."""
+    events = _tail_one_file(path)
+    if len(events) < n:
+        events = _tail_one_file(path + ".1")[
+            - max(0, n - len(events)):
+        ] + events
     if kind:
         events = [
             e for e in events
@@ -236,6 +337,29 @@ class _Handler(BaseHTTPRequestHandler):
                 ).encode(),
                 "application/json",
             )
+        elif url.path in ("/fleet", "/fleet.json"):
+            provider = _current_fleet_provider()
+            if provider is None:
+                self._send(
+                    404, b'{"error": "no fleet aggregator"}\n',
+                    "application/json",
+                )
+            else:
+                try:
+                    doc = provider() or {}
+                except Exception as e:
+                    logger.warning("fleet snapshot failed: %s", e)
+                    doc = {"error": str(e)}
+                if url.path == "/fleet.json":
+                    self._send(
+                        200, json.dumps(doc, default=str).encode(),
+                        "application/json",
+                    )
+                else:
+                    self._send(
+                        200, _format_fleet_text(doc).encode(),
+                        "text/plain; charset=utf-8",
+                    )
         elif url.path == "/healthz":
             degraded = _current_health()
             if degraded:

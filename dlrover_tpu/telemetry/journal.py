@@ -42,8 +42,23 @@ from dlrover_tpu.common.log import default_logger as logger
 
 ENV_JOURNAL = "DLROVER_TPU_JOURNAL"
 
+#: size cap (MB) on the backing JSONL file; past it the file is
+#: atomically renamed to ``<path>.1`` (replacing the previous ``.1``)
+#: and a fresh file begins with a ``journal.rotated`` event, so a
+#: months-long run holds at most ~2x the cap on disk. 0/unset = never
+#: rotate. Readers (``read_journal``, ``/journal?source=file``, the
+#: dump CLI) stitch ``<path>.1`` + ``<path>`` back into one timeline.
+ENV_JOURNAL_MAX_MB = "DLROVER_TPU_JOURNAL_MAX_MB"
+
+#: every N writes the writer re-syncs against the file (fstat size —
+#: other processes append to the same file — and an inode check that
+#: detects a rotation done by a SIBLING process, so this writer
+#: reopens the new file instead of growing the rotated one forever)
+_RESYNC_EVERY = 128
+
 __all__ = [
     "ENV_JOURNAL",
+    "ENV_JOURNAL_MAX_MB",
     "EventJournal",
     "default_journal",
     "set_default_journal",
@@ -95,13 +110,25 @@ def _notify_taps(event: Dict[str, Any]) -> None:
 class EventJournal:
     """Append-only structured event sink (memory ring + optional JSONL)."""
 
-    def __init__(self, path: Optional[str] = None, capacity: int = 4096):
+    def __init__(self, path: Optional[str] = None, capacity: int = 4096,
+                 max_bytes: Optional[int] = None):
         self.path = path
         self._lock = threading.Lock()
         self._seq = 0
         self._ring: deque = deque(maxlen=capacity)
         self._fd: Optional[int] = None
         self._host = socket.gethostname()
+        if max_bytes is None:
+            try:
+                max_mb = float(
+                    os.getenv(ENV_JOURNAL_MAX_MB, "0") or 0
+                )
+            except ValueError:
+                max_mb = 0.0
+            max_bytes = int(max_mb * 1024 * 1024)
+        self._max_bytes = max(0, max_bytes)  # 0 = never rotate
+        self._size = 0
+        self._writes_since_resync = 0
         if path:
             try:
                 os.makedirs(
@@ -110,6 +137,7 @@ class EventJournal:
                 self._fd = os.open(
                     path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
                 )
+                self._size = os.fstat(self._fd).st_size
             except OSError as e:
                 logger.warning(
                     "event journal %s unavailable (%s); memory-only",
@@ -120,6 +148,7 @@ class EventJournal:
     def record(self, kind: str, **fields: Any) -> Dict[str, Any]:
         """Append one event; returns the full envelope dict. Never
         raises — telemetry must not take the instrumented path down."""
+        rotated_from_bytes = 0
         with self._lock:
             self._seq += 1
             event = {
@@ -136,6 +165,14 @@ class EventJournal:
                 try:
                     line = json.dumps(event, default=str) + "\n"
                     os.write(self._fd, line.encode())
+                    self._size += len(line)
+                    self._writes_since_resync += 1
+                    if self._writes_since_resync >= _RESYNC_EVERY:
+                        self._resync_locked()
+                    if self._max_bytes \
+                            and self._size >= self._max_bytes:
+                        rotated_from_bytes = self._size
+                        self._rotate_locked()
                 except OSError as e:
                     logger.warning(
                         "journal write failed (%s); memory-only from "
@@ -147,7 +184,65 @@ class EventJournal:
                         pass
                     self._fd = None
         _notify_taps(event)
+        if rotated_from_bytes:
+            # first event of the fresh file — outside the lock, via the
+            # normal path, so taps/ring see it too
+            self.record(
+                "journal.rotated", path=self.path,
+                rotated_to=self.path + ".1",
+                size_bytes=rotated_from_bytes,
+                max_bytes=self._max_bytes,
+            )
         return event
+
+    def _resync_locked(self):
+        """Periodic truth check against the filesystem: other processes
+        append to the same file (count their bytes toward the cap), and
+        one of them may have rotated it (our fd then points at the
+        renamed ``.1`` — reopen the path so we write the NEW file)."""
+        self._writes_since_resync = 0
+        try:
+            fd_stat = os.fstat(self._fd)
+            try:
+                path_stat = os.stat(self.path)
+            except FileNotFoundError:
+                path_stat = None
+            if path_stat is None or path_stat.st_ino != fd_stat.st_ino:
+                os.close(self._fd)
+                self._fd = os.open(
+                    self.path,
+                    os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644,
+                )
+                self._size = os.fstat(self._fd).st_size
+            else:
+                self._size = fd_stat.st_size
+        except OSError:
+            pass  # keep the approximate counter; never take record() down
+
+    def _rotate_locked(self):
+        """Atomic rename to ``<path>.1`` + fresh file. The rename is a
+        single ``os.replace``: readers either see the old name or the
+        new, never a torn file."""
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+        self._fd = None
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError as e:
+            logger.warning("journal rotation failed: %s", e)
+        try:
+            self._fd = os.open(
+                self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+            )
+            self._size = os.fstat(self._fd).st_size
+        except OSError as e:
+            logger.warning(
+                "journal reopen after rotation failed (%s); "
+                "memory-only from here", e,
+            )
+            self._fd = None
 
     def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
         """In-memory tail, oldest first; ``kind`` filters exact or by
@@ -223,17 +318,31 @@ def record(kind: str, **fields: Any) -> Dict[str, Any]:
 def read_journal(path: str) -> List[Dict[str, Any]]:
     """Parse a JSONL journal file; unparseable lines (a torn write from
     a crashed process) are skipped, not fatal. Ordered by ``(ts, pid,
-    seq)`` so multi-process appends interleave into one timeline."""
+    seq)`` so multi-process appends interleave into one timeline. A
+    rotated predecessor (``<path>.1``, see ``ENV_JOURNAL_MAX_MB``) is
+    stitched in front, so consumers read across the rotation boundary
+    without knowing it exists."""
     events = []
-    with open(path, "r") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+    opened = False
+    for p in (path + ".1", path):
+        try:
+            f = open(p, "r")
+        except OSError:
+            continue
+        opened = True
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    if not opened:
+        # neither the file nor a rotated predecessor: keep the
+        # pre-rotation contract (callers report the missing path)
+        raise FileNotFoundError(path)
     events.sort(
         key=lambda e: (
             e.get("ts", 0.0), e.get("pid", 0), e.get("seq", 0)

@@ -26,7 +26,17 @@ stay wired into the hot paths permanently:
     (same atomic ``O_APPEND`` discipline as the journal), and
     ``python -m dlrover_tpu.telemetry.dump <dir> --trace`` merges every
     process's file into ONE Chrome trace-event JSON loadable in
-    ``chrome://tracing`` / Perfetto.
+    ``chrome://tracing`` / Perfetto;
+  * **cross-process causality** (ISSUE 17): a W3C-style trace context
+    (trace id + parent span id) rides a ``contextvars.ContextVar``.
+    Every enabled span allocates a span id, parents itself under the
+    current context and installs itself as the context for its body —
+    so nested spans chain naturally, and an RPC issued inside a span
+    carries ``traceparent()`` as gRPC metadata
+    (common/grpc_utils.py injects/extracts it). The merge links
+    cross-process parent/child edges with Perfetto flow events. All of
+    this lives strictly behind the ``_enabled`` check: the disabled
+    path is still one global read + the shared no-op.
 
 Usage::
 
@@ -43,13 +53,16 @@ Enable with ``DLROVER_TPU_TRACE=1`` (in-memory ring only) or
 programmatically via :func:`enable`.
 """
 
+import contextvars
+import itertools
 import json
 import os
 import socket
 import threading
 import time
+import zlib
 from collections import deque
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from dlrover_tpu.common.log import current_process_index
 from dlrover_tpu.common.log import default_logger as logger
@@ -61,6 +74,7 @@ ENV_TRACE_RING = "DLROVER_TPU_TRACE_RING"
 __all__ = [
     "ENV_TRACE",
     "ENV_TRACE_DIR",
+    "TRACE_METADATA_KEY",
     "span",
     "add_span",
     "set_step",
@@ -74,7 +88,15 @@ __all__ = [
     "chrome_trace",
     "merge_trace_dir",
     "read_span_file",
+    "current_context",
+    "trace_context",
+    "traceparent",
+    "parse_traceparent",
 ]
+
+#: gRPC metadata key the trace context crosses process boundaries under
+#: (grpc metadata keys must be lowercase)
+TRACE_METADATA_KEY = "dlrover-trace"
 
 #: the ONE branch the hot path pays when tracing is off — a module
 #: global read; everything else lives behind it.
@@ -87,11 +109,97 @@ _path: Optional[str] = None
 _host = socket.gethostname()
 _step = -1  # current training step (int store/load is GIL-atomic)
 
+# ----------------------------------------------------------- trace context
+
+#: (trace_id, span_id) of the innermost live span / extracted RPC
+#: parent; contextvars give per-thread AND per-asyncio-task isolation.
+_context: contextvars.ContextVar[Optional[Tuple[str, str]]] = (
+    contextvars.ContextVar("dlrover_trace_context", default=None)
+)
+
+#: span/trace ids: host-hash + pid prefix + monotonic counter. Unique
+#: fleet-wide without an os.urandom syscall per span; ``next()`` on
+#: itertools.count is GIL-atomic. Subprocesses re-import, so the
+#: prefix re-derives per process.
+_id_prefix = "%04x%04x" % (
+    zlib.crc32(_host.encode()) & 0xFFFF, os.getpid() & 0xFFFF
+)
+_id_counter = itertools.count(1)
+
+
+def _new_id() -> str:
+    return _id_prefix + "%08x" % (next(_id_counter) & 0xFFFFFFFF)
+
+
+def current_context() -> Optional[Tuple[str, str]]:
+    """The live (trace_id, span_id) pair, or None outside any trace."""
+    return _context.get()
+
+
+class trace_context:
+    """Install an extracted trace context for a block — the server side
+    of propagation: ``with trace_context(trace_id, span_id): handle()``
+    makes every span in the handler a child of the remote caller's
+    span. ``trace_context(None, None)`` (or falsy ids) is a no-op pass-
+    through, so extraction sites need no conditional."""
+
+    __slots__ = ("_trace", "_span", "_tok")
+
+    def __init__(self, trace_id: Optional[str], span_id: Optional[str]):
+        self._trace = trace_id
+        self._span = span_id
+        self._tok = None
+
+    def __enter__(self):
+        if self._trace and self._span:
+            self._tok = _context.set((self._trace, self._span))
+        return self
+
+    def __exit__(self, *exc):
+        if self._tok is not None:
+            try:
+                _context.reset(self._tok)
+            except ValueError:
+                # reset from a different context (generator hop):
+                # nothing to restore, the context died with its task
+                pass
+            self._tok = None
+        return False
+
+
+def traceparent() -> Optional[str]:
+    """The outbound wire form ``<trace_id>-<span_id>`` for the current
+    context, or None when tracing is off / no trace is live. The ONE
+    call RPC clients make per request — a module-global check first, so
+    the disabled fleet pays a few nanoseconds."""
+    if not _enabled:
+        return None
+    ctx = _context.get()
+    if ctx is None:
+        return None
+    return ctx[0] + "-" + ctx[1]
+
+
+def parse_traceparent(value: str) -> Tuple[Optional[str], Optional[str]]:
+    """Split a wire ``traceparent`` back into (trace_id, span_id);
+    malformed input degrades to (None, None), never raises — a bad
+    header must not take down an RPC handler."""
+    if not value or not isinstance(value, str):
+        return None, None
+    trace_id, sep, span_id = value.partition("-")
+    if not sep or not trace_id or not span_id:
+        return None, None
+    return trace_id, span_id
+
 
 class _NoopSpan:
-    """Shared disabled-path context manager: no state, no allocation."""
+    """Shared disabled-path context manager: no state, no allocation.
+    Class-level ids so call sites can read ``sp.span_id`` unguarded."""
 
     __slots__ = ()
+
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
 
     def __enter__(self):
         return self
@@ -105,23 +213,43 @@ _NOOP = _NoopSpan()
 
 class _Span:
     """A live span: wall-clock start (cross-process alignment) plus a
-    perf_counter duration (monotonic, immune to clock steps)."""
+    perf_counter duration (monotonic, immune to clock steps). On entry
+    it joins the current trace (or roots a new one), allocates its span
+    id and becomes the context for its body — children and outbound
+    RPCs parent under it."""
 
-    __slots__ = ("_name", "_attrs", "_ts", "_t0")
+    __slots__ = ("_name", "_attrs", "_ts", "_t0",
+                 "trace_id", "span_id", "_parent", "_tok")
 
     def __init__(self, name: str, attrs: Optional[Dict[str, Any]]):
         self._name = name
         self._attrs = attrs
 
     def __enter__(self):
+        ctx = _context.get()
+        self.span_id = _new_id()
+        if ctx is not None:
+            self.trace_id, self._parent = ctx
+        else:
+            # no live trace: this span roots one, so an RPC issued in
+            # its body starts a cross-process chain
+            self.trace_id = _new_id()
+            self._parent = None
+        self._tok = _context.set((self.trace_id, self.span_id))
         self._ts = time.time()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dur = time.perf_counter() - self._t0
+        try:
+            _context.reset(self._tok)
+        except ValueError:
+            pass  # exited in a different context (generator hop)
         _finish(self._name, self._ts, dur, self._attrs,
-                error=exc_type is not None)
+                error=exc_type is not None,
+                trace=self.trace_id, span=self.span_id,
+                parent=self._parent)
         return False
 
 
@@ -141,10 +269,16 @@ def add_span(name: str, start_ts: float, duration_s: float,
              attrs: Optional[Dict[str, Any]] = None) -> None:
     """Record a span retroactively from timestamps already measured
     (rendezvous rounds, checkpoint staging — paths that track their own
-    start time). No-op while tracing is disabled."""
+    start time). Joins the current trace context as a leaf child when
+    one is live. No-op while tracing is disabled."""
     if not _enabled:
         return
-    _finish(name, start_ts, max(0.0, duration_s), attrs)
+    ctx = _context.get()
+    if ctx is not None:
+        _finish(name, start_ts, max(0.0, duration_s), attrs,
+                trace=ctx[0], span=_new_id(), parent=ctx[1])
+    else:
+        _finish(name, start_ts, max(0.0, duration_s), attrs)
 
 
 def set_step(step: int) -> None:
@@ -160,7 +294,9 @@ def current_step() -> int:
 
 
 def _finish(name: str, ts: float, dur: float,
-            attrs: Optional[Dict[str, Any]], error: bool = False) -> None:
+            attrs: Optional[Dict[str, Any]], error: bool = False,
+            trace: Optional[str] = None, span: Optional[str] = None,
+            parent: Optional[str] = None) -> None:
     th = threading.current_thread()
     rec = {
         "name": name,
@@ -173,6 +309,12 @@ def _finish(name: str, ts: float, dur: float,
         "thread": th.name,
         "step": _step,
     }
+    if trace is not None:
+        rec["trace"] = trace
+    if span is not None:
+        rec["span"] = span
+    if parent is not None:
+        rec["parent"] = parent
     if attrs:
         rec["attrs"] = attrs
     if error:
@@ -327,11 +469,20 @@ def summarize(names: Optional[Iterable[str]] = None,
 
 def _chrome_events(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Trace-event "X" (complete) events plus process/thread metadata.
-    Deterministic: events sorted by (ts, pid, tid, name) so merging the
-    same inputs always yields byte-identical output."""
+    Parent/child span edges that cross a process boundary additionally
+    get Perfetto flow events ("s" on the parent slice, "f" on the
+    child) so the viewer draws the causal arrow worker → relay →
+    master. Deterministic: events sorted by (ts, pid, tid, name, ph) so
+    merging the same inputs always yields byte-identical output."""
     events: List[Dict[str, Any]] = []
     procs: Dict[int, Dict[str, Any]] = {}
     threads: Dict[tuple, str] = {}
+    #: span id -> its record, for cross-process flow linking
+    by_span: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        sid = rec.get("span")
+        if sid:
+            by_span.setdefault(str(sid), rec)
     for rec in records:
         pid = int(rec.get("pid", 0))
         tid = int(rec.get("tid", 0))
@@ -341,6 +492,9 @@ def _chrome_events(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             args["step"] = step
         if rec.get("error"):
             args["error"] = True
+        for key in ("trace", "span", "parent"):
+            if rec.get(key):
+                args[key] = rec[key]
         events.append({
             "ph": "X",
             "name": str(rec.get("name", "?")),
@@ -351,6 +505,27 @@ def _chrome_events(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "tid": tid,
             "args": args,
         })
+        parent = rec.get("parent")
+        if parent and str(parent) in by_span:
+            prec = by_span[str(parent)]
+            if int(prec.get("pid", 0)) != pid:
+                # cross-process causal edge: one flow per child, id'd
+                # by the child span so every edge is distinct
+                flow_id = str(rec.get("span") or parent)
+                events.append({
+                    "ph": "s", "id": flow_id, "name": "trace",
+                    "cat": "dlrover.flow",
+                    "ts": round(float(prec.get("ts", 0.0)) * 1e6, 3),
+                    "pid": int(prec.get("pid", 0)),
+                    "tid": int(prec.get("tid", 0)),
+                })
+                events.append({
+                    "ph": "f", "bp": "e", "id": flow_id,
+                    "name": "trace", "cat": "dlrover.flow",
+                    "ts": round(float(rec.get("ts", 0.0)) * 1e6, 3),
+                    "pid": pid,
+                    "tid": tid,
+                })
         if pid not in procs:
             proc = rec.get("proc")
             host = rec.get("host", "?")
@@ -362,7 +537,9 @@ def _chrome_events(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
                 "sort": proc if isinstance(proc, int) else pid,
             }
         threads.setdefault((pid, tid), str(rec.get("thread", tid)))
-    events.sort(key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"]))
+    events.sort(key=lambda e: (
+        e["ts"], e["pid"], e["tid"], e["name"], e["ph"],
+    ))
     meta: List[Dict[str, Any]] = []
     for pid in sorted(procs):
         meta.append({
@@ -410,10 +587,10 @@ def read_span_file(path: str) -> List[Dict[str, Any]]:
     return records
 
 
-def merge_trace_dir(path: str) -> Dict:
-    """Merge every process's span file under ``path`` (or a single
-    ``.jsonl`` file) into one Chrome trace object. Deterministic for a
-    fixed set of input files — diffable across re-runs of the merge."""
+def read_trace_dir(path: str) -> List[Dict[str, Any]]:
+    """Every process's span records under ``path`` (or from a single
+    ``.jsonl`` file), in deterministic file order — the raw-record view
+    ``dump --trace`` filters before rendering."""
     records: List[Dict[str, Any]] = []
     if os.path.isdir(path):
         names = sorted(
@@ -424,8 +601,15 @@ def merge_trace_dir(path: str) -> Dict:
             records.extend(read_span_file(os.path.join(path, name)))
     else:
         records.extend(read_span_file(path))
+    return records
+
+
+def merge_trace_dir(path: str) -> Dict:
+    """Merge every process's span file under ``path`` (or a single
+    ``.jsonl`` file) into one Chrome trace object. Deterministic for a
+    fixed set of input files — diffable across re-runs of the merge."""
     return {
-        "traceEvents": _chrome_events(records),
+        "traceEvents": _chrome_events(read_trace_dir(path)),
         "displayTimeUnit": "ms",
     }
 

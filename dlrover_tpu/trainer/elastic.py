@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.telemetry import tracing
+from dlrover_tpu.telemetry import fleet, tracing
 
 
 def compute_accum_steps(max_nodes: int, cur_nodes: int) -> int:
@@ -112,6 +112,7 @@ class ElasticTrainer:
         self._fault_injector = None
         self._created_ts = time.monotonic()
         self._first_step_seen = False
+        self._last_step_mono: Optional[float] = None
         # per-process goodput ledger (telemetry/goodput.py): phase
         # transitions ride on events that already fire; the trainer
         # only marks steps (-> training) and checkpoint stalls
@@ -328,6 +329,14 @@ class ElasticTrainer:
         )
         # spans and flight records carry the step they happened at
         tracing.set_step(self._global_step)
+        # step duration feeds the fleet roll-up plane (ISSUE 17): the
+        # master answers fleet p99 step time from these sketches with
+        # zero agent scrapes
+        now_mono = time.monotonic()
+        if self._last_step_mono is not None:
+            fleet.observe("step", now_mono - self._last_step_mono)
+            fleet.incr("steps")
+        self._last_step_mono = now_mono
         if not self._first_step_seen:
             # the first completed step carries the compile: classify
             # warm (persistent-cache hit) vs cold for the journal

@@ -1,0 +1,11 @@
+"""Fixture: exactly one metric-registry violation — a metric emitted
+with no row in the docs/TELEMETRY.md table (invisible to operators)."""
+
+from dlrover_tpu.telemetry import counter
+
+
+def observe():
+    counter(
+        "dlrover_fixture_only_metric_total",
+        "fixture metric no doc mentions",
+    ).inc()

@@ -432,3 +432,13 @@ def test_swarm_bench_smoke():
     assert result["relay_phase_dropped"] == 0
     assert result["relay_forwarded_batches"] > 0
     assert result["relay_forwarded_reports"] > 0
+    # the fleet roll-up phase (ISSUE 17, --smoke forces --fleet):
+    # quantiles materialize at the master from relay-pre-merged
+    # digests — zero agent scrapes, one digest source per RELAY —
+    # and the digest costs at most 2x the bare delta on the wire
+    assert result["fleet_agent_scrapes"] == 0
+    assert result["fleet_step_count"] > 0
+    assert result["fleet_step_p99_ms"] > 0.0
+    assert 0 < result["fleet_sources"] <= 2
+    assert result["fleet_digests"] > 0
+    assert result["fleet_digest_ratio"] <= 2.0

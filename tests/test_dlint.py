@@ -50,6 +50,7 @@ EXPECTED_ANCHORS = {
     "no-blocking-in-async": "dispatch:time.sleep",
     "commit-before-reply": "get_task:no-persist",
     "knob-registry": "default:DLROVER_TPU_FIXTURE_ONLY_KNOB",
+    "metric-registry": "undocumented:dlrover_fixture_only_metric_total",
 }
 
 #: the baseline ratchet: justified exceptions may be removed, never

@@ -324,7 +324,7 @@ def test_servicer_orders_rollback_and_recovers(monkeypatch):
     assert resp.rollback_id == 1 and resp.rollback_step == 5
     assert not resp.quarantined
     order = json.loads(sv._kv_store.get(ROLLBACK_ORDER_KEY).decode())
-    assert order == {"id": 1, "step": 5}
+    assert order["id"] == 1 and order["step"] == 5
     # a second rank tripping on the SAME corrupted state rides the
     # in-flight order instead of burning budget
     resp2 = sv.handle("report_anomaly", _report(1, "host-b", last_good=4))

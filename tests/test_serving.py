@@ -244,6 +244,71 @@ def test_autoscaler_respects_bounds_and_idles_down():
     assert calls == [2]
 
 
+def test_autoscaler_attributed_hold_on_model_time(monkeypatch):
+    """ISSUE 17: a p99 blown by MODEL time is not fixable by adding a
+    replica — the scaler holds and journals the attribution. The same
+    p99 blown by QUEUE WAIT scales as before."""
+    from dlrover_tpu.telemetry.journal import (
+        EventJournal,
+        default_journal,
+        set_default_journal,
+    )
+
+    set_default_journal(EventJournal())
+    try:
+        calls = []
+        held = {"submitted": 50, "queue_depth": 0, "p99_ms": 5000.0,
+                "queue_wait_p99_ms": 40.0, "model_time_p99_ms": 4900.0,
+                "workers": 2, "in_flight": 2, "sealed": False}
+        assert _scaler(held, calls).evaluate() is None
+        assert not calls
+        evs = default_journal().events("serve.autoscale_held")
+        assert len(evs) == 1
+        ev = evs[0]["data"]
+        assert ev["cause"] == "model_time"
+        assert ev["model_time_p99_ms"] == 4900.0
+        assert ev["queue_wait_p99_ms"] == 40.0
+        assert ev["replicas"] == 2
+
+        # queue-wait-dominated: one more replica genuinely helps
+        waity = dict(held, queue_wait_p99_ms=4900.0,
+                     model_time_p99_ms=40.0)
+        assert _scaler(waity, calls).evaluate() == 3
+        assert calls == [3]
+        scaled = default_journal().events("serve.autoscale")
+        assert scaled and scaled[-1]["data"]["reason"] == "p99_latency"
+        assert scaled[-1]["data"]["queue_wait_p99_ms"] == 4900.0
+
+        # stats from an older router (no split keys) keep the legacy
+        # behavior: p99 alone scales
+        legacy = {"submitted": 50, "queue_depth": 0, "p99_ms": 5000.0,
+                  "workers": 2, "in_flight": 2, "sealed": False}
+        assert _scaler(legacy, calls).evaluate() == 3
+    finally:
+        set_default_journal(EventJournal())
+
+
+def test_router_splits_latency_into_queue_wait_and_model_time():
+    """The router attributes each completion's latency to queue wait
+    (submit -> winning lease) vs model time (lease -> complete) — the
+    signal the autoscaler hold and the SLO attribution read."""
+    r = RequestRouter()
+    ok, rid, _ = r.submit(b"ping")
+    assert ok
+    time.sleep(0.12)  # queue wait: nobody leases yet
+    batch, _sealed = r.lease(W, 0, max_requests=4, incarnation=0)
+    assert batch == [(rid, b"ping")]
+    time.sleep(0.02)  # model time: short
+    assert r.complete(W, 0, rid, b"pong")
+    stats = r.stats()
+    wait_ms = stats["queue_wait_p99_ms"]
+    model_ms = stats["model_time_p99_ms"]
+    assert wait_ms >= 80.0  # dominated by the pre-lease sleep
+    assert model_ms < wait_ms
+    # the split partitions the end-to-end latency (allow scheduler slop)
+    assert wait_ms + model_ms == pytest.approx(stats["p99_ms"], rel=0.25)
+
+
 # -------------------------------------------------- injection grammar
 
 

@@ -19,6 +19,9 @@ stays bounded; the journal carries the canonical serve.* vocabulary
 (worker_ready x3, request_redelivered, relinquished, sealed, drained,
 both worker_exit reasons); the master exits 0 once the stream drains;
 and the job's goodput account books `serving` time for the replicas.
+With ``DLROVER_TPU_SLO=serve_p99_ms<=50`` the master's SLO evaluator
+journals ``slo.violated`` carrying the queue-wait vs model-time
+latency split (ISSUE 17 attributed cause).
 """
 
 import os
@@ -90,7 +93,12 @@ def test_serving_chaos_drill(tmp_path):
     ram_dir = os.path.join(tmp, "ram")
     journal_path = os.path.join(tmp, "journal.jsonl")
     env = _drill_env(journal_path)
-    master_env = dict(env, DLROVER_TPU_SERVE_LEASE_TIMEOUT="2.5")
+    # SLO plane (ISSUE 17): with 160 requests queued upfront against
+    # a 100ms model, the serve p99 is guaranteed past 50ms — the
+    # master must journal slo.violated and attribute WHICH side blew
+    # it (queue wait, here: the backlog dwarfs per-batch model time)
+    master_env = dict(env, DLROVER_TPU_SERVE_LEASE_TIMEOUT="2.5",
+                      DLROVER_TPU_SLO="serve_p99_ms<=50")
     worker_envs = {
         0: dict(env, DLROVER_FAULT_INJECT=f"serve_kill@{KILL_AFTER}"),
         1: dict(env),
@@ -228,6 +236,21 @@ def test_serving_chaos_drill(tmp_path):
         # drill runs the scaler) with the queue-depth trigger
         auto = T.default_journal().events("serve.autoscale")
         assert auto and auto[-1]["data"]["reason"] == "queue_depth"
+
+        # --- SLO: the master saw the blown serve p99 and said WHY ----
+        violated = [e for e in events if e.get("kind") == "slo.violated"]
+        assert violated, "slo.violated never journaled"
+        v = violated[0]["data"]
+        assert v["objective"] == "serve_p99_ms"
+        assert v["value"] > 50.0
+        # attributed latency: both sides of the split ride the event,
+        # and the blamed cause is the dominant side AT VIOLATION ONSET
+        # (typically model_time: the first batch completes with ~zero
+        # queue wait and a 100ms model against a 50ms objective)
+        assert v["cause"] in ("queue_wait", "model_time")
+        qw, mt = v["queue_wait_p99_ms"], v["model_time_p99_ms"]
+        assert mt > 0.0
+        assert v["cause"] == ("model_time" if mt > qw else "queue_wait")
         assert auto[-1]["data"]["target"] == 3
 
         # goodput: serving incarnations book `serving` time on the job

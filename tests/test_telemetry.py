@@ -171,6 +171,64 @@ def test_read_journal_skips_torn_lines(tmp_path):
     assert len(evts) == 1 and evts[0]["kind"] == "a"
 
 
+def test_journal_rotation_caps_disk_contiguous_tail(tmp_path):
+    """ISSUE 17: with DLROVER_TPU_JOURNAL_MAX_MB set, the journal
+    rotates to ``<path>.1`` at the cap. Disk stays bounded (current +
+    one predecessor), the stitched read_journal() view keeps a
+    CONTIGUOUS tail of the newest events (rotation drops oldest-first,
+    never punches holes), and each rotation journals itself."""
+    import os
+
+    path = str(tmp_path / "j.jsonl")
+    cap = 2000
+    j = EventJournal(path, max_bytes=cap)
+    for i in range(40):
+        j.record("checkpoint.save", step=i, i=i)
+    evts = read_journal(path)
+    iv = [e["data"]["i"] for e in evts if e["kind"] == "checkpoint.save"]
+    assert iv, "stitched view lost everything"
+    assert iv == list(range(iv[0], 40)), (
+        "rotation must keep a contiguous tail, got holes: %r" % (iv,)
+    )
+    assert iv[-1] == 39  # the newest event always survives
+    rotated = [e for e in evts if e["kind"] == "journal.rotated"]
+    assert rotated, "no journal.rotated marker in the stitched view"
+    for e in rotated:
+        assert e["data"]["rotated_to"] == path + ".1"
+        assert e["data"]["max_bytes"] == cap
+    disk = os.path.getsize(path)
+    old = path + ".1"
+    if os.path.exists(old):
+        disk += os.path.getsize(old)
+    assert disk < 3 * cap, f"disk {disk}B exceeds 3x the {cap}B cap"
+    # the in-memory ring is unaffected by file rotation
+    assert len(j.events("checkpoint.save")) == 40
+
+
+def test_journal_resync_follows_sibling_rotation(tmp_path):
+    """Two processes share one journal path; when a sibling rotates the
+    file out from under us, the periodic fstat/inode resync reopens the
+    live path instead of appending forever to the renamed ``.1``."""
+    import os
+
+    from dlrover_tpu.telemetry import journal as journal_mod
+
+    path = str(tmp_path / "shared.jsonl")
+    j = EventJournal(path, max_bytes=0)  # this writer never rotates
+    j.record("checkpoint.save", i=-1)
+    # a sibling process rotates the file away
+    os.replace(path, path + ".1")
+    for i in range(journal_mod._RESYNC_EVERY + 2):
+        j.record("checkpoint.save", i=i)
+    # post-resync events landed in the RECREATED live file itself
+    # (read_journal would stitch the .1 back in and hide a regression)
+    assert os.path.exists(path)
+    with open(path) as f:
+        live_is = [json.loads(line)["data"]["i"] for line in f]
+    assert live_is and live_is[-1] == journal_mod._RESYNC_EVERY + 1
+    assert -1 not in live_is  # pre-rotation events stayed in the .1
+
+
 def test_default_journal_env_configured(tmp_path, monkeypatch):
     path = str(tmp_path / "env.jsonl")
     monkeypatch.setenv("DLROVER_TPU_JOURNAL", path)

@@ -1,0 +1,161 @@
+"""Trace-propagation chaos drill (ISSUE 17 acceptance): THREE real OS
+processes — a worker, an aggregator relay, and a gRPC master — each
+armed only by exporting ``DLROVER_TPU_TRACE_DIR``, produce ONE merged
+Chrome trace in which the causal chain
+
+    worker ``report_node_status`` span
+        -> relay ``relay.forward`` span
+            -> master ``rpc.report_relay_batch`` span
+
+is asserted by span parent/child IDs (W3C context riding gRPC metadata
+at each hop), exactly as an operator would see it from
+``python -m dlrover_tpu.telemetry.dump <dir> --trace``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MASTER = """
+import os, sys, time
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.servicer import create_master_service
+
+speed = SpeedMonitor()
+jm = DistributedJobManager(speed_monitor=speed, heartbeat_timeout=3600.0)
+jm._node_managers[NodeType.WORKER].update_nodes({
+    0: Node(NodeType.WORKER, 0, status=NodeStatus.RUNNING),
+})
+server, servicer = create_master_service(
+    0, job_manager=jm, speed_monitor=speed,
+)
+server.start()
+print(f"PORT {server.port}", flush=True)
+stop = sys.argv[1]
+while not os.path.exists(stop):
+    time.sleep(0.05)
+server.stop(grace=0.2)
+servicer.close()
+"""
+
+_WORKER = """
+import sys, time
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.status_reporter import DeltaTracker
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.telemetry import tracing
+
+relay_addr = sys.argv[1]
+cli = MasterClient(relay_addr, node_id=0, node_type=NodeType.WORKER,
+                   timeout=10.0)
+tracker = DeltaTracker(incarnation=0)
+rep = tracker.compose(time.time(), step=7, pid=4242, host="drill-host")
+rep.node_id, rep.node_type = 0, NodeType.WORKER
+tracing.set_step(7)
+with tracing.span("report_node_status", {"node": 0}):
+    ack = cli.report_node_status(rep)
+assert ack is not None and ack.accepted, ack
+cli.close()
+"""
+
+
+def _env(trace_dir):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DLROVER_TPU_TRACE_DIR"] = trace_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _read_port(proc, tag):
+    line = proc.stdout.readline().strip()
+    assert line.startswith("PORT "), f"{tag}: bad handshake {line!r}"
+    return int(line.split()[1])
+
+
+def _spans_by_name(trace_dir):
+    from dlrover_tpu.telemetry import tracing
+
+    out = {}
+    for rec in tracing.read_trace_dir(trace_dir):
+        out.setdefault(rec["name"], []).append(rec)
+    return out
+
+
+def test_three_process_causal_chain(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    stop = str(tmp_path / "stop")
+    env = _env(trace_dir)
+    procs = []
+    try:
+        master = subprocess.Popen(
+            [sys.executable, "-c", _MASTER, stop], cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        procs.append(master)
+        master_port = _read_port(master, "master")
+        relay = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_tpu.agent.relay",
+             "--master_addr", f"localhost:{master_port}",
+             "--relay_id", "0", "--interval", "0.3"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        procs.append(relay)
+        relay_port = _read_port(relay, "relay")
+        worker = subprocess.run(
+            [sys.executable, "-c", _WORKER, f"localhost:{relay_port}"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=60,
+        )
+        assert worker.returncode == 0, worker.stderr[-2000:]
+        # the relay forwards on its own clock; wait for the master's
+        # handler span to land in the shared trace dir
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if "rpc.report_relay_batch" in _spans_by_name(trace_dir):
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("master handler span never appeared")
+    finally:
+        open(stop, "w").close()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    by_name = _spans_by_name(trace_dir)
+    report = by_name["report_node_status"][0]
+    forward = by_name["relay.forward"][0]
+    batch = by_name["rpc.report_relay_batch"][0]
+    # three DISTINCT real processes, one span file each
+    assert len({report["pid"], forward["pid"], batch["pid"]}) == 3
+    # the causal chain, by ids: one trace, parent -> child at each hop
+    assert report["trace"] == forward["trace"] == batch["trace"]
+    assert forward["parent"] == report["span"]
+    assert batch["parent"] == forward["span"]
+    # the worker's step stamp survives into its span record
+    assert report["step"] == 7
+
+    # and the operator view: dump --trace renders the merged chain
+    # with cross-process flow arrows for both hops
+    from dlrover_tpu.telemetry import dump
+
+    out = str(tmp_path / "chain.json")
+    assert dump.main([trace_dir, "--trace", "-o", out]) == 0
+    with open(out) as f:
+        trace = json.load(f)
+    flows = [e for e in trace["traceEvents"] if e["ph"] == "s"]
+    flow_pids = {e["pid"] for e in flows}
+    assert {report["pid"], forward["pid"]} <= flow_pids

@@ -154,7 +154,10 @@ def test_write_through_and_chrome_merge(tmp_path):
     xs = [e for e in evts if e["ph"] == "X"]
     assert len(xs) == 1
     assert xs[0]["name"] == "step"
-    assert xs[0]["args"] == {"k": "v", "step": 3}
+    args = xs[0]["args"]
+    assert args["k"] == "v" and args["step"] == 3
+    # root span: carries its trace/span ids but no parent edge
+    assert args["trace"] and args["span"] and "parent" not in args
     assert xs[0]["pid"] == os.getpid()
     # process/thread metadata present for the trace viewer
     metas = {e["name"] for e in evts if e["ph"] == "M"}
@@ -231,6 +234,99 @@ def test_dump_cli_trace_mode(tmp_path, capsys):
     assert "alpha" in capsys.readouterr().out
     # missing dir is a clean error, not a traceback
     assert dump.main([str(tmp_path / "nope"), "--trace"]) == 2
+
+
+FIXTURE_TRACE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "trace"
+)
+
+
+def _merged(tmp_path, capsys, *flags):
+    """Run dump --trace over the committed 2-process fixture with the
+    given filter flags; return (trace dict, stderr)."""
+    from dlrover_tpu.telemetry import dump
+
+    out = str(tmp_path / "t.json")
+    assert dump.main([FIXTURE_TRACE, "--trace", "-o", out, *flags]) == 0
+    err = capsys.readouterr().err
+    with open(out) as f:
+        return json.load(f), err
+
+
+def test_dump_trace_fixture_full_causal_chain(tmp_path, capsys):
+    """The committed fixture is a frozen 2-process causal chain
+    (worker report -> relay.forward -> rpc.report_relay_batch): the
+    merged trace carries both pids and the cross-process flow arrows."""
+    trace, err = _merged(tmp_path, capsys)
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {101, 202}
+    assert "10 spans from 2 process(es)" in err
+    by_name = {e["name"]: e for e in xs}
+    assert by_name["relay.forward"]["args"]["parent"] \
+        == by_name["report_node_status"]["args"]["span"]
+    assert by_name["rpc.report_relay_batch"]["args"]["parent"] \
+        == by_name["relay.forward"]["args"]["span"]
+    # one flow arrow per cross-pid parent/child hop
+    starts = [e for e in trace["traceEvents"] if e["ph"] == "s"]
+    finishes = [e for e in trace["traceEvents"] if e["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    assert starts[0]["pid"] == 101 and finishes[0]["pid"] == 202
+
+
+def test_dump_trace_step_filter(tmp_path, capsys):
+    """--step keeps the asked-for training steps and drops unstamped
+    setup spans (they are noise on a step-range query)."""
+    trace, err = _merged(tmp_path, capsys, "--step", "4..6")
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert sorted(e["args"]["step"] for e in xs) == [4, 5, 6]
+    assert all(e["name"] == "train_step" for e in xs)
+    assert "kept 3/10 spans" in err
+    # open-ended range + single-step form
+    trace, _ = _merged(tmp_path, capsys, "--step", "8..")
+    assert sorted(e["name"] for e in trace["traceEvents"]
+                  if e["ph"] == "X") == [
+        "report_node_status", "train_step",
+    ]
+    trace, _ = _merged(tmp_path, capsys, "--step", "3")
+    assert [e["args"]["step"] for e in trace["traceEvents"]
+            if e["ph"] == "X"] == [3]
+
+
+def test_dump_trace_proc_filter_recomputes_flows(tmp_path, capsys):
+    """--proc matches the elastic proc index OR the OS pid; flow
+    arrows are recomputed AFTER filtering so a dropped parent never
+    leaves a dangling arrow."""
+    # proc index 1 = the worker side only
+    trace, _ = _merged(tmp_path, capsys, "--proc", "1")
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {101}
+    assert not [e for e in trace["traceEvents"] if e["ph"] in "sf"]
+    # OS pid 202 = the relay/master side; its parents are filtered
+    # out, so again: spans survive, dangling flows do not
+    trace, _ = _merged(tmp_path, capsys, "--proc", "202")
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert sorted(e["name"] for e in xs) == [
+        "relay.forward", "rpc.report_relay_batch",
+    ]
+    assert not [e for e in trace["traceEvents"] if e["ph"] in "sf"]
+
+
+def test_dump_trace_since_filter_and_bad_value(tmp_path, capsys):
+    from dlrover_tpu.telemetry import dump
+
+    trace, err = _merged(tmp_path, capsys, "--since", "120.0")
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert sorted(e["name"] for e in xs) == [
+        "relay.forward", "report_node_status", "rpc.report_relay_batch",
+    ]
+    # both ends of each hop survive -> the flow arrows do too
+    assert len([e for e in trace["traceEvents"] if e["ph"] == "s"]) == 1
+    assert "kept 3/10 spans" in err
+    # a bad --since is a clean rc-2 error, not a traceback
+    assert dump.main(
+        [FIXTURE_TRACE, "--trace", "--since", "yesterdayish"]
+    ) == 2
+    assert "--since" in capsys.readouterr().err
 
 
 def test_torn_span_lines_skipped(tmp_path):

@@ -21,6 +21,7 @@ from tools.dlint.rules.locks import (
 from tools.dlint.rules.eventloop import NoBlockingInAsyncRule
 from tools.dlint.rules.reply import CommitBeforeReplyRule
 from tools.dlint.rules.knobs import KnobRegistryRule
+from tools.dlint.rules.metrics import MetricRegistryRule
 
 ALL_RULES = [
     EventNameRule,
@@ -35,6 +36,7 @@ ALL_RULES = [
     NoBlockingInAsyncRule,
     CommitBeforeReplyRule,
     KnobRegistryRule,
+    MetricRegistryRule,
 ]
 
 __all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

@@ -71,6 +71,7 @@ VOCABULARY = {
         "serve.request_redelivered",
         "serve.relinquished",
         "serve.autoscale",
+        "serve.autoscale_held",
         "serve.worker_ready",
         "serve.worker_exit",
         "serve.rpc_fallback",
@@ -118,6 +119,16 @@ VOCABULARY = {
         "relay.stopped",
         "relay.forward_failed",
         "relay.failover",
+    })),
+    # ISSUE 17: the fleet observability plane — SLO objective state
+    # machine (telemetry/fleet.py) and journal file rotation
+    # (telemetry/journal.py)
+    "slo": (("slo",), frozenset({
+        "slo.violated",
+        "slo.recovered",
+    })),
+    "journal_file": (("journal",), frozenset({
+        "journal.rotated",
     })),
     # ISSUE 15: the runtime lock-order watchdog
     # (telemetry/lockwatch.py) — cycle = potential deadlock in the
