@@ -276,8 +276,8 @@ def run_peer(dim, layers, workdir):
     stats = {}
     orig = r._restore_v2
 
-    def spy(step, target, local_file=None):
-        state, st = orig(step, target, local_file=local_file)
+    def spy(step, target, local_file=None, **kw):
+        state, st = orig(step, target, local_file=local_file, **kw)
         stats.update(st)
         return state, st
 

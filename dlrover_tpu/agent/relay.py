@@ -493,6 +493,156 @@ class AggregatorRelay:
         }
 
 
+class RelayTier:
+    """Launcher-side lifecycle of the relay tier (ISSUE 18).
+
+    ISSUE 16 built the relay; this owns its LIFE: size the tier as
+    ``ceil(agents / fanout)``, spawn one relay subprocess per slot,
+    monitor them, and restart a dead relay ON ITS ORIGINAL PORT — the
+    address handed to agents (``DLROVER_TPU_RELAY_ADDR``) stays valid
+    across the restart, so agents that failed over to the direct
+    master path drift back to the relay on their supervisor's next
+    probe without any re-pointing. Agents map to relays contiguously
+    (``rank // fanout``), wrapping for ranks grown past the
+    provisioned count.
+    """
+
+    def __init__(self, master_addr: str, n_agents: int,
+                 fanout: Optional[int] = None,
+                 check_interval: float = 1.0,
+                 spawn_timeout: float = 30.0):
+        self._master_addr = master_addr
+        self._n_agents = max(1, int(n_agents))
+        self._fanout = max(1, int(fanout) if fanout else relay_fanout())
+        #: tier size: every agent fronted, no relay over fanout
+        self.n_relays = -(-self._n_agents // self._fanout)
+        self._check_interval = max(0.05, float(check_interval))
+        self._spawn_timeout = float(spawn_timeout)
+        self._lock = threading.Lock()
+        self._procs: Dict[int, "subprocess.Popen"] = {}
+        self._ports: Dict[int, int] = {}
+        self.restarts = 0
+        self._stopped = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "RelayTier":
+        for rid in range(self.n_relays):
+            self._spawn(rid, port=0)
+        self._monitor = threading.Thread(
+            target=self._watch, name="relay-tier-monitor", daemon=True,
+        )
+        self._monitor.start()
+        record(
+            "relay.tier_started", relays=self.n_relays,
+            fanout=self._fanout, agents=self._n_agents,
+            ports=sorted(self.ports().values()),
+        )
+        return self
+
+    def stop(self, grace: float = 2.0) -> None:
+        self._stopped.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10.0)
+        with self._lock:
+            procs = list(self._procs.values())
+        for p in procs:
+            p.terminate()
+        deadline = time.monotonic() + grace
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except Exception:
+                p.kill()
+        record(
+            "relay.tier_stopped", relays=self.n_relays,
+            restarts=self.restarts,
+        )
+
+    # ------------------------------------------------------------ addressing
+
+    def addr_for(self, node_rank: int) -> str:
+        """The relay address for one agent — what the launcher exports
+        as ``DLROVER_TPU_RELAY_ADDR`` into the agent's env."""
+        rid = (int(node_rank) // self._fanout) % self.n_relays
+        with self._lock:
+            return f"localhost:{self._ports[rid]}"
+
+    def ports(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._ports)
+
+    # ------------------------------------------------------------ internals
+
+    def _spawn(self, rid: int, port: int) -> None:
+        import re
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.agent.relay",
+                "--master_addr", self._master_addr,
+                "--relay_id", str(rid), "--port", str(port),
+            ],
+            stdout=subprocess.PIPE, text=True,
+        )
+        got = None
+        deadline = time.monotonic() + self._spawn_timeout
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            m = re.match(r"PORT (\d+)", line or "")
+            if m:
+                got = int(m.group(1))
+                break
+            if proc.poll() is not None:
+                break
+        if got is None:
+            proc.kill()
+            raise RuntimeError(
+                f"relay {rid} did not report its port in "
+                f"{self._spawn_timeout}s"
+            )
+        with self._lock:
+            self._procs[rid] = proc
+            self._ports[rid] = got
+
+    def _watch(self) -> None:
+        """Restart dead relays on their original port. Agents ride
+        their supervisor's failover to the direct master while the
+        slot is down; the restart makes the advertised address serve
+        again."""
+        while not self._stopped.wait(self._check_interval):
+            with self._lock:
+                dead = [
+                    (rid, p, self._ports[rid])
+                    for rid, p in self._procs.items()
+                    if p.poll() is not None
+                ]
+            for rid, p, port in dead:
+                if self._stopped.is_set():
+                    return
+                logger.warning(
+                    "relay %d died rc=%s; restarting on port %d",
+                    rid, p.poll(), port,
+                )
+                try:
+                    self._spawn(rid, port=port)
+                except Exception as e:
+                    # the port can linger in TIME_WAIT right after a
+                    # crash: leave the slot dead and retry next tick
+                    logger.warning(
+                        "relay %d restart failed (%s); retrying", rid, e
+                    )
+                    continue
+                self.restarts += 1
+                record(
+                    "relay.restarted", relay_id=rid, port=port,
+                    exit_rc=p.poll(),
+                )
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="dlrover-tpu aggregator relay (ISSUE 16)"

@@ -369,6 +369,10 @@ class ShardingClient:
             raise ValueError(f"batch_size must be positive: {batch_size}")
         with self._lock:
             self._batch_size = batch_size
+            # the reconnect re-hello replays _dataset_params: a master
+            # that lost its journal would otherwise re-create the
+            # dataset under the PRE-resize geometry
+            self._dataset_params["batch_size"] = batch_size
 
     def report_batch_done(self, batch_size: Optional[int] = None) -> bool:
         """Accumulate batch completions; report the oldest pending task

@@ -298,7 +298,7 @@ class _Fetcher:
         self.sources = [s for s in sources if s is not None]
         self.cache: Dict[str, np.ndarray] = {}
         self.stats = {
-            "local": 0, "peer": 0, "store": 0,
+            "local": 0, "peer": 0, "store": 0, "live": 0,
             "digest_mismatch": 0, "bytes": 0,
         }
 
@@ -320,6 +320,16 @@ class _Fetcher:
             if raw is None:
                 tried.append(src.tier)
                 continue
+            if not isinstance(raw, (bytes, bytearray, memoryview)):
+                # an in-process source (the live tier) handed back the
+                # array itself: the bytes never left this trust domain
+                # and never round-tripped through npz, so there is
+                # nothing to decode or digest-verify — downstream
+                # device_put moves it device-to-device
+                self.stats[src.tier] = self.stats.get(src.tier, 0) + 1
+                self.stats["bytes"] += int(getattr(raw, "nbytes", 0))
+                self.cache[key] = raw
+                return raw
             if want is not None and (
                 hashlib.sha256(raw).hexdigest() != want
             ):

@@ -141,13 +141,18 @@ class DistributedJobMaster:
                 self.state_journal.save_kv if self.state_journal else None
             )
         )
+        # the round listener is single-slot, and two consumers want
+        # it: the state journal (crash recovery) and the transition
+        # coordinator (a completed TRAINING round seals the reshard
+        # membership so later unseen RUNNING ranks read as joins) —
+        # _on_rdzv_round fans out to whichever are configured
+        for name, mgr in self.rdzv_managers.items():
+            mgr.set_round_listener(
+                lambda r, _n=name: self._on_rdzv_round(_n, r)
+            )
         if self.state_journal is not None:
             self.task_manager.attach_state_journal(self.state_journal)
             for name, mgr in self.rdzv_managers.items():
-                mgr.set_round_listener(
-                    lambda r, _n=name:
-                        self.state_journal.save_rdzv_round(_n, r)
-                )
                 mgr.set_params_listener(
                     lambda p, _n=name:
                         self.state_journal.save_rdzv_params(_n, p)
@@ -526,6 +531,15 @@ class DistributedJobMaster:
             time.sleep(grace)
         except Exception as e:
             logger.warning("stop broadcast failed: %s", e)
+
+    def _on_rdzv_round(self, name, rdzv_round):
+        """Fan a completed rendezvous round out to its consumers (the
+        managers' round listener is single-slot)."""
+        if self.state_journal is not None:
+            self.state_journal.save_rdzv_round(name, rdzv_round)
+        if (self.transition_coordinator is not None
+                and name == RendezvousName.TRAINING):
+            self.transition_coordinator.seal_world()
 
     def _reshard_fallback(self, order):
         """An online transition aborted: hand the incident to the

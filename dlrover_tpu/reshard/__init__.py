@@ -27,9 +27,15 @@ from dlrover_tpu.reshard.coordinator import (  # noqa: F401
 from dlrover_tpu.reshard.order import (  # noqa: F401
     KIND_ABORT,
     KIND_GROW,
+    KIND_PROMOTE,
     KIND_SHRINK,
+    SPARE_KEY_PREFIX,
     TRANSITION_ORDER_KEY,
     TransitionOrder,
+)
+from dlrover_tpu.reshard.spare import (  # noqa: F401
+    HotSpare,
+    PrewarmedSource,
 )
 from dlrover_tpu.reshard.transition import MeshTransition  # noqa: F401
 
@@ -37,9 +43,13 @@ __all__ = [
     "TransitionCoordinator",
     "TransitionOrder",
     "MeshTransition",
+    "HotSpare",
+    "PrewarmedSource",
     "TRANSITION_ORDER_KEY",
+    "SPARE_KEY_PREFIX",
     "KIND_SHRINK",
     "KIND_GROW",
+    "KIND_PROMOTE",
     "KIND_ABORT",
     "reshard_enabled",
     "reshard_opted_in",

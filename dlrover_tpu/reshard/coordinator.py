@@ -34,7 +34,9 @@ from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.reshard.order import (
     KIND_ABORT,
     KIND_GROW,
+    KIND_PROMOTE,
     KIND_SHRINK,
+    SPARE_KEY_PREFIX,
     TRANSITION_ORDER_KEY,
     TransitionOrder,
 )
@@ -88,6 +90,10 @@ class TransitionCoordinator:
         self._lock = threading.RLock()
         self._seq = 0
         self._world: List[int] = []
+        #: True once the first training rendezvous round completed:
+        #: the initial membership is established, so a LATER unseen
+        #: RUNNING rank is a real node join, not bring-up stragglers
+        self._sealed = False
         self._active: Optional[TransitionOrder] = None
         self._active_since = 0.0
         self._acks: Dict[int, str] = {}
@@ -95,13 +101,73 @@ class TransitionCoordinator:
 
     # ------------------------------------------------------------ membership
 
-    def note_node_running(self, rank: int) -> None:
-        """A worker reported RUNNING: it is mesh-transition material."""
+    def note_node_running(self, rank: int) -> Optional[TransitionOrder]:
+        """A worker reported RUNNING: it is mesh-transition material.
+
+        Before the world is sealed (:meth:`seal_world`), RUNNING
+        reports are initial bring-up and only widen the membership.
+        After the seal, an unseen rank is a REAL join: cut a grow
+        order so the newcomer adopts at the step boundary and
+        receives its shard set live from peers (ISSUE 18).
+        Registered hot spares are deliberately NOT grown in — they
+        idle warm until a loss promotes them
+        (:meth:`note_node_lost`).
+        """
+        rank = int(rank)
         with self._lock:
-            rank = int(rank)
-            if rank not in self._world:
+            if rank in self._world:
+                return None
+            if rank in self._spare_ranks():
+                return None
+            if not self._sealed:
                 self._world.append(rank)
                 self._world.sort()
+                return None
+        return self.note_node_join(rank, reason="node_join")
+
+    def seal_world(self) -> None:
+        """The training rendezvous completed a round: the membership
+        is established. Called by the master on every completed round
+        (dist_master wires the rendezvous round listener here), so a
+        world unsealed by an abort re-seals as soon as the relaunched
+        fleet re-forms."""
+        with self._lock:
+            if not self._sealed and self._world:
+                self._sealed = True
+                logger.info(
+                    "reshard world sealed at %s: later unseen ranks "
+                    "are joins", self._world,
+                )
+
+    @property
+    def sealed(self) -> bool:
+        with self._lock:
+            return self._sealed
+
+    def _spare_ranks(self) -> List[int]:
+        """Ranks pre-registered as hot spares (KV scan — the spare
+        writes ``reshard/spare/<rank>`` before reporting RUNNING)."""
+        ranks = []
+        for key in self._kv.keys(SPARE_KEY_PREFIX):
+            try:
+                ranks.append(int(key[len(SPARE_KEY_PREFIX):]))
+            except ValueError:
+                continue
+        return sorted(ranks)
+
+    def _claim_spare_locked(self, lost_rank: int) -> Optional[int]:
+        """Take the lowest eligible registered spare off the bench
+        (deletes its registration so it cannot be claimed twice)."""
+        for spare in self._spare_ranks():
+            if spare == lost_rank or spare in self._world:
+                continue
+            try:
+                self._kv.delete(f"{SPARE_KEY_PREFIX}{spare}")
+            except Exception as e:
+                logger.warning("spare %d claim failed: %s", spare, e)
+                continue
+            return spare
+        return None
 
     @property
     def world(self) -> List[int]:
@@ -156,14 +222,33 @@ class TransitionCoordinator:
                 "reshard.detected", node_rank=rank, reason=reason,
                 old_world_size=len(self._world),
             )
+            spare = self._claim_spare_locked(rank)
             self._seq += 1
-            order = TransitionOrder(
-                id=self._seq, kind=KIND_SHRINK,
-                old_world_size=len(self._world),
-                world_size=len(survivors),
-                survivors=survivors, lost=[rank],
-                reason=reason,
-            )
+            if spare is not None:
+                # a warm spare stands in for the casualty: the world
+                # size holds, the spare takes the dead rank's shard
+                # set (it pre-warmed the step from peers), and no
+                # batch-size/sampler resize is needed
+                order = TransitionOrder(
+                    id=self._seq, kind=KIND_PROMOTE,
+                    old_world_size=len(self._world),
+                    world_size=len(survivors) + 1,
+                    survivors=sorted(survivors + [spare]),
+                    lost=[rank], joined=[spare],
+                    reason=reason,
+                )
+                record(
+                    "spare.promoted", order_id=self._seq,
+                    spare_rank=spare, lost_rank=rank,
+                )
+            else:
+                order = TransitionOrder(
+                    id=self._seq, kind=KIND_SHRINK,
+                    old_world_size=len(self._world),
+                    world_size=len(survivors),
+                    survivors=survivors, lost=[rank],
+                    reason=reason,
+                )
             self._open_locked(order)
         if self._goodput is not None:
             self._goodput.note_fault(cause="reshard", node_id=rank)
@@ -319,6 +404,11 @@ class TransitionCoordinator:
         self._world = [r for r in self._world if r not in order.lost]
         self._active = None
         self._acks = {}
+        # the fallback restarts the world: un-seal so the relaunched
+        # incarnations' RUNNING reports re-widen the membership
+        # instead of cutting spurious grow orders; the next completed
+        # rendezvous round re-seals
+        self._sealed = False
         # the attempt spends budget either way: a job that keeps
         # aborting degrades to always-restart instead of looping
         self._done += 1

@@ -1,12 +1,19 @@
 """State migration for mesh transitions.
 
-Two movers, one stats vocabulary:
+Three movers, one stats vocabulary:
 
 * :func:`reshard_arrays` — the pure in-process path for shards this
   rank already holds: ``jax.device_put`` each leaf into its new
   ``NamedSharding`` (the SNIPPETS.md pattern; Universal Checkpointing
   makes this legal because format-v2 state is layout-free). Counts as
   ``device`` moves.
+* :func:`migrate_live` — the archive-free hot path (ISSUE 18): every
+  shard of the NEW layout whose bytes still exist on a survivor is
+  served straight out of the live pytree (:class:`LiveShardSource`,
+  ``live`` moves — no host npz, no sha256 re-hash of data that never
+  left the process) and lands device-to-device via ``jax.device_put``;
+  only the domains nobody holds any more (the dead rank's rows) fall
+  through to the checkpoint tiers below.
 * :func:`migrate_from_checkpoint` — for shards this rank does NOT
   hold (the dead rank's rows, or rows the remap hands to a different
   survivor): assemble the last flash save through the PR 13 tiered
@@ -14,18 +21,18 @@ Two movers, one stats vocabulary:
   tier over ``/ckpt/shard`` (``peer``), the persistent store
   (``store``) — every shard digest-verified before it is trusted.
 
-Both return a stats dict with the shared keys
-``{"local","peer","store","device","digest_mismatch","bytes"}``;
+All return a stats dict with the shared keys
+``{"live","local","peer","store","device","digest_mismatch","bytes"}``;
 :meth:`MeshTransition.note_migrated` journals it and feeds the
 ``dlrover_reshard_shard_moves_total{source}`` counters.
 """
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.log import default_logger as logger
 
 #: the canonical per-source move-count keys
-MOVE_SOURCES = ("local", "peer", "store", "device")
+MOVE_SOURCES = ("live", "local", "peer", "store", "device")
 
 
 def empty_stats() -> Dict[str, int]:
@@ -71,10 +78,115 @@ def reshard_arrays(state: Any, shardings: Any) -> Tuple[Any, Dict]:
     return state, stats
 
 
+class LiveShardSource:
+    """The live pytree as a shard source for the v2 loader.
+
+    Flattens a survivor's CURRENT state into ``(path, index) ->
+    single-device jax array`` and serves those members to
+    :class:`~dlrover_tpu.checkpoint.loader._Fetcher` ahead of every
+    checkpoint tier. Served members stay jax arrays end to end: the
+    fetcher skips npy decode and sha256 (the bytes never left this
+    process), and the planner's ``jax.device_put`` moves them
+    device-to-device into the new layout.
+
+    ``held_fn(device)`` narrows what this source claims to hold — a
+    virtual-host world (forced CPU devices) addresses EVERY device
+    in-process, so drills/benches pass a predicate that excludes the
+    dead rank's devices to model which bytes really survived.
+
+    ``step`` pins the source to the step the live state was saved at;
+    the checkpointer's walk-down then skips it for any other
+    candidate instead of serving wrong-step bytes un-verified.
+    """
+
+    tier = "live"
+
+    def __init__(self, state: Any, step: Optional[int] = None,
+                 held_fn: Optional[Callable[[Any], bool]] = None):
+        import jax
+
+        from dlrover_tpu.checkpoint import manifest as mf
+        from dlrover_tpu.trainer import ckpt_store
+
+        self.step = step
+        self._members: Dict[Tuple[str, str], Any] = {}
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        for path, leaf in flat:
+            if not isinstance(leaf, jax.Array):
+                continue
+            pkey = mf.path_key(ckpt_store._path_components(path))
+            shape = leaf.shape
+            try:
+                shards = leaf.addressable_shards
+            except Exception:
+                continue
+            held = 0
+            for sh in shards:
+                if held_fn is not None and not held_fn(sh.device):
+                    continue
+                nidx = mf.normalize_index(sh.index, shape)
+                self._members[(pkey, mf.index_key(nidx))] = sh.data
+                held += 1
+                if sh.data.shape == tuple(shape):
+                    # a fully-replicated leaf is also addressable by
+                    # the whole-array key ("array"-kind fetches)
+                    self._members[(pkey, "full")] = sh.data
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def fetch(self, pkey: str, ikey: str, procs):
+        return self._members.get((pkey, ikey))
+
+
+def migrate_live(
+    checkpointer,
+    live_state: Any,
+    target: Any = None,
+    step: Optional[int] = None,
+    live_step: Optional[int] = None,
+    held_fn: Optional[Callable[[Any], bool]] = None,
+    extra_sources: Optional[List[Any]] = None,
+) -> Tuple[Any, Optional[int], Dict]:
+    """Archive-free migration: live redistribution first, checkpoint
+    tiers only for what no survivor holds.
+
+    ``live_state`` is this rank's current pytree (old layout);
+    ``live_step`` is the step it corresponds to — pass it, or the
+    source serves any candidate the restore walks down to.
+    ``extra_sources`` rank between the live tier and the checkpoint
+    tiers (a hot spare's pre-warmed cache). Returns
+    ``(state, restored_step, stats)`` like
+    :func:`migrate_from_checkpoint`; ``stats["live"]`` counts the
+    fast-path moves.
+    """
+    sources: List[Any] = []
+    if live_state is not None:
+        src = LiveShardSource(
+            live_state, step=live_step, held_fn=held_fn
+        )
+        if len(src):
+            sources.append(src)
+    sources.extend(extra_sources or [])
+    state, got = checkpointer.restore(
+        target=target, step=step, extra_sources=sources
+    )
+    stats = merge_stats(
+        getattr(checkpointer, "last_restore_stats", None)
+    )
+    if state is None:
+        logger.warning(
+            "live migration found no restorable step (requested %s)",
+            step,
+        )
+    return state, got, stats
+
+
 def migrate_from_checkpoint(
     checkpointer,
     target: Any = None,
     step: Optional[int] = None,
+    extra_sources: Optional[List[Any]] = None,
 ) -> Tuple[Any, Optional[int], Dict]:
     """Assemble this rank's NEW shard set from the last flash save.
 
@@ -86,7 +198,9 @@ def migrate_from_checkpoint(
     Returns ``(state, restored_step, stats)``; ``state`` is None when
     nothing was restorable (callers abort the transition).
     """
-    state, got = checkpointer.restore(target=target, step=step)
+    state, got = checkpointer.restore(
+        target=target, step=step, extra_sources=extra_sources
+    )
     stats = merge_stats(
         getattr(checkpointer, "last_restore_stats", None)
     )

@@ -22,12 +22,19 @@ from typing import List, Optional
 #: worker polls it on the step cadence (MeshTransition.poll_order)
 TRANSITION_ORDER_KEY = "reshard/transition_order"
 
-#: order kinds: a shrink drops ranks, a grow adds them, an abort
-#: cancels a still-open transition and hands the incident to the
-#: restart-the-world fallback
+#: order kinds: a shrink drops ranks, a grow adds them, a promote
+#: swaps a lost rank for a pre-warmed hot spare at constant world
+#: size, an abort cancels a still-open transition and hands the
+#: incident to the restart-the-world fallback
 KIND_SHRINK = "shrink"
 KIND_GROW = "grow"
+KIND_PROMOTE = "promote"
 KIND_ABORT = "abort"
+
+#: KV-store prefix hot spares register under (``reshard/spare/<rank>``)
+#: BEFORE reporting RUNNING, so the coordinator never grows them in —
+#: they idle warm until a node loss promotes one
+SPARE_KEY_PREFIX = "reshard/spare/"
 
 
 @dataclass

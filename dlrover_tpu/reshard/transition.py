@@ -148,6 +148,10 @@ class MeshTransition:
                 order.id, self._node_rank,
             )
             return
+        # the newest order defines membership: a latecomer can read a
+        # stale broadcast cut before it existed (which excluded it)
+        # and then be grown in by the next order
+        self._excluded = False
         self._pending = order
         self._adopted_at = time.time()
         # adopt under the order's carried trace context: cut ->
@@ -164,6 +168,54 @@ class MeshTransition:
                 new_index=new_index, world_size=order.world_size,
                 node_rank=self._node_rank,
             )
+
+    # ------------------------------------------------------------ agreement
+
+    def agree_step(self, order: TransitionOrder, compute_fn,
+                   poll: float = 0.2, timeout: float = 30.0) -> int:
+        """Pin the restore step for ``order`` across every survivor.
+
+        Survivors reach the step boundary at different times, and the
+        fastest ones resume saving (and committing) the moment their
+        migration lands — so "the newest committed step" is NOT a
+        stable answer; a slow rank reading it later can pick a step
+        that did not exist when the first rank chose, and the
+        migration aborts on the mismatch. Instead exactly ONE
+        survivor decides: the first to claim the order's agreement
+        key (an atomic KV counter) runs ``compute_fn`` and publishes
+        the result; everyone else reads the published value. Returns
+        the agreed step (negative = the decider found nothing to
+        restore)."""
+        if self._client is None:
+            return int(compute_fn())
+        key = f"reshard/agree/{order.id}/step"
+        try:
+            n = self._client.kv_store_add(f"{key}/claim", 1)
+        except Exception as e:
+            logger.warning("step-agreement claim failed (%s); "
+                           "deciding locally", e)
+            return int(compute_fn())
+        if n == 1:
+            value = int(compute_fn())
+            self._client.kv_store_set(key, str(value).encode())
+            record(
+                "reshard.step_pinned", order_id=order.id,
+                step=value, node_rank=self._node_rank,
+            )
+            return value
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                raw = self._client.kv_store_get(key)
+            except Exception:
+                raw = b""
+            if raw:
+                return int(raw)
+            time.sleep(poll)
+        raise TimeoutError(
+            f"no pinned restore step for order {order.id} "
+            f"within {timeout}s"
+        )
 
     # ------------------------------------------------------------ reporting
 
@@ -186,12 +238,16 @@ class MeshTransition:
                       stats: Optional[Dict[str, int]] = None,
                       duration_s: float = 0.0) -> Optional[str]:
         """State migration landed: journal the per-source move counts
-        (local archive / peer RAM / store / in-process device_put),
-        bump the move counters, and report the phase."""
+        (live redistribution / local archive / peer RAM / store /
+        in-process device_put), bump the move counters, and report
+        the phase."""
+        from dlrover_tpu.reshard.migrate import MOVE_SOURCES
+
         stats = stats or {}
         record(
             "reshard.migrated", order_id=order.id,
             node_rank=self._node_rank,
+            live=int(stats.get("live", 0)),
             local=int(stats.get("local", 0)),
             peer=int(stats.get("peer", 0)),
             store=int(stats.get("store", 0)),
@@ -201,7 +257,7 @@ class MeshTransition:
             duration_s=round(float(duration_s), 6),
         )
         moves = _moves_counter()
-        for source in ("local", "peer", "store", "device"):
+        for source in MOVE_SOURCES:
             n = int(stats.get(source, 0))
             if n > 0:
                 moves.labels(source=source).inc(n)

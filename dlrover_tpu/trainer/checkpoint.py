@@ -1187,7 +1187,8 @@ class FlashCheckpointer:
             )
             return None
 
-    def restore(self, target: Any = None, step: Optional[int] = None):
+    def restore(self, target: Any = None, step: Optional[int] = None,
+                extra_sources: Optional[List[Any]] = None):
         """Restore (state, step), preferring the RAM tier.
 
         ``target``: pytree of arrays with desired shardings (abstract or
@@ -1196,6 +1197,13 @@ class FlashCheckpointer:
         multi-process world, the outcome is AGREED across processes:
         either every process restores the consensus step or every
         process starts fresh — never a mix.
+
+        ``extra_sources``: shard sources consulted BEFORE every
+        checkpoint tier by the v2 planner (reshard/migrate.py's live
+        tier, a hot spare's pre-warmed cache). A source carrying a
+        ``step`` attribute is only consulted when the candidate step
+        matches it — a walk-down to an older step must never be
+        served another step's bytes.
         """
         self._drain_saves()
         # per-tier shard-move stats of the newest v2 assembly (consumed
@@ -1207,7 +1215,7 @@ class FlashCheckpointer:
             # no agreement collective on this path: let failures
             # SURFACE — downgrading a single-host restore error to a
             # fresh start would silently bury a recoverable checkpoint
-            return self._restore_once(target, step)
+            return self._restore_once(target, step, extra_sources)
         # Multi-process auto mode runs a FIXED collective sequence —
         # consensus allgather, then agreement allgather — on every
         # host, no matter what fails locally:
@@ -1223,7 +1231,8 @@ class FlashCheckpointer:
         state, got = None, None
         if step is not None:
             try:
-                state, got = self._restore_once(target, step)
+                state, got = self._restore_once(target, step,
+                                                extra_sources)
             except Exception as e:
                 logger.warning("restore attempt failed: %s", e)
                 state, got = None, None
@@ -1270,7 +1279,8 @@ class FlashCheckpointer:
         return steps
 
     def _restore_once(self, target: Any = None,
-                      step: Optional[int] = None):
+                      step: Optional[int] = None,
+                      extra_sources: Optional[List[Any]] = None):
         t0 = time.time()
         ram = dict(self._list_ram())
         auto_step = step is None
@@ -1318,7 +1328,7 @@ class FlashCheckpointer:
                         tainted = True
                     else:
                         state = self._restore_local_archive(
-                            f, man, step, target
+                            f, man, step, target, extra_sources
                         )
                         logger.info(
                             "Restored step %d from RAM tier", step
@@ -1382,7 +1392,9 @@ class FlashCheckpointer:
             # works across any topology change and with the store off
             # the critical path when peers still hold the step
             try:
-                state, stats = self._restore_v2(cand, target)
+                state, stats = self._restore_v2(
+                    cand, target, extra_sources=extra_sources
+                )
             except Exception as e:
                 state, stats = None, None
                 logger.info(
@@ -1457,7 +1469,8 @@ class FlashCheckpointer:
             return _restore_shards(snapshot, target), cand
         return None, None
 
-    def _restore_local_archive(self, f, man, step: int, target):
+    def _restore_local_archive(self, f, man, step: int, target,
+                               extra_sources=None):
         """RAM-tier restore dispatch on the archive's format. v1
         archives (and complete single-process v2 archives) go through
         the monolithic reader; a multi-process v2 archive holds only
@@ -1476,10 +1489,13 @@ class FlashCheckpointer:
         if version < 2 or (topo_n <= 1 and not man.get("subset")):
             snapshot, _ = ckpt_store.snapshot_from_file(f, target)
             return _restore_shards(snapshot, target)
-        state, _ = self._restore_v2(step, target, local_file=f)
+        state, _ = self._restore_v2(
+            step, target, local_file=f, extra_sources=extra_sources
+        )
         return state
 
-    def _restore_v2(self, step: int, target, local_file=None):
+    def _restore_v2(self, step: int, target, local_file=None,
+                    extra_sources=None):
         """Format-v2 catalog restore across the tier chain: build the
         widest catalog the surviving metadata allows (this host's
         archive manifest, peers' manifests, the store's merged step
@@ -1492,6 +1508,18 @@ class FlashCheckpointer:
 
         catalog = None
         sources: List[Any] = []
+        for src in extra_sources or []:
+            # the live/pre-warmed tiers outrank every checkpoint tier
+            # (their bytes never left the process trust domain), but a
+            # source that declares its step serves ONLY that step — a
+            # walk-down candidate older than the live state must be
+            # assembled from the checkpoint tiers instead
+            if src is None:
+                continue
+            src_step = getattr(src, "step", None)
+            if src_step is not None and int(src_step) != int(step):
+                continue
+            sources.append(src)
         if local_file is not None:
             man = ckpt_store.read_manifest(local_file)
             catalog = ckpt_loader.StepCatalog.from_archive_manifest(man)
@@ -1582,7 +1610,7 @@ class FlashCheckpointer:
             ),
             restore_processes=self._n_processes,
             local=stats.get("local", 0), peer=stats.get("peer", 0),
-            store=stats.get("store", 0),
+            store=stats.get("store", 0), live=stats.get("live", 0),
             digest_mismatch=stats.get("digest_mismatch", 0),
             bytes=stats.get("bytes", 0),
         )

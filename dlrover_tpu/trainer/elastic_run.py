@@ -20,6 +20,7 @@ from dlrover_tpu.agent.elastic.training import (
     launch_agent,
 )
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.relay import ENV_RELAY_ADDR, ENV_RELAY_FANOUT, RelayTier
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.grpc_utils import addr_connected
 from dlrover_tpu.common.log import default_logger as logger
@@ -59,6 +60,14 @@ def parse_args(argv=None):
                              "'off' disables")
     parser.add_argument("--master_addr", type=str,
                         default=os.getenv(NodeEnv.MASTER_ADDR, ""))
+    parser.add_argument("--relay_fanout", type=int,
+                        default=int(os.getenv(ENV_RELAY_FANOUT, "0") or 0),
+                        help="agents per aggregator relay; > 0 makes "
+                             "node-rank-0's launcher run a relay tier "
+                             "of ceil(max_nodes / fanout) local "
+                             "subprocesses and point agents' report "
+                             "lane at it (0 = no relay tier, direct "
+                             "fan-in)")
     parser.add_argument("entrypoint", type=str, help="training script/cmd")
     parser.add_argument("entry_args", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
@@ -143,7 +152,21 @@ def run(args) -> int:
     )
     if args.compile_cache_dir:
         config.env[NodeEnv.COMPILE_CACHE_DIR] = args.compile_cache_dir
+    relay_tier: Optional[RelayTier] = None
+    if args.relay_fanout > 0:
+        # hierarchical fan-in (ISSUE 16/18): the tier is sized to the
+        # job's MAX world so grown-in agents land on a provisioned
+        # relay; a dead relay is restarted on its original port, so
+        # the address exported here outlives relay crashes
+        relay_tier = RelayTier(
+            master_addr, n_agents=max_nodes, fanout=args.relay_fanout,
+        ).start()
+        atexit.register(relay_tier.stop)
+        config.env[ENV_RELAY_ADDR] = relay_tier.addr_for(args.node_rank)
     result = launch_agent(config, client)
+    if relay_tier is not None:
+        relay_tier.stop()
+        atexit.unregister(relay_tier.stop)
     if master_proc is not None:
         master_proc.terminate()
     if result.state == "succeeded":

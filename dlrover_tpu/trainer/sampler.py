@@ -41,7 +41,11 @@ class ElasticDistributedSampler:
         self._recompute_sizes()
 
     def _recompute_sizes(self):
-        remaining = self.dataset_size - self.completed_num
+        # padding advances completed_num past dataset_size at epoch
+        # end; a set_world after that must see an EMPTY remainder, not
+        # a negative one (negative num_samples breaks __len__ and the
+        # drop_last slice)
+        remaining = max(0, self.dataset_size - self.completed_num)
         if self.drop_last:
             self.num_samples = remaining // self.num_replicas
         else:
@@ -93,10 +97,14 @@ class ElasticDistributedSampler:
         self._recompute_sizes()
         indices = self._epoch_indices()[self.completed_num:]
         if not self.drop_last:
-            # pad to a replica multiple
+            # pad to a replica multiple, REPEATING the remainder when
+            # it is shorter than the pad (a grow past the remaining
+            # samples): a short pad would hand some ranks fewer
+            # indices than others and stall the lockstep collective
             pad = self.total_size - len(indices)
             if pad > 0 and indices:
-                indices += indices[:pad]
+                reps = -(-pad // len(indices))  # ceil
+                indices += (indices * reps)[:pad]
         else:
             indices = indices[: self.total_size]
         return indices[self.rank::self.num_replicas]
@@ -137,4 +145,11 @@ class ElasticDistributedSampler:
             self.num_replicas = num_replicas
         if rank is not None:
             self.rank = rank
+        if self.rank >= self.num_replicas or self.rank < 0:
+            # same guard as set_world: a stale rank silently yields a
+            # partition overlapping a live rank's (double consumption)
+            raise ValueError(
+                f"rank {self.rank} out of range for "
+                f"{self.num_replicas} replicas"
+            )
         self._recompute_sizes()

@@ -16,13 +16,26 @@ a shrink order.
 
 Survivors poll the order on the step cadence and execute it at the
 next step boundary WITHOUT process exit: re-form the rendezvous world,
-rebuild the mesh, re-target the checkpointer at the 3-host topology,
-and migrate state through the tiered v2 loader — own RAM (``local``),
-surviving peers over HTTP (``peer``), the store for the dead rank's
-pieces (``store``) — then re-arm the data plane and report
+rebuild the mesh, re-target the checkpointer at the new topology, and
+migrate state LIVE (``migrate_live``): every row a survivor still
+holds moves device-to-device straight out of the live pytree
+(``live``), and only the dead rank's rows fall back to the tiered v2
+loader — own RAM (``local``), surviving peers over HTTP (``peer``),
+the store (``store``) — then re-arm the data plane and report
 migrated/completed. ``MIGRATED`` lines carry the restored step plus a
 sha256 of the restored arrays so the test can prove every survivor
 landed on the SAME bit-identical state.
+
+Two latecomer modes share the adoption loop:
+
+* ``--join`` — a fresh worker on a sealed world: its RUNNING report
+  makes the master cut a GROW order; it idles until an order includes
+  it, then takes its place and assembles its shard set from the
+  checkpoint tiers.
+* ``--spare`` — same, but it registers under ``reshard/spare/<rank>``
+  BEFORE reporting RUNNING (so it is never grown in) and pre-warms
+  the newest advertised step from peers while idle; a node loss then
+  cuts a PROMOTE order and the spare restores out of its warm cache.
 
 ``DRILL_RESHARD_REFUSE=1`` makes this rank refuse the order instead
 (reports ``aborted``): the coordinator broadcasts the abort and every
@@ -53,6 +66,10 @@ def main() -> int:
     p.add_argument("--dataset_size", type=int, default=96)
     p.add_argument("--batch_size", type=int, default=4)
     p.add_argument("--shard_secs", type=float, default=0.05)
+    p.add_argument("--spare", action="store_true",
+                   help="register as a hot spare and idle warm")
+    p.add_argument("--join", action="store_true",
+                   help="late joiner: wait to be grown into the world")
     args = p.parse_args()
 
     from dlrover_tpu.common.log import set_process_index
@@ -67,8 +84,11 @@ def main() -> int:
     from dlrover_tpu.checkpoint import peer
     from dlrover_tpu.common.constants import NodeEnv, RendezvousName
     from dlrover_tpu.fault_tolerance.injection import FaultInjector
-    from dlrover_tpu.reshard import MeshTransition
-    from dlrover_tpu.reshard.migrate import migrate_from_checkpoint
+    from dlrover_tpu.reshard import HotSpare, MeshTransition
+    from dlrover_tpu.reshard.migrate import (
+        migrate_from_checkpoint,
+        migrate_live,
+    )
     from dlrover_tpu.telemetry import goodput, record
     from dlrover_tpu.telemetry.http import MetricsServer
     from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
@@ -110,6 +130,13 @@ def main() -> int:
     client = MasterClient(
         args.master_addr, node_id=args.node_id, node_type="worker",
     )
+    hs = None
+    if args.spare:
+        # registration MUST precede the first RUNNING report: the
+        # coordinator sees the spare key and neither widens the world
+        # nor cuts a grow order for this rank
+        hs = HotSpare(client, args.node_id)
+        hs.register()
     client.update_node_status("running", "", restart_count)
     injector = FaultInjector.from_env(role="worker")
     mt = MeshTransition.from_env(client)
@@ -169,41 +196,66 @@ def main() -> int:
                 raise TimeoutError(tag)
             time.sleep(0.2)
 
-    client.report_rdzv_params(
-        min_nodes=1, max_nodes=args.n_nodes, waiting_timeout=0.5,
-        node_unit=1,
-    )
-    rendezvous("ROUND")
+    def make_sharding():
+        # lookahead=0 / fetch_batch=1: the victim dies holding exactly
+        # its in-flight shard, which the coordinator's ledger rebalance
+        # requeues exactly-once
+        return ShardingClient(
+            dataset_name="reshard-drill",
+            batch_size=args.batch_size,
+            num_epochs=1,
+            dataset_size=args.dataset_size,
+            shuffle=False,
+            num_minibatches_per_shard=1,
+            master_client=client,
+            fetch_batch=1,
+            lookahead=0,
+        )
 
-    ckpt = build_ckpt(args.node_id, args.n_nodes)
-    srv = MetricsServer(port=0, shard_provider=ckpt.shard_provider())
-    srv.start()
-    # the registry built before the server knew its port: re-wire it
-    ckpt._peer_registry = peer.PeerRegistry(
-        client, args.node_id, f"http://127.0.0.1:{srv.port}"
-    )
-
-    # lookahead=0 / fetch_batch=1: the victim dies holding exactly its
-    # in-flight shard, which the coordinator's ledger rebalance
-    # requeues exactly-once
-    sharding = ShardingClient(
-        dataset_name="reshard-drill",
-        batch_size=args.batch_size,
-        num_epochs=1,
-        dataset_size=args.dataset_size,
-        shuffle=False,
-        num_minibatches_per_shard=1,
-        master_client=client,
-        fetch_batch=1,
-        lookahead=0,
-    )
-
+    sharding = None
     step = 0
-    cur = state_for(0)
+    cur = None
+
+    if not (args.spare or args.join):
+        # joins can grow the world past the provisioned count
+        client.report_rdzv_params(
+            min_nodes=1, max_nodes=args.n_nodes + 2,
+            waiting_timeout=0.5, node_unit=1,
+        )
+        rendezvous("ROUND")
+
+        ckpt = build_ckpt(args.node_id, args.n_nodes)
+        srv = MetricsServer(
+            port=0, shard_provider=ckpt.shard_provider()
+        )
+        srv.start()
+        # the registry built before the server knew its port: re-wire
+        ckpt._peer_registry = peer.PeerRegistry(
+            client, args.node_id, f"http://127.0.0.1:{srv.port}"
+        )
+        sharding = make_sharding()
+        cur = state_for(0)
+
+    def settled_steps(proc_index) -> list:
+        """Committed steps, read twice until stable: commits only
+        ever ADD, and the last pre-adoption uploads can still be
+        landing while workers compute their restore step — two
+        identical reads make every rank pick the SAME newest step."""
+        from dlrover_tpu.trainer import ckpt_store
+        store = ckpt_store.get_store(args.store_dir)
+        avail = ckpt_store.available_steps(store, proc_index)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            time.sleep(0.5)
+            again = ckpt_store.available_steps(store, proc_index)
+            if again == avail:
+                return avail
+            avail = again
+        return avail
 
     def execute_transition(order) -> bool:
         """The in-process mesh transition; False aborts into fallback."""
-        nonlocal ckpt, mesh, cur, step
+        nonlocal ckpt, srv, mesh, cur, step, sharding
         t0 = time.time()
         new_index = order.new_index(args.node_id)
         emit(f"ADOPT {order.id} {new_index} {order.world_size}")
@@ -218,28 +270,40 @@ def main() -> int:
         # 1. re-form the collective world among survivors
         rendezvous("REFORMED")
         mesh = Mesh(np.array(jax.devices()), ("dp",))
-        # 2. re-target the checkpointer at the new topology; the
-        # restore step is the newest store-COMMITted step — the only
-        # tier that can still serve the dead rank's rows (its RAM
-        # server died with it), and deterministic across survivors
-        # because a commit needs every OLD rank's upload, so none can
-        # land after the loss
-        registry = peer.PeerRegistry(
-            client, new_index, f"http://127.0.0.1:{srv.port}"
+        # 2. bump the save-attempt namespace to the order id (shared
+        # by every survivor): the new world's uploads can never
+        # collide with pre-transition partial uploads under the old
+        # topology — which would commit a TORN step the moment the
+        # new world filled in the dead rank's missing keys
+        os.environ[NodeEnv.RDZV_ROUND] = str(order.id)
+        # 3. re-target the checkpointer; the restore step is the
+        # newest store-COMMITted step — the only tier that can still
+        # serve a dead rank's rows (its RAM server died with it).
+        # Exactly ONE survivor decides which (fast ranks resume
+        # committing while slow ranks are still here, so a local read
+        # is not stable); the rest read the pinned value
+        target_step = mt.agree_step(
+            order,
+            lambda: max(settled_steps(new_index), default=-1),
         )
-        from dlrover_tpu.trainer import ckpt_store
-        avail = ckpt_store.available_steps(
-            ckpt_store.get_store(args.store_dir), new_index
-        )
-        if not avail:
+        if target_step < 0:
             mt.abort(order, "no committed step to migrate from")
             return False
-        target_step = max(avail)
         old = ckpt
         ckpt = build_ckpt(new_index, order.world_size)
-        ckpt._peer_registry = registry
-        old.close()
-        # 3. migrate state through the tiered v2 loader
+        if srv is None:
+            # a latecomer starts serving its RAM tier at adoption
+            srv = MetricsServer(
+                port=0, shard_provider=ckpt.shard_provider()
+            )
+            srv.start()
+        ckpt._peer_registry = peer.PeerRegistry(
+            client, new_index, f"http://127.0.0.1:{srv.port}"
+        )
+        if old is not None:
+            old.close()
+        # 4. migrate state: live redistribution for everything a
+        # survivor still holds, checkpoint tiers for the rest
         target = {
             "w": jax.device_put(
                 np.zeros((8, 4), np.float32),
@@ -247,9 +311,29 @@ def main() -> int:
             ),
             "step": 0,
         }
-        state, got, stats = migrate_from_checkpoint(
-            ckpt, target=target, step=target_step
-        )
+        if cur is not None:
+            # a survivor: its rows at the migration step move straight
+            # device-to-device out of the live arrays. The drill's
+            # synthetic state is regenerated per step, so "the live
+            # arrays at the step boundary" are rebuilt here; held_fn
+            # excludes the dead ranks' devices — those bytes did NOT
+            # survive and must come from the checkpoint tiers
+            dead = set(order.lost)
+            po = proc_of_device(order.old_world_size)
+            live = state_for(target_step)
+            state, got, stats = migrate_live(
+                ckpt, live, target=target, step=target_step,
+                live_step=target_step,
+                held_fn=lambda d: po(d) not in dead,
+            )
+        else:
+            # a latecomer holds nothing live; a spare restores out of
+            # its pre-warmed RAM cache, a plain joiner from the tiers
+            extra = [hs.source()] if hs is not None else None
+            state, got, stats = migrate_from_checkpoint(
+                ckpt, target=target, step=target_step,
+                extra_sources=extra,
+            )
         if state is None or got != target_step:
             mt.abort(order, f"migration found {got}, "
                             f"wanted {target_step}")
@@ -263,16 +347,73 @@ def main() -> int:
             return False
         emit(f"MIGRATED {got} {digest_of(state)} "
              f"{'ok' if ok else 'STATE_MISMATCH'} "
+             f"live={stats.get('live', 0)} "
              f"local={stats.get('local', 0)} peer={stats.get('peer', 0)} "
              f"store={stats.get('store', 0)} "
              f"mismatch={stats.get('digest_mismatch', 0)}")
-        # 4. re-arm the data plane under the new geometry (record-based
+        # 5. re-arm the data plane under the new geometry (record-based
         # completion accounting keeps the in-flight shard exactly-once)
-        sharding.resize(args.batch_size)
+        if sharding is None:
+            sharding = make_sharding()
+        else:
+            sharding.resize(args.batch_size)
         if mt.complete(order) != "ok":
             return False
         emit(f"TRANSITION {order.id} {dur * 1000:.1f}")
         return True
+
+    if args.spare or args.join:
+        # the latecomer adoption loop: idle (warming, for a spare)
+        # until a broadcast order includes this rank, then take the
+        # assigned place and fall through to the consume loop
+        emit("SPARE" if args.spare else "JOINER")
+        registry = peer.PeerRegistry(client, args.node_id, "")
+        from dlrover_tpu.trainer import ckpt_store
+        spare_store = (
+            ckpt_store.get_store(args.store_dir) if args.spare else None
+        )
+        last_warm = None
+        last_report = time.monotonic()
+        deadline = time.monotonic() + 300
+        while True:
+            mt.poll_order()
+            if mt.fallback:
+                emit("FALLBACK")
+                led.close()
+                return FALLBACK_RC
+            order = mt.pop_pending()
+            if order is not None:
+                emit(f"{'PROMOTED' if args.spare else 'GROWN'} "
+                     f"{order.id}")
+                if execute_transition(order):
+                    break
+                continue
+            if hs is not None:
+                # warm only store-COMMITted steps: a promotion
+                # restores the newest committed step, and survivors'
+                # RAM frontier runs ahead of the store the moment a
+                # death freezes commits (a commit needs every old
+                # rank's upload)
+                committed = set(
+                    ckpt_store.available_steps(spare_store, 0)
+                )
+                warmed = hs.prewarm(
+                    registry,
+                    steps=[s for s in registry.advertised_steps()
+                           if s in committed],
+                )
+                if warmed is not None and warmed != last_warm:
+                    last_warm = warmed
+                    emit(f"WARM {warmed}")
+            if args.join and time.monotonic() - last_report > 1.0:
+                # a join is only cut while no transition is open:
+                # keep re-reporting RUNNING until an order lands
+                client.update_node_status("running", "", restart_count)
+                last_report = time.monotonic()
+            if time.monotonic() > deadline:
+                emit("ERROR latecomer never adopted")
+                return 3
+            time.sleep(0.2)
 
     while True:
         mt.poll_order()

@@ -106,6 +106,9 @@ DEFAULT_MODULE_BUDGET_S = 60.0
 MODULE_BUDGET_OVERRIDES = {
     "test_four_node_drill": 240.0,
     "test_goodput_drill": 180.0,
+    # four real-agent-subprocess drills (chaos, fallback, spare
+    # promotion, join/shrink/join oscillation) — measured 113s
+    "test_reshard_drill": 180.0,
     "test_serving_drill": 120.0,
     "test_preemption_drill": 120.0,
     "test_sentinel_drill": 120.0,

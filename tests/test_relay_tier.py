@@ -1,0 +1,77 @@
+"""RelayTier lifecycle (ISSUE 18 satellite): sizing, agent->relay
+addressing, and the killed-relay drill — a SIGKILLed relay subprocess
+comes back on its ORIGINAL port, so the ``DLROVER_TPU_RELAY_ADDR``
+the launcher exported before the crash keeps serving."""
+
+import os
+import signal
+import time
+
+from dlrover_tpu.agent.relay import RelayTier
+from dlrover_tpu.common.grpc_utils import addr_connected
+
+
+def test_relay_tier_sizing_and_addressing():
+    tier = RelayTier("localhost:1", n_agents=5, fanout=2)
+    # ceil(5 / 2) = 3 relays, none over fanout
+    assert tier.n_relays == 3
+    tier = RelayTier("localhost:1", n_agents=512, fanout=256)
+    assert tier.n_relays == 2
+    tier = RelayTier("localhost:1", n_agents=513, fanout=256)
+    assert tier.n_relays == 3
+    # one agent still gets a (single-relay) tier
+    tier = RelayTier("localhost:1", n_agents=1, fanout=256)
+    assert tier.n_relays == 1
+
+
+def test_relay_tier_restarts_killed_relay(tmp_path):
+    """Kill one relay of a live tier: the monitor respawns it on the
+    same port (new pid), the advertised address serves again, and the
+    surviving relays were never touched."""
+    # the master is unreachable on purpose — relays only need it for
+    # upstream forwards, which don't happen without agent reports
+    tier = RelayTier(
+        "localhost:1", n_agents=5, fanout=2, check_interval=0.2,
+    ).start()
+    try:
+        assert tier.n_relays == 3
+        ports = tier.ports()
+        assert sorted(ports) == [0, 1, 2]
+        # contiguous rank // fanout mapping...
+        assert tier.addr_for(0) == f"localhost:{ports[0]}"
+        assert tier.addr_for(1) == f"localhost:{ports[0]}"
+        assert tier.addr_for(2) == f"localhost:{ports[1]}"
+        assert tier.addr_for(4) == f"localhost:{ports[2]}"
+        # ...and ranks grown past the provisioned count wrap
+        assert tier.addr_for(6) == f"localhost:{ports[0]}"
+        for rid in range(3):
+            assert addr_connected(f"localhost:{ports[rid]}", timeout=10)
+
+        victim_pid = tier._procs[1].pid
+        other_pids = {rid: tier._procs[rid].pid for rid in (0, 2)}
+        os.kill(victim_pid, signal.SIGKILL)
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with tier._lock:
+                p = tier._procs[1]
+                respawned = p.pid != victim_pid and p.poll() is None
+            if respawned:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("relay 1 was not respawned in 60s")
+
+        assert tier.restarts >= 1
+        # SAME port: the address agents hold stays valid
+        assert tier.ports()[1] == ports[1]
+        assert addr_connected(tier.addr_for(2), timeout=10)
+        # survivors undisturbed
+        for rid, pid in other_pids.items():
+            assert tier._procs[rid].pid == pid
+            assert tier._procs[rid].poll() is None
+    finally:
+        tier.stop()
+    # tier.stop() reaps everything
+    for p in tier._procs.values():
+        assert p.poll() is not None

@@ -16,7 +16,9 @@ from dlrover_tpu.common.comm import ReshardResponse
 from dlrover_tpu.reshard import (
     KIND_ABORT,
     KIND_GROW,
+    KIND_PROMOTE,
     KIND_SHRINK,
+    SPARE_KEY_PREFIX,
     TRANSITION_ORDER_KEY,
     MeshTransition,
     TransitionCoordinator,
@@ -53,6 +55,17 @@ class FakeKV:
 
     def get(self, key):
         return self.data.get(key, b"")
+
+    def keys(self, prefix=""):
+        return sorted(k for k in self.data if k.startswith(prefix))
+
+    def delete(self, key):
+        self.data.pop(key, None)
+
+    def add(self, key, amount=1):
+        cur = int(self.data.get(key, b"0") or b"0") + int(amount)
+        self.data[key] = str(cur).encode()
+        return cur
 
 
 class FakeTaskManager:
@@ -294,6 +307,87 @@ class TestTransitionCoordinator:
         coord.note_node_lost(2)
         assert coord.note_node_join(5) is None
 
+    def test_running_widens_until_sealed_then_grows(self):
+        coord = _coordinator()
+        # bring-up: RUNNING reports only widen the membership
+        for r in range(3):
+            assert coord.note_node_running(r) is None
+        assert coord.world == [0, 1, 2] and not coord.sealed
+        coord.seal_world()
+        assert coord.sealed
+        # post-seal an unseen RUNNING rank IS a node join
+        order = coord.note_node_running(3)
+        assert order is not None and order.kind == KIND_GROW
+        assert order.joined == [3] and order.survivors == [0, 1, 2, 3]
+        # a known member re-reporting never re-cuts
+        for r in coord.world:
+            assert coord.note_node_running(r) is None
+
+    def test_seal_is_a_noop_on_an_empty_world(self):
+        coord = _coordinator()
+        coord.seal_world()
+        assert not coord.sealed
+        assert coord.note_node_running(0) is None
+        assert coord.world == [0]
+
+    def test_abort_unseals_for_the_relaunch(self):
+        coord = _coordinator()
+        for r in range(3):
+            coord.note_node_running(r)
+        coord.seal_world()
+        coord.note_node_lost(2)
+        coord.abort("drill")
+        # the fallback restarts the world: fresh incarnations'
+        # RUNNING reports must widen, not cut grow orders
+        assert not coord.sealed
+        assert coord.note_node_running(2) is None
+        assert coord.world == [0, 1, 2]
+
+    def test_spare_is_not_grown_in(self):
+        kv = FakeKV()
+        coord = _coordinator(kv)
+        for r in range(3):
+            coord.note_node_running(r)
+        coord.seal_world()
+        kv.set(f"{SPARE_KEY_PREFIX}7", b"{}")
+        # the spare's RUNNING report neither widens nor cuts a grow
+        assert coord.note_node_running(7) is None
+        assert coord.world == [0, 1, 2]
+
+    def test_loss_promotes_a_registered_spare(self, _fresh_journal):
+        kv = FakeKV()
+        coord = _coordinator(kv)
+        for r in range(3):
+            coord.note_node_running(r)
+        coord.seal_world()
+        kv.set(f"{SPARE_KEY_PREFIX}7", b"{}")
+        coord.note_node_running(7)
+        order = coord.note_node_lost(1, reason="heartbeat")
+        assert order.kind == KIND_PROMOTE
+        assert order.survivors == [0, 2, 7]
+        assert order.lost == [1] and order.joined == [7]
+        # constant world size: the spare stands in for the casualty
+        assert order.world_size == order.old_world_size == 3
+        # the claim is exactly-once: the registration is consumed
+        assert kv.keys(SPARE_KEY_PREFIX) == []
+        assert len(_fresh_journal.events("spare.promoted")) == 1
+        for r in order.survivors:
+            coord.note_worker_phase(r, order.id, "completed")
+        assert coord.world == [0, 2, 7]
+        # a second loss has no spare left: plain shrink
+        order2 = coord.note_node_lost(2)
+        assert order2.kind == KIND_SHRINK
+
+    def test_lost_rank_cannot_be_its_own_spare(self):
+        kv = FakeKV()
+        coord = _coordinator(kv)
+        for r in range(3):
+            coord.note_node_running(r)
+        coord.seal_world()
+        kv.set(f"{SPARE_KEY_PREFIX}1", b"{}")
+        order = coord.note_node_lost(1)
+        assert order.kind == KIND_SHRINK
+
 
 # ------------------------------------------------------------ worker executor
 
@@ -306,6 +400,12 @@ class FakeMasterClient:
 
     def kv_store_get(self, key):
         return self.kv.get(key)
+
+    def kv_store_set(self, key, value):
+        self.kv.set(key, value)
+
+    def kv_store_add(self, key, amount=1):
+        return self.kv.add(key, amount)
 
     def report_reshard(self, order_id, phase, detail=""):
         self.reports.append((order_id, phase))
@@ -414,6 +514,57 @@ class TestMeshTransition:
         mt = MeshTransition(None, node_rank=0)
         assert mt.poll_order() is None
         assert mt.report_phase(_shrink(), "completed") is None
+        # masterless agreement degrades to a local decision
+        assert mt.agree_step(_shrink(), lambda: 7) == 7
+
+    def test_latecomer_excluded_by_stale_cut_is_regrown(self):
+        """A joiner can read the PREVIOUS order off the KV store (cut
+        before it existed, excluding it) and then be grown in by the
+        next order: the newest order defines membership."""
+        client = FakeMasterClient()
+        client.kv.set(TRANSITION_ORDER_KEY, _shrink().to_json())
+        mt = MeshTransition(client, node_rank=1)  # shed by that cut
+        assert mt.poll_order() is None and mt.excluded
+        client.kv.set(TRANSITION_ORDER_KEY, TransitionOrder(
+            id=2, kind=KIND_GROW, old_world_size=2, world_size=3,
+            survivors=[0, 1, 2], joined=[1],
+        ).to_json())
+        order = mt.poll_order()
+        assert order is not None and order.id == 2
+        assert not mt.excluded
+
+    def test_agree_step_first_claimer_decides(self, _fresh_journal):
+        """Exactly ONE survivor runs compute_fn; the rest read the
+        pinned value even when their own (later) answer would differ."""
+        kv = FakeKV()
+        ma = MeshTransition(FakeMasterClient(kv), node_rank=0)
+        mb = MeshTransition(FakeMasterClient(kv), node_rank=2)
+        order = _shrink()
+        calls = []
+        assert ma.agree_step(order, lambda: calls.append("a") or 6) == 6
+        # b reaches the boundary later, when a newer step committed —
+        # without agreement it would pick 7 and the worlds diverge
+        assert mb.agree_step(order, lambda: calls.append("b") or 7) == 6
+        assert calls == ["a"]
+        (evt,) = _fresh_journal.events("reshard.step_pinned")
+        assert evt["data"]["step"] == 6
+        assert evt["data"]["order_id"] == order.id
+        assert evt["data"]["node_rank"] == 0
+
+    def test_agree_step_claim_failure_decides_locally(self):
+        client = FakeMasterClient()
+        client.kv_store_add = lambda *a, **kw: (_ for _ in ()).throw(
+            RuntimeError("kv down")
+        )
+        mt = MeshTransition(client, node_rank=0)
+        assert mt.agree_step(_shrink(), lambda: 5) == 5
+
+    def test_agree_step_reader_times_out_without_a_decider(self):
+        kv = FakeKV()
+        kv.add("reshard/agree/1/step/claim", 1)  # claimed, never pinned
+        mt = MeshTransition(FakeMasterClient(kv), node_rank=0)
+        with pytest.raises(TimeoutError):
+            mt.agree_step(_shrink(), lambda: 5, poll=0.02, timeout=0.2)
 
 
 # ---------------------------------------------------------------- migration
@@ -423,8 +574,8 @@ class TestMigrate:
     def test_stats_vocabulary(self):
         stats = empty_stats()
         assert set(stats) == {
-            "local", "peer", "store", "device", "digest_mismatch",
-            "bytes",
+            "live", "local", "peer", "store", "device",
+            "digest_mismatch", "bytes",
         }
         merged = merge_stats({"peer": 1}, {"peer": 2, "bytes": 8}, None)
         assert merged["peer"] == 3 and merged["bytes"] == 8
@@ -450,7 +601,8 @@ class TestMigrate:
         class FakeCheckpointer:
             last_restore_stats = {"peer": 3, "store": 1, "bytes": 4096}
 
-            def restore(self, target=None, step=None):
+            def restore(self, target=None, step=None,
+                        extra_sources=None):
                 return {"w": [1, 2]}, 40
 
         state, step, stats = migrate_from_checkpoint(FakeCheckpointer())
@@ -459,9 +611,118 @@ class TestMigrate:
 
     def test_migrate_from_checkpoint_nothing_restorable(self):
         class EmptyCheckpointer:
-            def restore(self, target=None, step=None):
+            def restore(self, target=None, step=None,
+                        extra_sources=None):
                 return None, None
 
         state, step, stats = migrate_from_checkpoint(EmptyCheckpointer())
         assert state is None and step is None
         assert stats == empty_stats()
+
+
+# ------------------------------------------------------------ live migration
+
+
+def _saved_world(tmp_path, step=7):
+    """Four virtual hosts (2 devices each) flash-save one dp-sharded
+    array; returns (state, mesh, sharding)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs).reshape(4, 2), ("dp", "tp"))
+    sharding = NamedSharding(mesh, P("dp", None))
+    state = {
+        "w": jax.device_put(
+            np.arange(32, dtype=np.float32).reshape(8, 4), sharding
+        ),
+        "step": step,
+    }
+    # every rank saves before anyone waits: the store COMMIT is a
+    # consensus over all four process files
+    ckpts = [
+        FlashCheckpointer(
+            persist_dir=str(tmp_path / "store"),
+            ram_dir=str(tmp_path / f"ram{p}"),
+            persist_interval=1, use_orbax=False,
+            process_index=p, n_processes=4,
+            proc_of_device=lambda d: d.id // 2,
+            commit_timeout=60,
+        )
+        for p in range(4)
+    ]
+    for c in ckpts:
+        c.save(step, state, force_persist=True)
+    for c in ckpts:
+        c.wait()
+        c.close()
+    return state, mesh, sharding
+
+
+class TestLiveMigration:
+    DEAD = 2  # old proc whose devices (4, 5) did not survive
+
+    def _survivor_ckpt(self, tmp_path):
+        from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+        # the post-transition identity: one logical process over the
+        # whole (shrunken, here emulated as full) device set, fresh
+        # RAM dir — only the store holds the dead rank's rows
+        return FlashCheckpointer(
+            persist_dir=str(tmp_path / "store"),
+            ram_dir=str(tmp_path / "ram-new"),
+            persist_interval=0, use_orbax=False,
+            process_index=0, n_processes=1,
+        )
+
+    def _target(self, mesh):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return {
+            "w": jax.device_put(
+                np.zeros((8, 4), np.float32),
+                NamedSharding(mesh, P(None, "tp")),
+            ),
+            "step": -1,
+        }
+
+    def test_survivor_shards_move_live(self, tmp_path):
+        from dlrover_tpu.reshard.migrate import migrate_live
+
+        state, mesh, _ = _saved_world(tmp_path, step=7)
+        r = self._survivor_ckpt(tmp_path)
+        got, step, stats = migrate_live(
+            r, state, target=self._target(mesh), step=7, live_step=7,
+            held_fn=lambda d: d.id // 2 != self.DEAD,
+        )
+        r.close()
+        assert step == 7
+        np.testing.assert_array_equal(
+            np.asarray(got["w"]), np.asarray(state["w"])
+        )
+        assert got["step"] == 7
+        # survivors' rows moved device-to-device, no npz round-trip;
+        # only the dead rank's rows needed a checkpoint tier
+        assert stats["live"] >= 1
+        assert stats["local"] + stats["peer"] + stats["store"] >= 1
+        assert stats["digest_mismatch"] == 0
+
+    def test_stale_live_state_is_skipped(self, tmp_path):
+        from dlrover_tpu.reshard.migrate import migrate_live
+
+        state, mesh, _ = _saved_world(tmp_path, step=7)
+        r = self._survivor_ckpt(tmp_path)
+        # the live pytree is one step AHEAD of the restore candidate:
+        # serving it would mix steps — the pinned source steps aside
+        got, step, stats = migrate_live(
+            r, state, target=self._target(mesh), step=7, live_step=8,
+            held_fn=lambda d: d.id // 2 != self.DEAD,
+        )
+        r.close()
+        assert step == 7 and stats["live"] == 0
+        np.testing.assert_array_equal(
+            np.asarray(got["w"]), np.asarray(state["w"])
+        )

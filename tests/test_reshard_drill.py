@@ -19,9 +19,10 @@ rebalanced once, adopted/migrated per survivor, completed once, no
 abort, no ``scale.restart``); every survivor restored the SAME step
 with the SAME digest, bit-identical to the expected state; the shard
 ledger stays exactly-once across the resize (the victim's in-flight
-shard included); the migration pulled from all three tiers; and the
-goodput account books the outage under the ``reshard`` phase with a
-recovered fault window.
+shard included); every row a survivor still held moved LIVE
+(device-to-device, no re-hash) while the dead rank's rows came from
+the store; and the goodput account books the outage under the
+``reshard`` phase with a recovered fault window.
 
 The fallback drill flips one survivor to refuse the order
 (``DRILL_RESHARD_REFUSE=1``): the coordinator aborts, every survivor
@@ -29,6 +30,17 @@ exits into the restart-the-world path (rc 7), the master re-enables
 relaunch for the lost rank, and relaunched fresh incarnations drain
 the dataset — still exactly-once — with ``reshard.aborted`` (and no
 ``reshard.completed``) on the record.
+
+The promotion drill adds a 5th process registered as a hot spare
+(``--spare``): it pre-warms the committed frontier from peers while
+idle, and the same node loss now cuts a PROMOTE order — constant
+world size, the spare taking the casualty's place out of its warm
+cache, inside ONE step boundary and with zero process restarts.
+
+The oscillation drill runs join -> shrink -> join on one master:
+order ids stay strictly monotonic, a latecomer that reads a stale
+broadcast from before its time ignores it, and the dataset stays
+exactly-once across all three transitions.
 """
 
 import os
@@ -85,19 +97,22 @@ def _spawn_master(tmp, env, state_dir, port, tag):
     )
 
 
-def _spawn_worker(tmp, env, port, node_id, tag, store_dir, ram_dir):
+def _spawn_worker(tmp, env, port, node_id, tag, store_dir, ram_dir,
+                  extra_args=(), n_nodes=N_NODES,
+                  dataset_size=DATASET_SIZE):
     return subprocess.Popen(
         [sys.executable,
          os.path.join(REPO, "tests", "_reshard_drill_worker.py"),
          "--master_addr", f"localhost:{port}",
          "--node_id", str(node_id),
-         "--n_nodes", str(N_NODES),
+         "--n_nodes", str(n_nodes),
          "--out", os.path.join(tmp, f"worker-{tag}.txt"),
          "--store_dir", store_dir,
          "--ram_dir", ram_dir,
-         "--dataset_size", str(DATASET_SIZE),
+         "--dataset_size", str(dataset_size),
          "--batch_size", str(BATCH_SIZE),
-         "--shard_secs", str(SHARD_SECS)],
+         "--shard_secs", str(SHARD_SECS),
+         *extra_args],
         cwd=REPO, env=env,
         stdout=open(os.path.join(tmp, f"worker-{tag}.out"), "w"),
         stderr=subprocess.STDOUT,
@@ -127,16 +142,36 @@ def _lines(tmp, tag, key):
     ]
 
 
-def _assert_exactly_once(tmp, tags):
+def _assert_exactly_once(tmp, tags, size=DATASET_SIZE):
     ranges = []
     for tag in tags:
         for parts in _lines(tmp, tag, "SHARD"):
             ranges.append((int(parts[1]), int(parts[2])))
     ranges.sort()
     assert ranges, "no shards consumed at all"
-    assert ranges[0][0] == 0 and ranges[-1][1] == DATASET_SIZE, ranges
+    assert ranges[0][0] == 0 and ranges[-1][1] == size, ranges
     for (_, end), (start, _) in zip(ranges, ranges[1:]):
         assert end == start, f"shard gap/overlap at {start}: {ranges}"
+
+
+def _await(check, what, timeout, procs, tmp, logs):
+    """Poll ``check`` until truthy; fail loudly (with log tails and a
+    liveness sweep) if the drill phase never materialises."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if check():
+            return
+        for name, p in procs.items():
+            rc = p.poll()
+            assert rc is None or rc == 0 or name == "dead", (
+                f"{name} died rc={rc} while waiting for {what}; "
+                + "".join(_tail(tmp, f) for f in logs)
+            )
+        time.sleep(0.25)
+    raise AssertionError(
+        f"timed out waiting for {what}; "
+        + "".join(_tail(tmp, f) for f in logs)
+    )
 
 
 def test_reshard_chaos_drill(tmp_path):
@@ -225,16 +260,18 @@ def test_reshard_chaos_drill(tmp_path):
     assert {e["data"]["node_rank"] for e in migrated} == set(survivors)
     for e in migrated:
         assert e["data"]["digest_mismatch"] == 0, e
-    # the migration exercised every tier: shards this host kept
-    # (local), shards fetched from surviving peers' RAM over HTTP
-    # (peer), and the dead rank's shards from the store (store)
+        assert e["data"]["live"] >= 1, e
+    # live redistribution: every row a survivor still holds moves
+    # device-to-device out of the live pytree (no npz, no re-hash) —
+    # the checkpoint tiers serve ONLY the dead rank's rows, and the
+    # victim's RAM server died with it, so those come from the store
     totals = {
         k: sum(e["data"][k] for e in migrated)
-        for k in ("local", "peer", "store")
+        for k in ("live", "local", "peer", "store")
     }
-    assert totals["local"] >= 1, totals
-    assert totals["peer"] >= 1, totals
+    assert totals["live"] >= 1, totals
     assert totals["store"] >= 1, totals
+    assert totals["live"] >= totals["local"] + totals["peer"], totals
 
     # ---- every survivor landed on the SAME bit-identical state -------
     migr_lines = [
@@ -348,3 +385,244 @@ def test_reshard_fallback_drill(tmp_path):
     tags = [f"{r}-a" for r in range(N_NODES)]
     tags += [f"{r}-b" for r in range(N_NODES)]
     _assert_exactly_once(tmp, tags)
+
+
+def test_spare_promotion_drill(tmp_path):
+    """Hot-spare promotion: a 5th process registers as a spare BEFORE
+    reporting RUNNING (never grown in), pre-warms the committed
+    frontier from peers while idle, and the node loss cuts a PROMOTE
+    order — constant world size, the spare taking the casualty's
+    place out of its warm RAM cache inside ONE step boundary, with
+    zero process restarts and bit-identical state across the world."""
+    tmp = str(tmp_path)
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    store_dir = os.path.join(tmp, "store")
+    env = _drill_env(journal_path)
+    master_env = dict(env, DLROVER_TPU_RESHARD="1")
+    SPARE = N_NODES  # rank 4
+
+    procs = {}
+    try:
+        master = _spawn_master(
+            tmp, master_env, os.path.join(tmp, "state"), 0, "1"
+        )
+        procs["master"] = master
+        port = _master_port(tmp, "1", master)
+
+        for rank in range(N_NODES):
+            procs[rank] = _spawn_worker(
+                tmp, _worker_env(env, rank), port, rank, str(rank),
+                store_dir, os.path.join(tmp, f"ram{rank}"),
+            )
+        # the spare gets no fault of its own (the injected spec only
+        # matches host VICTIM anyway) and idles warm from the start
+        procs[SPARE] = _spawn_worker(
+            tmp, _worker_env(env, SPARE), port, SPARE, str(SPARE),
+            store_dir, os.path.join(tmp, f"ram{SPARE}"),
+            extra_args=["--spare"],
+        )
+
+        rc = _wait(procs[VICTIM], 180, "victim (kill expected)", tmp,
+                   [f"worker-{VICTIM}.out", "master-1.err"])
+        assert rc == -signal.SIGKILL, (
+            f"victim exited rc={rc}, wanted SIGKILL; "
+            + _tail(tmp, f"worker-{VICTIM}.out")
+        )
+
+        finishers = [r for r in range(N_NODES) if r != VICTIM] + [SPARE]
+        for rank in finishers:
+            rc = _wait(procs[rank], 300, f"worker {rank}", tmp,
+                       [f"worker-{rank}.out", "master-1.err"])
+            assert rc == 0, (
+                f"worker {rank} exited rc={rc}; "
+                + _tail(tmp, f"worker-{rank}.out")
+            )
+        rc = _wait(master, 60, "master", tmp, ["master-1.err"])
+        assert rc == 0, _tail(tmp, "master-1.err")
+    finally:
+        for p in procs.values():
+            _killpg(p, signal.SIGTERM)
+        time.sleep(0.5)
+        for p in procs.values():
+            _killpg(p)
+
+    survivors = [r for r in range(N_NODES) if r != VICTIM]
+
+    # ---- promotion inside one step boundary, zero restarts ----------
+    for rank in survivors + [SPARE]:
+        pids = _lines(tmp, str(rank), "PID")
+        assert len(pids) == 1 and pids[0][2] == "0", (rank, pids)
+        assert _lines(tmp, str(rank), "FALLBACK") == [], rank
+        assert len(_lines(tmp, str(rank), "TRANSITION")) == 1, rank
+    # the spare's own story: registered idle, warmed ahead of the
+    # fault, promoted exactly once
+    assert _lines(tmp, str(SPARE), "SPARE"), "spare never registered"
+    assert _lines(tmp, str(SPARE), "WARM"), "spare never pre-warmed"
+    assert len(_lines(tmp, str(SPARE), "PROMOTED")) == 1
+
+    # ---- the journal tells the promotion story exactly once ---------
+    events = read_journal(journal_path)
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e.get("kind"), []).append(e)
+    assert "scale.restart" not in by_kind, by_kind.get("scale.restart")
+    assert "reshard.aborted" not in by_kind, by_kind.get(
+        "reshard.aborted")
+
+    (ordered,) = by_kind["reshard.ordered"]
+    assert ordered["data"]["order_kind"] == "promote"
+    # constant world size: the spare replaces the casualty 1:1
+    assert ordered["data"]["world_size"] == N_NODES
+    assert ordered["data"]["lost"] == [VICTIM]
+    assert ordered["data"]["joined"] == [SPARE]
+    assert len(by_kind["spare.registered"]) == 1
+    assert len(by_kind["spare.warmed"]) >= 1
+    (promoted,) = by_kind["spare.promoted"]
+    assert promoted["data"]["spare_rank"] == SPARE
+    assert promoted["data"]["lost_rank"] == VICTIM
+    (completed,) = by_kind["reshard.completed"]
+    assert completed["data"]["duration_s"] > 0.0
+
+    migrated = by_kind["reshard.migrated"]
+    assert {e["data"]["node_rank"] for e in migrated} == set(
+        survivors + [SPARE])
+    for e in migrated:
+        assert e["data"]["digest_mismatch"] == 0, e
+    # the spare restored out of its warm cache (``local``): every
+    # member that was reachable at warm time. Only the victim's own
+    # rows can hit the store — the victim advertised its kill-step
+    # save moments before dying, leaving the spare no window to pull
+    # those two rows peer-to-peer
+    (spare_migrated,) = [
+        e for e in migrated if e["data"]["node_rank"] == SPARE
+    ]
+    assert spare_migrated["data"]["local"] >= 4, spare_migrated
+    assert spare_migrated["data"]["store"] <= 2, spare_migrated
+    # survivors still move their held rows live
+    assert sum(e["data"]["live"] for e in migrated) >= 1
+
+    # ---- bit-identical state across the whole new world -------------
+    migr_lines = [
+        _lines(tmp, str(rank), "MIGRATED")[0]
+        for rank in survivors + [SPARE]
+    ]
+    assert len({parts[1] for parts in migr_lines}) == 1, migr_lines
+    assert len({parts[2] for parts in migr_lines}) == 1, migr_lines
+    for parts in migr_lines:
+        assert parts[3] == "ok", parts
+    assert int(migr_lines[0][1]) == KILL_STEP, migr_lines
+
+    # ---- the dataset completed exactly once across the promotion ----
+    _assert_exactly_once(
+        tmp, [str(r) for r in range(N_NODES)] + [str(SPARE)]
+    )
+
+
+def test_reshard_oscillation_drill(tmp_path):
+    """Join -> shrink -> join on one master: order ids strictly
+    monotonic, stale broadcasts ignored by latecomers born after
+    them, and the dataset exactly-once across all three transitions."""
+    tmp = str(tmp_path)
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    store_dir = os.path.join(tmp, "store")
+    env = _drill_env(journal_path)
+    master_env = dict(env, DLROVER_TPU_RESHARD="1")
+    # no injected fault: the shrink comes from an external SIGKILL
+    no_fault = {"DLROVER_FAULT_INJECT": ""}
+    BASE = 3           # initial world 0..2
+    OSC_DATASET = 2 * DATASET_SIZE  # room for three transitions
+
+    def worker(rank, extra_args=()):
+        return _spawn_worker(
+            tmp, _worker_env(env, rank, no_fault), port, rank,
+            str(rank), store_dir, os.path.join(tmp, f"ram{rank}"),
+            extra_args=extra_args, n_nodes=BASE,
+            dataset_size=OSC_DATASET,
+        )
+
+    procs = {}
+    logs = ["master-1.err"] + [f"worker-{r}.out" for r in range(5)]
+    try:
+        master = _spawn_master(
+            tmp, master_env, os.path.join(tmp, "state"), 0, "1"
+        )
+        procs["master"] = master
+        port = _master_port(tmp, "1", master)
+
+        for rank in range(BASE):
+            procs[rank] = worker(rank)
+        # phase 1: world sealed and training (grow orders only exist
+        # on a sealed world)
+        _await(lambda: _lines(tmp, "0", "SHARD"),
+               "initial world progress", 120, procs, tmp, logs)
+
+        # phase 2: rank 3 joins -> grow order, adopted by everyone
+        procs[3] = worker(3, extra_args=["--join"])
+        _await(lambda: _lines(tmp, "3", "TRANSITION"),
+               "join transition", 120, procs, tmp, logs)
+
+        # phase 3: rank 1 dies (external SIGKILL) -> shrink order
+        _killpg(procs[1], signal.SIGKILL)
+        procs["dead"] = procs.pop(1)
+        _await(lambda: len(_lines(tmp, "0", "TRANSITION")) >= 2,
+               "shrink transition", 120, procs, tmp, logs)
+
+        # phase 4: rank 4 joins the shrunken world -> second grow.
+        # It is born AFTER two orders were broadcast: the stale ones
+        # must not make it stand down or fall back.
+        procs[4] = worker(4, extra_args=["--join"])
+        _await(lambda: _lines(tmp, "4", "TRANSITION"),
+               "second join transition", 180, procs, tmp, logs)
+
+        for rank in (0, 2, 3, 4):
+            rc = _wait(procs[rank], 300, f"worker {rank}", tmp,
+                       [f"worker-{rank}.out", "master-1.err"])
+            assert rc == 0, (
+                f"worker {rank} exited rc={rc}; "
+                + _tail(tmp, f"worker-{rank}.out")
+            )
+        rc = _wait(master, 60, "master", tmp, ["master-1.err"])
+        assert rc == 0, _tail(tmp, "master-1.err")
+    finally:
+        for p in procs.values():
+            _killpg(p, signal.SIGTERM)
+        time.sleep(0.5)
+        for p in procs.values():
+            _killpg(p)
+
+    events = read_journal(journal_path)
+    ordered = [e for e in events if e.get("kind") == "reshard.ordered"]
+    ids = [e["data"]["order_id"] for e in ordered]
+    kinds = [e["data"]["order_kind"] for e in ordered]
+    # strictly monotonic ids across the whole oscillation
+    assert all(a < b for a, b in zip(ids, ids[1:])), ids
+    assert kinds == ["grow", "shrink", "grow"], kinds
+    assert ordered[0]["data"]["joined"] == [3]
+    assert ordered[1]["data"]["lost"] == [1]
+    assert ordered[2]["data"]["joined"] == [4]
+    completed = [
+        e for e in events if e.get("kind") == "reshard.completed"
+    ]
+    assert [e["data"]["order_id"] for e in completed] == ids
+    assert not [e for e in events if e.get("kind") == "reshard.aborted"]
+
+    # single incarnations; the latecomers adopted exactly the order
+    # addressed to them (stale broadcasts ignored, no fallback)
+    for rank in (0, 2, 3, 4):
+        pids = _lines(tmp, str(rank), "PID")
+        assert len(pids) == 1 and pids[0][2] == "0", (rank, pids)
+        assert _lines(tmp, str(rank), "FALLBACK") == [], rank
+    adopted_by_4 = [
+        e["data"]["order_id"] for e in events
+        if e.get("kind") == "reshard.adopted"
+        and e["data"]["node_rank"] == 4
+    ]
+    assert adopted_by_4 == [ids[2]], adopted_by_4
+    # rank 3 rode all three orders (its join, the shrink, the second
+    # grow); rank 4 only the order that grew it in
+    assert len(_lines(tmp, "3", "TRANSITION")) == 3
+    assert len(_lines(tmp, "4", "TRANSITION")) == 1
+
+    # exactly-once across join -> shrink -> join
+    _assert_exactly_once(tmp, [str(r) for r in range(5)],
+                         size=OSC_DATASET)

@@ -1,11 +1,13 @@
 """Tier-1 gate on the reshard-in-place MTTR claim.
 
 Runs ``benchmarks/reshard_mttr.py --smoke`` (tiny state, one sample
-per path) and holds the PR 14 acceptance line: an in-process mesh
-transition must beat restart-the-world by >= 5x, and the migrated
-state must be exactly-once (bit-identical, zero digest mismatches).
-The measured evidence at real state sizes lives in RESHARD_r07.json
-(the full tier of the same script).
+per path) and holds the acceptance lines: an in-process mesh
+transition must beat restart-the-world by >= 5x, live migration
+(ISSUE 18: device-to-device moves for survivor-held shards) must beat
+the checkpoint-tier transition by >= 2x, and the migrated state must
+be exactly-once (bit-identical, zero digest mismatches). The measured
+evidence at real state sizes lives in RESHARD_r08.json (the full tier
+of the same script).
 """
 
 import json
@@ -27,8 +29,11 @@ def test_reshard_mttr_smoke():
     line = proc.stdout.strip().splitlines()[-1]
     res = json.loads(line)
     assert set(res) == {
-        "reshard_mttr_ms", "restart_mttr_ms", "speedup", "exactly_once"
+        "live_migration_ms", "reshard_mttr_ms", "restart_mttr_ms",
+        "speedup", "live_speedup", "live_vs_restart", "exactly_once",
     }
     assert res["exactly_once"] is True
+    assert res["live_migration_ms"] > 0
     assert res["reshard_mttr_ms"] > 0
     assert res["speedup"] >= 5.0, res
+    assert res["live_speedup"] >= 2.0, res

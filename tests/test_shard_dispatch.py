@@ -369,6 +369,21 @@ def test_resize_mid_shard_keeps_exactly_once():
     sc.stop()
 
 
+def test_resize_updates_reconnect_rehello_params():
+    """The reconnect re-hello replays _dataset_params against a master
+    that lost its journal: after a resize it must carry the NEW batch
+    geometry, or the re-created dataset shards under the pre-resize
+    size."""
+    mc = LocalMasterClient()
+    sc = ShardingClient(
+        dataset_name="rehello-ds", batch_size=8, dataset_size=32,
+        num_minibatches_per_shard=2, master_client=mc,
+    )
+    sc.resize(batch_size=4)
+    assert sc._dataset_params["batch_size"] == 4
+    sc.stop()
+
+
 def test_resize_mid_chunk_index_stream_exactly_once():
     """IndexShardingClient with its consumer cursor mid-chunk across a
     resize: every index of the dataset is handed out exactly once and

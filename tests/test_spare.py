@@ -1,0 +1,212 @@
+"""Hot-spare lifecycle: register, pre-warm from peers, serve restore.
+
+Unit-level counterpart of the promotion drill in
+tests/test_reshard_drill.py: two virtual hosts flash-save to RAM and
+advertise over the KV store; an idle spare registers, pre-warms the
+step over ``/ckpt/shard``, and — after a host dies — restores the
+dead host's shard set out of its warm cache without touching the
+object store.
+"""
+
+import shutil
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu import telemetry as T
+from dlrover_tpu.agent.master_client import LocalMasterClient
+from dlrover_tpu.checkpoint import peer
+from dlrover_tpu.reshard import SPARE_KEY_PREFIX, HotSpare, PrewarmedSource
+from dlrover_tpu.telemetry.http import MetricsServer
+from dlrover_tpu.telemetry.journal import EventJournal
+from dlrover_tpu.trainer.checkpoint import FlashCheckpointer
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_defaults():
+    T.set_default_registry(None)
+    T.set_default_journal(EventJournal(None))
+    yield
+    T.set_default_registry(None)
+    T.set_default_journal(EventJournal(None))
+
+
+def events(kind):
+    return T.default_journal().events(kind)
+
+
+class _BrokenStore:
+    def __getattr__(self, name):
+        def boom(*a, **k):
+            raise OSError("store unreachable")
+
+        return boom
+
+
+def _checkpointer(tmp_path, p, n=2):
+    return FlashCheckpointer(
+        persist_dir=str(tmp_path / "store"),
+        ram_dir=str(tmp_path / f"ram{p}"),
+        persist_interval=0, use_orbax=False,
+        process_index=p, n_processes=n,
+        proc_of_device=lambda d: d.id // 4,
+    )
+
+
+def _state(mesh):
+    return {
+        "w": jax.device_put(
+            np.arange(64, dtype=np.float32).reshape(8, 8),
+            NamedSharding(mesh, P(None, "tp")),
+        ),
+        "epoch": 4,
+    }
+
+
+def _serving_world(tmp_path, kv, step):
+    """Two hosts save ``step`` to RAM only and advertise it."""
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+    state = _state(mesh)
+    ckpts, servers = [], []
+    for p in range(2):
+        c = _checkpointer(tmp_path, p)
+        srv = MetricsServer(
+            port=0, shard_provider=c.shard_provider()
+        ).start()
+        c._peer_registry = peer.PeerRegistry(
+            kv, p, f"http://127.0.0.1:{srv.port}"
+        )
+        ckpts.append(c)
+        servers.append(srv)
+    for c in ckpts:
+        c.save(step, state)
+        c.wait()
+    return mesh, state, ckpts, servers
+
+
+def test_registration_precedes_running():
+    kv = LocalMasterClient()
+    spare = HotSpare(kv, node_rank=5)
+    spare.register()
+    assert kv.kv_store_get(f"{SPARE_KEY_PREFIX}5")
+    assert not spare.is_claimed()
+    assert len(events("spare.registered")) == 1
+    # the coordinator consumes the registration at promotion
+    kv.kv_store_delete(f"{SPARE_KEY_PREFIX}5")
+    assert spare.is_claimed()
+
+
+def test_prewarmed_source_is_step_pinned_and_deduped():
+    src = PrewarmedSource(9)
+    src.put("pk", "ik", b"abc")
+    src.put("pk", "ik", b"xyz")  # first copy wins
+    assert src.fetch("pk", "ik", None) == b"abc"
+    assert src.fetch("pk", "other", None) is None
+    assert len(src) == 1 and src.bytes == 3
+    assert src.step == 9 and src.tier == "local"
+
+
+def test_prewarm_pulls_newest_advertised_step(tmp_path):
+    kv = LocalMasterClient()
+    mesh, state, ckpts, servers = _serving_world(tmp_path, kv, 11)
+    try:
+        for c in ckpts:
+            c.save(12, state)
+            c.wait()
+        spare = HotSpare(kv, node_rank=2)
+        reg = peer.PeerRegistry(kv, 2, "")
+        assert spare.prewarm(reg) == 12
+        src = spare.source()
+        assert src is not None and len(src) >= 1 and src.step == 12
+        (evt,) = events("spare.warmed")
+        assert evt["data"]["step"] == 12
+        assert evt["data"]["members"] == len(src)
+        # re-warming the held step is a no-op (the idle-cadence loop)
+        assert spare.prewarm(reg) == 12
+        assert len(events("spare.warmed")) == 1
+    finally:
+        for c in ckpts:
+            c.close()
+        for s in servers:
+            s.stop()
+
+
+def test_promotion_restores_from_the_warm_cache(tmp_path):
+    """The promotion data path: host 0 dies AFTER the spare warmed;
+    the spare takes identity 0 and reassembles the step from RAM —
+    store broken, every member digest-verified at warm time."""
+    kv = LocalMasterClient()
+    mesh, state, ckpts, servers = _serving_world(tmp_path, kv, 21)
+    spare = HotSpare(kv, node_rank=2)
+    assert spare.prewarm(peer.PeerRegistry(kv, 2, "")) == 21
+
+    # host 0 dies: tmpfs gone; the spare is promoted into its place
+    shutil.rmtree(tmp_path / "ram0")
+    servers[0].stop()
+    r = _checkpointer(tmp_path, 0)
+    r._store = _BrokenStore()
+    r._peer_registry = peer.PeerRegistry(kv, 0, "http://127.0.0.1:1")
+    target = {
+        "w": jax.device_put(
+            np.zeros((8, 8), np.float32),
+            NamedSharding(mesh, P(None, "tp")),
+        ),
+        "epoch": -1,
+    }
+    try:
+        got, step = r.restore(
+            target=target, step=21, extra_sources=[spare.source()]
+        )
+    finally:
+        r.close()
+        for c in ckpts:
+            c.close()
+        for s in servers:
+            s.stop()
+    assert step == 21
+    assert np.array_equal(np.asarray(got["w"]), np.asarray(state["w"]))
+    assert got["epoch"] == 4
+    tr = events("ckpt.topology_restore")[-1]["data"]
+    # the warm cache served everything: no peer refetch, no store
+    assert tr["local"] >= 1 and tr["store"] == 0
+    assert tr["digest_mismatch"] == 0
+
+
+def test_stale_warm_cache_steps_aside(tmp_path):
+    """A spare warmed at step N must not serve a restore of step M:
+    the pinned source is skipped and the peers cover the restore."""
+    kv = LocalMasterClient()
+    mesh, state, ckpts, servers = _serving_world(tmp_path, kv, 30)
+    spare = HotSpare(kv, node_rank=2)
+    assert spare.prewarm(peer.PeerRegistry(kv, 2, "")) == 30
+    try:
+        for c in ckpts:
+            c.save(31, state)
+            c.wait()
+        r = _checkpointer(tmp_path, 0)
+        r._store = _BrokenStore()
+        r._peer_registry = peer.PeerRegistry(kv, 0, "http://127.0.0.1:1")
+        target = {
+            "w": jax.device_put(
+                np.zeros((8, 8), np.float32),
+                NamedSharding(mesh, P(None, "tp")),
+            ),
+            "epoch": -1,
+        }
+        got, step = r.restore(
+            target=target, step=31, extra_sources=[spare.source()]
+        )
+        r.close()
+    finally:
+        for c in ckpts:
+            c.close()
+        for s in servers:
+            s.stop()
+    assert step == 31
+    assert np.array_equal(np.asarray(got["w"]), np.asarray(state["w"]))

@@ -786,6 +786,19 @@ def test_reshard_event_names_are_the_canonical_set():
     _assert_vocabulary_clean("reshard")
 
 
+def test_spare_event_names_are_the_canonical_set():
+    """The spare.* vocabulary is closed (VOCABULARY['spare'], new in
+    ISSUE 18 with hot-spare promotion)."""
+    _assert_vocabulary_clean("spare")
+
+
+def test_relay_event_names_are_the_canonical_set():
+    """The relay.* vocabulary is closed (VOCABULARY['relay'];
+    tier_*/restarted joined in ISSUE 18 with the launcher-owned relay
+    lifecycle)."""
+    _assert_vocabulary_clean("relay")
+
+
 def test_control_event_names_are_the_canonical_set():
     """The control.* vocabulary is closed (VOCABULARY['control'])."""
     _assert_vocabulary_clean("control")

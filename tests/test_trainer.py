@@ -156,6 +156,42 @@ def test_sampler_live_iterator_keeps_old_stride_across_set_world():
     assert fresh[0] == 16 and fresh.size == (40 - 16) // 2
 
 
+def test_sampler_grow_past_remaining_pads_every_rank():
+    """Grow to more replicas than remaining samples: the pad is
+    shorter than the shortfall, so it must REPEAT — a short pad hands
+    some ranks fewer indices than others and the lockstep collective
+    stalls forever."""
+    counts = []
+    for r in range(4):
+        s = ElasticDistributedSampler(24, 4, r, shuffle=False)
+        s.load_state_dict({"epoch": 0, "completed_num": 23})
+        idx = list(s)
+        counts.append(len(idx))
+        assert idx == [23]  # the one remaining sample, on every rank
+    assert counts == [1, 1, 1, 1]
+
+
+def test_sampler_world_change_after_epoch_end_stays_empty():
+    """Padding overshoots completed_num past dataset_size at epoch
+    end; a set_world then must see an empty remainder, not a negative
+    one."""
+    s = ElasticDistributedSampler(10, 3, 0, shuffle=False,
+                                  drop_last=True)
+    s.load_state_dict({"epoch": 0, "completed_num": 12})
+    s.set_world(4, 1)
+    assert len(s) == 0
+    assert list(s) == []
+
+
+def test_sampler_load_state_rejects_out_of_range_rank():
+    s = ElasticDistributedSampler(10, 4, 3, shuffle=False)
+    with pytest.raises(ValueError):
+        # shrink to 2 replicas while keeping rank 3: the partition
+        # would silently alias a live rank's indices
+        s.load_state_dict({"epoch": 0, "completed_num": 0},
+                          num_replicas=2)
+
+
 def test_sampler_shuffle_is_epoch_deterministic():
     a = ElasticDistributedSampler(20, 2, 0, shuffle=True, seed=7)
     b = ElasticDistributedSampler(20, 2, 0, shuffle=True, seed=7)

@@ -88,6 +88,15 @@ VOCABULARY = {
         "reshard.rebalanced",
         "reshard.completed",
         "reshard.aborted",
+        "reshard.step_pinned",
+    })),
+    # ISSUE 18: hot spares — idle ranks registered for sub-second
+    # promotion into a dead rank's slot (reshard/spare.py,
+    # reshard/coordinator.py)
+    "spare": (("spare",), frozenset({
+        "spare.registered",
+        "spare.warmed",
+        "spare.promoted",
     })),
     # ISSUE 12: control-plane fan-in (master side / agent side)
     "control": (("control",), frozenset({
@@ -114,11 +123,16 @@ VOCABULARY = {
     })),
     # ISSUE 16: the aggregator relay tier (agent/relay.py) and the
     # agents' relay -> direct-master failover (master_client.py)
+    # (tier_* / restarted: ISSUE 18's launcher-owned relay lifecycle,
+    # agent/relay.py RelayTier)
     "relay": (("relay",), frozenset({
         "relay.started",
         "relay.stopped",
         "relay.forward_failed",
         "relay.failover",
+        "relay.tier_started",
+        "relay.tier_stopped",
+        "relay.restarted",
     })),
     # ISSUE 17: the fleet observability plane — SLO objective state
     # machine (telemetry/fleet.py) and journal file rotation
