@@ -51,11 +51,18 @@ Prints ONE JSON line (BENCH conventions):
                         delivery (relay_phase_dropped == 0) + master
                         CPU per thousand delivered agent-intervals,
                         relay tier vs direct batched
+  fleet_*               the roll-up phase (--fleet): quantiles with
+                        zero per-agent scrapes, digest wire ratio;
+                        with --jobs N (ISSUE 19) agents shard across
+                        N job namespaces and fleet_job_* proves every
+                        job got its own quantiles from the same
+                        per-job relay pre-merge
 
 Run:  JAX_PLATFORMS=cpu python benchmarks/master_swarm.py \
           [--agents 1000] [--threads 16] [--duration 6] [--steps 10] \
-          [--relays 32]
-      --smoke shrinks the run for the tier-1 suite (forces --relays 2).
+          [--relays 32] [--fleet --jobs 4]
+      --smoke shrinks the run for the tier-1 suite (forces --relays 2,
+      --fleet, --jobs 2).
 """
 
 import argparse
@@ -145,6 +152,9 @@ def run_master(ns) -> int:
         },
         "final_step": getattr(speed, "_global_step", 0),
         "fleet": fleet_agg.snapshot(),
+        "fleet_jobs": {
+            j: fleet_agg.snapshot(job=j) for j in fleet_agg.jobs()
+        },
     }
     print("STATS " + json.dumps(stats), flush=True)
     return 0
@@ -226,13 +236,16 @@ def _percentile(sorted_vals, q: float) -> float:
 
 def _drive(master: MasterProc, mode: str, agents: int, threads: int,
            duration: float, steps_per_interval: int,
-           retry_cap: float = 0.5, addrs=None, fleet=False) -> dict:
+           retry_cap: float = 0.5, addrs=None, fleet=False,
+           jobs=1) -> dict:
     """Hammer the master with interval-equivalent cycles until the
     deadline; returns throughput + latency + delivery accounting.
     ``addrs`` (relay tier) routes agent ``a`` to ``addrs[a % len]``
     instead of the master directly. ``fleet`` attaches a per-agent
     metric digest to every report (the ISSUE 17 roll-up lane) and
-    accounts its wire bytes against the bare delta's."""
+    accounts its wire bytes against the bare delta's. ``jobs > 1``
+    (ISSUE 19) shards the agents round-robin across that many job
+    namespaces — the per-job roll-up axis."""
     from dlrover_tpu.agent.status_reporter import DeltaTracker
     from dlrover_tpu.common import comm
     from dlrover_tpu.common.grpc_utils import GenericRpcClient
@@ -247,7 +260,13 @@ def _drive(master: MasterProc, mode: str, agents: int, threads: int,
     cycles = [0] * threads
     sheds = [0] * threads
     acked_seq = {}  # agent id -> last acked seq (batched mode)
-    trackers = {a: DeltaTracker(incarnation=0) for a in range(agents)}
+    trackers = {
+        a: DeltaTracker(
+            incarnation=0,
+            job_id=f"job-{a % jobs}" if jobs > 1 else "",
+        )
+        for a in range(agents)
+    }
     steps = {a: 0 for a in range(agents)}
     start_evt = threading.Event()
     warm_barrier = threading.Barrier(threads + 1)
@@ -497,7 +516,8 @@ def _run_fleet_phase(ns) -> dict:
         n_relays = len(relays)
         addrs = [f"localhost:{relay.port}" for relay in relays]
         res = _drive(m, "batched", ns.agents, ns.threads, ns.duration,
-                     ns.steps, addrs=addrs, fleet=True)
+                     ns.steps, addrs=addrs, fleet=True,
+                     jobs=max(1, ns.jobs))
         for relay in relays:
             relay.stop(flush=True)
         relays = []
@@ -507,6 +527,7 @@ def _run_fleet_phase(ns) -> dict:
         master_stats = m.stop()
     fleet_doc = master_stats.get("fleet", {})
     res["fleet"] = fleet_doc
+    res["fleet_jobs"] = master_stats.get("fleet_jobs", {})
     res["fleet_relays"] = n_relays
     return res
 
@@ -543,6 +564,11 @@ def main() -> int:
                    help="phase 5: digest roll-ups through the relay "
                         "tier, fleet quantiles with zero agent "
                         "scrapes (--smoke forces it on)")
+    p.add_argument("--jobs", type=int, default=1,
+                   help="fleet phase: shard the agents round-robin "
+                        "across N job namespaces (ISSUE 19) — gates "
+                        "per-job quantiles for every job with zero "
+                        "per-agent scrapes (--smoke forces 2)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny run for the tier-1 suite")
     ns = p.parse_args()
@@ -557,6 +583,7 @@ def main() -> int:
         ns.duration = min(ns.duration, 1.5)
         ns.relays = 2 if ns.relays == 0 else min(ns.relays, 2)
         ns.fleet = True
+        ns.jobs = 2 if ns.jobs <= 1 else min(ns.jobs, 2)
     min_speedup = ns.min_speedup
     if min_speedup is None:
         min_speedup = 2.0 if ns.smoke else 10.0
@@ -659,6 +686,22 @@ def main() -> int:
             # bare steady-state delta it piggybacks on
             and digest_ratio <= 2.0
         )
+        if ns.jobs > 1:
+            # ISSUE 19: the job axis — every job namespace must come
+            # back with ITS OWN materialized quantiles (still zero
+            # per-agent scrapes, still relay-pre-merged per job)
+            fjobs = fleet.get("fleet_jobs", {})
+            want = {f"job-{k}" for k in range(ns.jobs)}
+            ok = ok and set(fjobs) == want and all(
+                fjobs[j].get("series", {}).get("step", {})
+                .get("count", 0) > 0
+                and fjobs[j].get("series", {}).get("step", {})
+                .get("p99_ms", 0.0) > 0.0
+                and fjobs[j].get("counters", {}).get("steps", 0) > 0
+                and 0 < fjobs[j].get("sources", 0)
+                <= fleet["fleet_relays"]
+                for j in want
+            )
     result = {
         "metric": "control_plane_fanin_throughput",
         "value": round(batched["intervals_per_s"], 1),
@@ -732,6 +775,21 @@ def main() -> int:
                 / max(1.0, fleet["delta_bytes_avg"]), 3
             ),
         })
+        if ns.jobs > 1:
+            fjobs = fleet.get("fleet_jobs", {})
+            result.update({
+                "fleet_jobs": ns.jobs,
+                "fleet_job_step_counts": {
+                    j: fjobs[j].get("series", {}).get("step", {})
+                    .get("count", 0)
+                    for j in sorted(fjobs)
+                },
+                "fleet_job_step_p99_ms": {
+                    j: fjobs[j].get("series", {}).get("step", {})
+                    .get("p99_ms", 0.0)
+                    for j in sorted(fjobs)
+                },
+            })
     if errors:
         result["errors"] = errors[:5]
     print(json.dumps(result))

@@ -81,11 +81,14 @@ class _AgentSlot:
     __slots__ = (
         "tracker", "timestamp", "step", "step_ts", "pid",
         "goodput_fields", "resource", "host", "final", "fresh",
-        "pending_action", "upstream_seq", "trace_ctx",
+        "pending_action", "upstream_seq", "trace_ctx", "job_id",
     )
 
     def __init__(self, tracker):
         self.tracker = tracker
+        #: job namespace of the fronted agent (ISSUE 19) — stamped onto
+        #: every re-delta'd sub-report so the master attributes it
+        self.job_id = "default"
         self.timestamp = 0.0
         self.step: Optional[int] = None
         self.step_ts = 0.0
@@ -133,15 +136,17 @@ class AggregatorRelay:
         #: None = undecided, False = master predates the batch RPC —
         #: forward per-agent report_node_status instead
         self._batch_supported: Optional[bool] = None
-        # pre-merged fleet digest (ISSUE 17): agents' per-report metric
-        # digests fold into ONE wire dict here, so the master sees one
-        # summary per relay per interval regardless of fanout. Same
-        # loss-free contract as the agent's DigestCollector: compose
-        # drains pending -> in-flight, a failed forward keeps in-flight
-        # for the next compose, only an accepted forward clears it.
-        # Both dicts are guarded by ``self._lock``.
-        self._pending_digest: Dict = {}
-        self._inflight_digest: Dict = {}
+        # pre-merged fleet digests (ISSUE 17, per-job since ISSUE 19):
+        # agents' per-report metric digests fold into ONE wire dict PER
+        # JOB here, so the master sees one summary per (relay, job) per
+        # interval regardless of fanout — and jobs sharing a relay
+        # never cross-contaminate. Same loss-free contract as the
+        # agent's DigestCollector: compose drains pending -> in-flight,
+        # a failed forward keeps in-flight for the next compose, only
+        # an accepted forward clears it. Both maps (job_id -> wire
+        # digest) are guarded by ``self._lock``.
+        self._pending_digests: Dict[str, Dict] = {}
+        self._inflight_digests: Dict[str, Dict] = {}
         self._stopped = threading.Event()
         self._kick = threading.Event()
         self._flush_on_stop = True
@@ -269,8 +274,14 @@ class AggregatorRelay:
             # grpc_utils installed the agent's trace context for this
             # handler; park it so the next forward chains under it
             slot.trace_ctx = tracing.current_context()
+            if req.job_id != slot.job_id:
+                slot.job_id = req.job_id
+                slot.tracker.job_id = req.job_id
             if req.has_metrics and req.metrics:
-                fleet.merge_digest(self._pending_digest, req.metrics)
+                fleet.merge_digest(
+                    self._pending_digests.setdefault(req.job_id, {}),
+                    req.metrics,
+                )
             action = slot.pending_action
             slot.pending_action = ""
             self.downstream_reports += 1
@@ -322,16 +333,20 @@ class AggregatorRelay:
                 )
                 for key, slot in fresh
             ]
-            # drain pending -> in-flight; a retried/failed forward's
-            # digest is still in-flight and re-merges here losslessly
-            if self._pending_digest:
+            # drain pending -> in-flight per job; a retried/failed
+            # forward's digests are still in-flight and re-merge here
+            # losslessly
+            for job, pending in self._pending_digests.items():
                 fleet.merge_digest(
-                    self._inflight_digest, self._pending_digest
+                    self._inflight_digests.setdefault(job, {}), pending
                 )
-                self._pending_digest = {}
-            digest: Dict = {}
-            if self._inflight_digest:
-                fleet.merge_digest(digest, self._inflight_digest)
+            self._pending_digests = {}
+            digests: Dict[str, Dict] = {}
+            for job, inflight in self._inflight_digests.items():
+                if inflight:
+                    fleet.merge_digest(
+                        digests.setdefault(job, {}), inflight
+                    )
         reports, slots = [], []
         for (key, slot, ts, step, step_ts, pid, goodput, resource,
              host, final) in snapshots:
@@ -345,10 +360,10 @@ class AggregatorRelay:
             report.node_type, report.node_id = key
             reports.append(report)
             slots.append((key, slot))
-        return reports, slots, digest
+        return reports, slots, digests
 
     def _forward_once(self):
-        reports, slots, digest = self._compose_batch()
+        reports, slots, digests = self._compose_batch()
         if not reports:
             return
         # adopt the freshest carried agent context: the relay's forward
@@ -369,7 +384,7 @@ class AggregatorRelay:
                     if self._batch_supported is False:
                         acks = self._forward_individually(reports)
                     else:
-                        acks = self._forward_batch(reports, digest)
+                        acks = self._forward_batch(reports, digests)
                 except Exception as e:
                     self._forward_failures.inc()
                     record(
@@ -385,22 +400,32 @@ class AggregatorRelay:
                             slot.fresh = True  # recompose next interval
                     return
                 self._commit_acks(slots, reports, acks)
-                if digest:
-                    # the master applied the in-flight digest (or an
-                    # old master that can't consume it acked the
-                    # fallback — either way retrying it would
+                if digests:
+                    # the master applied the in-flight digests (or an
+                    # old master that can't consume them acked the
+                    # fallback — either way retrying would
                     # double-count)
                     with self._lock:
-                        self._inflight_digest = {}
+                        self._inflight_digests = {}
         finally:
             self._forward_latency.observe(time.perf_counter() - t0)
 
     def _forward_batch(self, reports,
-                       digest: Optional[Dict] = None
+                       digests: Optional[Dict[str, Dict]] = None
                        ) -> List[comm.NodeStatusAck]:
-        batch = comm.RelayBatchReport(
-            reports=reports, relay_incarnation=0, digest=digest or {},
-        )
+        digests = digests or {}
+        if set(digests) <= {"default"}:
+            # single-job relay: ride the legacy field so the wire (and
+            # an ISSUE 17 master) is byte-identical to the pre-job
+            # format
+            batch = comm.RelayBatchReport(
+                reports=reports, relay_incarnation=0,
+                digest=digests.get("default", {}),
+            )
+        else:
+            batch = comm.RelayBatchReport(
+                reports=reports, relay_incarnation=0, digests=digests,
+            )
         attempts = 0
         while True:
             ack = self._upstream.report_relay_batch(batch)

@@ -34,7 +34,7 @@ from typing import Callable, Dict, Optional, Tuple
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.telemetry import fleet
-from dlrover_tpu.telemetry.journal import record
+from dlrover_tpu.telemetry.journal import current_job_id, record
 
 #: fractional interval jitter (0.2 = ±20%)
 DEFAULT_JITTER = 0.2
@@ -55,8 +55,13 @@ class DeltaTracker:
 
     def __init__(self, incarnation: int = 0,
                  goodput_min_delta_s: float = GOODPUT_MIN_DELTA_S,
-                 max_skip: int = DEFAULT_MAX_SKIP):
+                 max_skip: int = DEFAULT_MAX_SKIP,
+                 job_id: str = ""):
         self._incarnation = incarnation
+        #: job namespace stamped into every composed report (ISSUE 19);
+        #: the sparse wire omits the default, so single-job fleets are
+        #: byte-identical to the pre-job format
+        self.job_id = job_id or "default"
         self._seq = 0
         self._full_next = True
         self._goodput_min_delta = goodput_min_delta_s
@@ -103,6 +108,7 @@ class DeltaTracker:
             seq=self._seq,
             full=full,
             final=final,
+            job_id=self.job_id,
         )
         if full or final:
             # host only travels when someone reads it: the master
@@ -196,7 +202,9 @@ class StatusReporter:
             except ValueError:
                 jitter = DEFAULT_JITTER
         self._jitter = min(0.9, max(0.0, jitter))
-        self._tracker = DeltaTracker(incarnation=incarnation)
+        self._tracker = DeltaTracker(
+            incarnation=incarnation, job_id=current_job_id()
+        )
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: None = undecided, True = batched path confirmed, False =

@@ -580,6 +580,10 @@ class NodeStatusReport(BaseRequest):
     #: the process produced no samples this interval.
     has_metrics: bool = False
     metrics: Dict = field(default_factory=dict)
+    #: job namespace (ISSUE 19): which job this reporter belongs to.
+    #: Sparse encoding omits the default, so single-job wires (and old
+    #: peers) are byte-identical to the pre-job format.
+    job_id: str = "default"
 
 
 @dataclass
@@ -612,8 +616,14 @@ class RelayBatchReport(BaseRequest):
     #: pre-merged metric digest across this relay's agents for the
     #: interval (ISSUE 17): the master folds ONE mergeable summary per
     #: relay instead of K per-agent digests. Sub-reports carry no
-    #: per-agent digest when this is set.
+    #: per-agent digest when this is set. Legacy single-job field — a
+    #: relay that only saw default-job agents still uses it; the master
+    #: attributes it to job "default".
     digest: Dict = field(default_factory=dict)
+    #: per-job pre-merged digests (ISSUE 19): job_id -> digest. Set
+    #: instead of ``digest`` when the relay saw a non-default job this
+    #: interval; sparse encoding keeps single-job wires unchanged.
+    digests: Dict = field(default_factory=dict)
 
 
 @dataclass

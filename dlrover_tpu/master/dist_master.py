@@ -16,6 +16,7 @@ from dlrover_tpu.common.constants import (
     RendezvousName,
     TaskType,
 )
+from dlrover_tpu.brain.advisor import ResourceAdvisor
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.elastic_training.kv_store_service import (
     KVStoreService,
@@ -274,6 +275,24 @@ class DistributedJobMaster:
             fleet_aggregator=self.fleet_aggregator,
         )
         self.port = self._server.port
+        # the explainable resource advisor (ISSUE 19): per-job
+        # telemetry in, journaled evidence-chain proposals out. Shadow
+        # (observe) by default; DLROVER_TPU_BRAIN=advise routes
+        # grow/shrink plans for THIS job through manual_scale's
+        # validity guards.
+        from dlrover_tpu.telemetry.journal import current_job_id
+
+        self.resource_advisor = ResourceAdvisor(
+            fleet=self.fleet_aggregator,
+            goodput=self.goodput_aggregator,
+            speed_monitors_fn=self.servicer.job_speed_monitors,
+            quarantine=self.quarantine,
+            scale_fn=self.auto_scaler.manual_scale,
+            local_job=current_job_id(),
+            node_unit=(
+                getattr(job_args, "node_unit", 1) if job_args else 1
+            ) or 1,
+        )
         self._exit_code = 0
         self._exit_reason = ""
         self._metrics_server = None
@@ -430,8 +449,10 @@ class DistributedJobMaster:
         # /goodput on this master serves the job-level aggregation
         # (and refreshes the goodput gauges on every read)
         goodput_mod.set_job_provider(self._goodput_summary)
-        # /fleet serves the roll-up plane's snapshot (ISSUE 17)
+        # /fleet serves the roll-up plane's snapshot (ISSUE 17);
+        # ?job= scoping rides on the snapshot's job keyword
         set_fleet_provider(self.fleet_aggregator.snapshot)
+        self.resource_advisor.start()
         # Prometheus /metrics + /journal (telemetry/http.py);
         # DLROVER_TPU_METRICS_PORT pins the port, "off" disables
         self._metrics_server = start_metrics_server()
@@ -498,6 +519,9 @@ class DistributedJobMaster:
                     self.fleet_aggregator.slo.evaluate(
                         self.fleet_aggregator
                     )
+                # advisory beat: rate-limits itself to its own
+                # interval; shadow mode only journals proposals
+                self.resource_advisor.maybe_step()
                 if self.job_manager.is_job_failed():
                     # critical-node fast-fail (dist_job_manager
                     # mark_job_failed): don't limp at reduced capacity
@@ -551,24 +575,29 @@ class DistributedJobMaster:
         if handle is not None:
             handle(order.lost)
 
-    def _goodput_summary(self):
-        summary = self.goodput_aggregator.summary()
-        goodput_mod.export_metrics(summary)
+    def _goodput_summary(self, job=None):
+        summary = self.goodput_aggregator.summary(job=job)
+        if job is None:
+            # gauges stay job-wide: a scoped read must not shrink the
+            # exported totals to one job's slice
+            goodput_mod.export_metrics(summary)
         return summary
 
     # ------------------------------------------------------- SLO signals
 
-    def _slo_goodput_percent(self):
-        job = self.goodput_aggregator.summary().get("job") or {}
-        if not job.get("procs"):
+    def _slo_goodput_percent(self, job=None):
+        doc = self.goodput_aggregator.summary(job=job)
+        job_doc = doc.get("job") or {}
+        if not job_doc.get("procs"):
             return None  # no ledgers yet: nothing to hold an SLO on
-        return float(job.get("goodput_percent") or 0.0)
+        return float(job_doc.get("goodput_percent") or 0.0)
 
-    def _slo_goodput_cause(self):
+    def _slo_goodput_cause(self, job=None):
         """The goodput ledger's dominant badput cause — the attributed
-        'why' on slo.violated for step/goodput objectives."""
-        job = self.goodput_aggregator.summary().get("job") or {}
-        badput = job.get("badput_s") or {}
+        'why' on slo.violated for step/goodput objectives. Job-aware
+        (ISSUE 19): per-job evaluation blames the job's own ledger."""
+        doc = self.goodput_aggregator.summary(job=job)
+        badput = (doc.get("job") or {}).get("badput_s") or {}
         if not any(badput.values()):
             return {}
         cause = max(badput, key=badput.get)

@@ -10,6 +10,7 @@ import threading
 import time
 from typing import Optional
 
+from dlrover_tpu.brain.advisor import ResourceAdvisor
 from dlrover_tpu.common.constants import JobExitReason, RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.elastic_training.rdzv_manager import (
@@ -23,7 +24,13 @@ from dlrover_tpu.master.node.local_job_manager import LocalJobManager
 from dlrover_tpu.master.servicer import create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
 from dlrover_tpu.serving.router import RequestRouter
-from dlrover_tpu.telemetry.http import start_metrics_server
+from dlrover_tpu.telemetry import goodput as goodput_mod
+from dlrover_tpu.telemetry.fleet import FleetAggregator, SLOEvaluator
+from dlrover_tpu.telemetry.http import (
+    set_fleet_provider,
+    start_metrics_server,
+)
+from dlrover_tpu.telemetry.journal import current_job_id
 
 
 class LocalJobMaster:
@@ -42,6 +49,13 @@ class LocalJobMaster:
         # serving request plane (standalone/bench wiring): same router
         # the distributed master runs, minus the scale-plan autoscaler
         self.request_router = RequestRouter()
+        # job-scoped observability (ISSUE 19): the standalone master
+        # runs the same fleet/goodput planes as the distributed one so
+        # multi-job drills (several agent groups, one master) get
+        # per-job /fleet, /goodput and advisor proposals without a
+        # full control plane
+        self.fleet_aggregator = FleetAggregator(slo=SLOEvaluator())
+        self.goodput_aggregator = goodput_mod.GoodputAggregator()
         self._server, self.servicer = create_master_service(
             port,
             task_manager=self.task_manager,
@@ -51,8 +65,19 @@ class LocalJobMaster:
             sync_service=self.sync_service,
             error_monitor=self.error_monitor,
             request_router=self.request_router,
+            goodput_aggregator=self.goodput_aggregator,
+            fleet_aggregator=self.fleet_aggregator,
         )
         self.port = self._server.port
+        # the advisor runs shadow-only here: the local master has no
+        # scaler, so even DLROVER_TPU_BRAIN=advise cannot actuate —
+        # proposals journal with scale_fn=None guards intact
+        self.resource_advisor = ResourceAdvisor(
+            fleet=self.fleet_aggregator,
+            goodput=self.goodput_aggregator,
+            speed_monitors_fn=self.servicer.job_speed_monitors,
+            local_job=current_job_id(),
+        )
         self._exit_code = 0
         self._exit_reason = ""
         self._metrics_server = None
@@ -70,6 +95,11 @@ class LocalJobMaster:
         self.task_manager.start()
         self.request_router.start()
         self._server.start()
+        # /goodput and /fleet serve this master's aggregations, with
+        # ?job= scoping (ISSUE 19)
+        goodput_mod.set_job_provider(self.goodput_aggregator.summary)
+        set_fleet_provider(self.fleet_aggregator.snapshot)
+        self.resource_advisor.start()
         # Prometheus /metrics + /journal (telemetry/http.py);
         # DLROVER_TPU_METRICS_PORT pins the port, "off" disables
         self._metrics_server = start_metrics_server()
@@ -84,6 +114,7 @@ class LocalJobMaster:
                         self._exit_code = 1
                         self._exit_reason = JobExitReason.UNKNOWN_ERROR
                     break
+                self.resource_advisor.maybe_step()
                 if self.task_manager.finished():
                     # drain, don't slam the door: workers are about to
                     # see end-of-dataset and exit, and their agents
@@ -111,6 +142,8 @@ class LocalJobMaster:
         self.request_router.stop()
         self.task_manager.stop()
         self.job_manager.stop()
+        goodput_mod.set_job_provider(None)
+        set_fleet_provider(None)
         self._server.stop(grace=1.0)
         if self._metrics_server is not None:
             self._metrics_server.stop()

@@ -9,6 +9,7 @@ RPC from the reference service (elastic_training.proto:243-299).
 import asyncio
 import json
 import os
+import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -115,6 +116,41 @@ class MasterServicer:
         self._method_metrics: Dict[
             str, Tuple[object, object]
         ] = {}
+        # --- job-scoped consumers (ISSUE 19) ------------------------
+        # the master's own job namespace: reports stamped with it (or
+        # "default") drive the primary speed monitor exactly as before;
+        # any OTHER job gets a lazily created monitor of its own, so
+        # straggler scoring and step-rate views never mix jobs
+        from dlrover_tpu.telemetry.journal import current_job_id
+
+        self._job = current_job_id()
+        self._job_monitors_lock = threading.Lock()
+        self._job_monitors: Dict[str, object] = {}
+
+    def speed_monitor_for(self, job: str):
+        """The speed monitor owning ``job``'s step stream: the primary
+        monitor for the master's own job (and the default namespace),
+        a per-job one otherwise."""
+        if not job or job == "default" or job == self._job:
+            return self._speed_monitor
+        with self._job_monitors_lock:
+            mon = self._job_monitors.get(job)
+            if mon is None:
+                from dlrover_tpu.master.monitor.speed_monitor import (
+                    SpeedMonitor,
+                )
+
+                mon = self._job_monitors[job] = SpeedMonitor()
+            return mon
+
+    def job_speed_monitors(self) -> Dict[str, object]:
+        """Job namespace -> monitor, primary job included — the Brain
+        advisor's per-job straggler/step-rate read surface."""
+        with self._job_monitors_lock:
+            out = dict(self._job_monitors)
+        if self._speed_monitor is not None:
+            out.setdefault(self._job, self._speed_monitor)
+        return out
 
     def _running_nodes(self):
         """Deferred node-list snapshot for the stats collector: only
@@ -747,16 +783,19 @@ class MasterServicer:
         purely the section application, shared by both lanes and the
         relay batch path."""
         action = ""
+        job = req.job_id or "default"
         if self._job_manager:
             action = self._job_manager.collect_node_heartbeat(
                 req.node_type, req.node_id, req.timestamp
             ) or ""
         if req.has_step and self._speed_monitor:
-            self._speed_monitor.collect_global_step(
+            monitor = self.speed_monitor_for(job)
+            monitor.collect_global_step(
                 req.step, req.step_ts or req.timestamp,
                 node_id=req.node_id,
             )
-            if self._job_metric_collector:
+            if self._job_metric_collector \
+                    and monitor is self._speed_monitor:
                 self._job_metric_collector.collect_runtime_stats(
                     self._speed_monitor, self._running_nodes,
                 )
@@ -769,6 +808,7 @@ class MasterServicer:
                 phases=req.goodput_phases,
                 phase=req.goodput_phase,
                 host=req.host, final=req.final,
+                job=job,
             )
         if req.has_resource and self._job_manager:
             self._job_manager.update_node_resource_usage(
@@ -781,6 +821,7 @@ class MasterServicer:
                 self._fleet.observe_digest(
                     req.metrics,
                     source=f"{req.node_type}-{req.node_id}",
+                    job=job,
                 )
         return action
 
@@ -879,12 +920,21 @@ class MasterServicer:
                 s.release()
 
     def _consume_relay_digest(self, req: comm.RelayBatchReport):
-        """Fold a relay's pre-merged digest — ONE summary per relay per
-        interval, however many agents it fronts."""
-        if self._fleet is not None and req.digest:
+        """Fold a relay's pre-merged digests — ONE summary per (relay,
+        job) per interval, however many agents it fronts. The legacy
+        single-digest field is the default job's."""
+        if self._fleet is None:
+            return
+        if req.digest:
             self._fleet.observe_digest(
                 req.digest, source=f"relay-{req.node_id}",
             )
+        for job, digest in (req.digests or {}).items():
+            if digest:
+                self._fleet.observe_digest(
+                    digest, source=f"relay-{req.node_id}",
+                    job=str(job),
+                )
 
     async def ingest_relay_batch_async(
         self, req: comm.RelayBatchReport

@@ -29,6 +29,10 @@ keeps spans stamped with a global step in the range, ``--proc <id>``
 keeps one process (matches the JAX process index or the OS pid).
 Cross-process flow arrows are recomputed over the surviving spans.
 
+``--job <id>`` (any mode) keeps one job's records when several jobs
+share a journal or trace dir (job-scoped telemetry, ISSUE 19):
+events/spans without a ``job`` stamp belong to job ``default``.
+
 Example::
 
     $ python -m dlrover_tpu.telemetry.dump /tmp/job.journal
@@ -120,13 +124,26 @@ def _parse_step_range(text: str):
     return (v, v)
 
 
+def filter_events_by_job(events: List[Dict], job: str) -> List[Dict]:
+    """``--job`` filter for journal events: an envelope without a
+    ``job`` field belongs to the default job (only non-default jobs
+    stamp the key — journal.py keeps single-job envelopes unchanged)."""
+    return [
+        e for e in events if (e.get("job") or "default") == job
+    ]
+
+
 def filter_spans(records: List[Dict], since: Optional[float] = None,
-                 steps=None, proc: Optional[int] = None) -> List[Dict]:
+                 steps=None, proc: Optional[int] = None,
+                 job: Optional[str] = None) -> List[Dict]:
     """Apply the --trace filters to raw span records (seconds-valued
     ``ts``). ``--step`` drops spans with no step stamp — a range query
     asks for the training timeline, unstamped setup spans are noise."""
     out = []
     for rec in records:
+        if job is not None \
+                and (rec.get("job") or "default") != job:
+            continue
         if since is not None and float(rec.get("ts", 0.0)) < since:
             continue
         if steps is not None:
@@ -146,7 +163,8 @@ def filter_spans(records: List[Dict], since: Optional[float] = None,
 
 def dump_trace(path: str, out: str = "",
                since: Optional[float] = None, steps=None,
-               proc: Optional[int] = None) -> int:
+               proc: Optional[int] = None,
+               job: Optional[str] = None) -> int:
     """Merge a span-trace directory (or one span file) into a single
     Chrome trace JSON; deterministic for fixed inputs. Filters run on
     the raw records, so flow arrows only connect surviving spans."""
@@ -158,9 +176,10 @@ def dump_trace(path: str, out: str = "",
         print(f"cannot read {path}: {e}", file=sys.stderr)
         return 2
     total = len(records)
-    if since is not None or steps is not None or proc is not None:
+    if since is not None or steps is not None or proc is not None \
+            or job is not None:
         records = filter_spans(
-            records, since=since, steps=steps, proc=proc
+            records, since=since, steps=steps, proc=proc, job=job
         )
         print(
             f"-- filters kept {len(records)}/{total} spans",
@@ -230,6 +249,11 @@ def main(argv=None) -> int:
         help="with --trace: keep one process (JAX process index or "
         "OS pid)",
     )
+    ap.add_argument(
+        "--job", default=None,
+        help="keep one job's events/spans (envelope 'job' field; "
+        "events without one belong to 'default')",
+    )
     args = ap.parse_args(argv)
     if args.as_trace:
         try:
@@ -246,17 +270,20 @@ def main(argv=None) -> int:
             return 2
         return dump_trace(
             args.journal, args.out, since=since, steps=steps,
-            proc=args.proc,
+            proc=args.proc, job=args.job,
         )
     try:
         events = read_journal(args.journal)
     except OSError as e:
         print(f"cannot read {args.journal}: {e}", file=sys.stderr)
         return 2
+    if args.job is not None:
+        events = filter_events_by_job(events, args.job)
     if args.as_goodput:
         from dlrover_tpu.telemetry.goodput import dump_goodput
 
-        print(dump_goodput(events, as_json=args.as_json))
+        print(dump_goodput(events, as_json=args.as_json,
+                           job=args.job))
         print(f"-- {len(events)} events replayed", file=sys.stderr)
         return 0
     out = render(events, kind=args.kind, as_json=args.as_json)
@@ -264,7 +291,8 @@ def main(argv=None) -> int:
         print(out)
     print(
         f"-- {len(events)} events"
-        + (f" (filter: {args.kind})" if args.kind else ""),
+        + (f" (filter: {args.kind})" if args.kind else "")
+        + (f" (job: {args.job})" if args.job else ""),
         file=sys.stderr,
     )
     return 0

@@ -60,6 +60,7 @@ __all__ = [
     "set_default_collector",
     "ENV_FLEET_DIGEST",
     "ENV_FLEET_MEM_MB",
+    "ENV_FLEET_TOPK",
     "ENV_SLO",
 ]
 
@@ -73,6 +74,22 @@ DEFAULT_FLEET_MEM_MB = 16
 
 #: declarative SLOs, ";"-separated ``name<=value`` / ``name>=value``
 ENV_SLO = "DLROVER_TPU_SLO"
+
+#: cap on the ``/fleet`` per-host breakdown: the top-k hosts by the
+#: sort metric (furthest behind the fleet-max step, then stalest)
+#: travel; the rest fold into an ``omitted_hosts`` count so a
+#: 10k-agent fleet cannot emit a multi-MB response (ISSUE 19 satellite)
+ENV_FLEET_TOPK = "DLROVER_TPU_FLEET_TOPK"
+DEFAULT_FLEET_TOPK = 16
+
+
+def fleet_topk() -> int:
+    try:
+        return int(
+            os.environ.get(ENV_FLEET_TOPK, "") or DEFAULT_FLEET_TOPK
+        )
+    except ValueError:
+        return DEFAULT_FLEET_TOPK
 
 
 def digests_enabled() -> bool:
@@ -477,6 +494,48 @@ def _series_summary(sk: HistogramSketch) -> Dict[str, Any]:
     }
 
 
+class _JobView:
+    """One job's slice of the fleet plane: its own store, counters,
+    host breakdown and source set. Created lazily on the first digest
+    or report stamped with a non-default ``job_id`` — single-job
+    deployments never allocate one. Guarded by the owning aggregator's
+    lock (the store has its own)."""
+
+    __slots__ = ("store", "counters", "sources", "hosts", "digests")
+
+    def __init__(self):
+        self.store = TimeSeriesStore()
+        self.counters: Dict[str, int] = {}
+        self.sources: Dict[str, float] = {}
+        self.hosts: Dict[str, Dict[str, Any]] = {}
+        self.digests = 0
+
+
+def _capped_hosts(hosts: Dict[str, Dict[str, Any]]
+                  ) -> Tuple[List[Dict[str, Any]], int]:
+    """Top-k per-host breakdown (ISSUE 19 satellite): when the fleet
+    exceeds ``DLROVER_TPU_FLEET_TOPK`` hosts, keep the ones furthest
+    behind the fleet-max step (the ones an operator is looking for),
+    stalest-first on ties, and report the rest as a count."""
+    entries = [dict(h) for h in hosts.values()]
+    topk = fleet_topk()
+    omitted = 0
+    if topk > 0 and len(entries) > topk:
+        lead = max(
+            (h["step"] for h in entries if h["step"] >= 0), default=-1
+        )
+        entries.sort(
+            key=lambda h: (
+                -(lead - h["step"]) if h["step"] >= 0 else 1,
+                h["last_seen"], h["host"],
+            )
+        )
+        omitted = len(entries) - topk
+        entries = entries[:topk]
+    entries.sort(key=lambda h: h["host"])
+    return entries, omitted
+
+
 class FleetAggregator:
     """Master-side consumer of the digest roll-ups.
 
@@ -485,7 +544,14 @@ class FleetAggregator:
     report sections the ingest plane already applies. Both are called
     on ingest shard executors — everything here takes the aggregator
     lock briefly and never calls out while holding it (lock-discipline:
-    journal/SLO work happens after the merge, outside the lock)."""
+    journal/SLO work happens after the merge, outside the lock).
+
+    Since ISSUE 19 both entry points take a ``job`` namespace: the
+    fleet-wide store/counters/hosts stay the merge across ALL jobs
+    (every pre-job view and SLO built-in reads them unchanged), and a
+    non-default job additionally folds into its own :class:`_JobView`
+    so ``snapshot(job=...)``, per-job SLO evaluation and the Brain
+    advisor attribute per job."""
 
     def __init__(self, store: Optional[TimeSeriesStore] = None,
                  slo: Optional["SLOEvaluator"] = None):
@@ -496,11 +562,21 @@ class FleetAggregator:
         self._sources: Dict[str, float] = {}
         self._hosts: Dict[str, Dict[str, Any]] = {}
         self._digests = 0
+        self._jobs: Dict[str, _JobView] = {}
 
     # ---------------------------------------------------------- ingestion
 
+    def _job_view_locked(self, job: str) -> Optional[_JobView]:
+        if not job or job == "default":
+            return None
+        view = self._jobs.get(job)
+        if view is None:
+            view = self._jobs[job] = _JobView()
+        return view
+
     def observe_digest(self, digest: Dict, source: str = "",
-                       ts: Optional[float] = None):
+                       ts: Optional[float] = None,
+                       job: str = "default"):
         if not digest or not isinstance(digest, dict):
             return
         now = ts if ts is not None else time.time()
@@ -519,12 +595,28 @@ class FleetAggregator:
                     )
                 except (ValueError, TypeError):
                     continue
-        # store has its own lock; never nest it under ours
+            view = self._job_view_locked(job)
+            if view is not None:
+                view.digests += 1
+                if source:
+                    view.sources[source] = now
+                for name, delta in (digest.get("c") or {}).items():
+                    try:
+                        view.counters[name] = (
+                            view.counters.get(name, 0) + int(delta)
+                        )
+                    except (ValueError, TypeError):
+                        continue
+        # stores have their own locks; never nest them under ours
         for name, sk in sketches:
             if sk.count:
                 self.store.add(name, now, sk)
+                if view is not None:
+                    view.store.add(name, now, sk)
         if self.slo is not None:
             self.slo.evaluate(self)
+            if view is not None:
+                self.slo.evaluate(self, job=job)
 
     def observe_report(self, report):
         """Per-host breakdown from sections the report already carries
@@ -532,33 +624,61 @@ class FleetAggregator:
         host = getattr(report, "host", "") or ""
         if not host:
             return
+        job = getattr(report, "job_id", "default") or "default"
         with self._lock:
-            entry = self._hosts.get(host)
-            if entry is None:
-                entry = self._hosts[host] = {
-                    "host": host, "step": -1, "step_ts": 0.0,
-                    "cpu_percent": 0.0, "memory_mb": 0,
-                    "last_seen": 0.0,
-                }
-            entry["last_seen"] = float(
-                getattr(report, "timestamp", 0.0) or time.time()
-            )
-            if getattr(report, "has_step", False):
-                entry["step"] = int(report.step)
-                entry["step_ts"] = float(report.step_ts)
-            if getattr(report, "has_resource", False):
-                entry["cpu_percent"] = float(report.cpu_percent)
-                entry["memory_mb"] = int(report.memory_mb)
-            if getattr(report, "final", False):
-                self._hosts.pop(host, None)
+            view = self._job_view_locked(job)
+            tables = [self._hosts]
+            if view is not None:
+                tables.append(view.hosts)
+            for table in tables:
+                entry = table.get(host)
+                if entry is None:
+                    entry = table[host] = {
+                        "host": host, "step": -1, "step_ts": 0.0,
+                        "cpu_percent": 0.0, "memory_mb": 0,
+                        "last_seen": 0.0,
+                    }
+                entry["last_seen"] = float(
+                    getattr(report, "timestamp", 0.0) or time.time()
+                )
+                if getattr(report, "has_step", False):
+                    entry["step"] = int(report.step)
+                    entry["step_ts"] = float(report.step_ts)
+                if getattr(report, "has_resource", False):
+                    entry["cpu_percent"] = float(report.cpu_percent)
+                    entry["memory_mb"] = int(report.memory_mb)
+                if getattr(report, "final", False):
+                    table.pop(host, None)
 
     # ------------------------------------------------------------- views
 
-    def stragglers(self, k: int = 5) -> List[Dict[str, Any]]:
-        """Top-k hosts furthest behind the fleet-max step — the
-        straggler view a 10k-agent job reads FIRST."""
+    def jobs(self) -> List[str]:
+        """Job namespaces with their own view (non-default only)."""
         with self._lock:
-            hosts = [dict(h) for h in self._hosts.values()
+            return sorted(self._jobs)
+
+    def store_for(self, job: Optional[str]) -> TimeSeriesStore:
+        """The fleet-wide store, or one job's slice of it (an empty
+        fresh store for an unknown job — absence reads as no data, not
+        an error)."""
+        if not job or job == "default":
+            return self.store
+        with self._lock:
+            view = self._jobs.get(job)
+        return view.store if view is not None else TimeSeriesStore()
+
+    def stragglers(self, k: int = 5,
+                   job: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Top-k hosts furthest behind the fleet-max step — the
+        straggler view a 10k-agent job reads FIRST. ``job`` scopes the
+        lead and the candidates to one job's hosts."""
+        with self._lock:
+            if job and job != "default":
+                view = self._jobs.get(job)
+                table = view.hosts if view is not None else {}
+            else:
+                table = self._hosts
+            hosts = [dict(h) for h in table.values()
                      if h["step"] >= 0]
         if not hosts:
             return []
@@ -572,10 +692,13 @@ class FleetAggregator:
             out.append(h)
         return out
 
-    def snapshot(self) -> Dict[str, Any]:
-        """The ``/fleet.json`` document: fleet-wide quantiles per
-        series, per-host breakdown, top-k stragglers, counters, SLO
-        state."""
+    def snapshot(self, job: Optional[str] = None) -> Dict[str, Any]:
+        """The ``/fleet.json`` document: quantiles per series, top-k
+        per-host breakdown, stragglers, counters, SLO state.
+        ``job=None`` is the fleet-wide merge across all jobs;
+        ``job="a"`` scopes every section to that job's view."""
+        if job and job != "default":
+            return self._job_snapshot(job)
         series: Dict[str, Any] = {}
         for name in self.store.series_names():
             sk = self.store.current(name)
@@ -583,23 +706,61 @@ class FleetAggregator:
                 series[name] = _series_summary(sk)
         with self._lock:
             counters = dict(self._counters)
-            hosts = sorted(
-                (dict(h) for h in self._hosts.values()),
-                key=lambda h: h["host"],
-            )
+            hosts, omitted = _capped_hosts(self._hosts)
             sources = len(self._sources)
             digests = self._digests
+            jobs = sorted(self._jobs)
         doc = {
             "series": series,
             "counters": counters,
             "hosts": hosts,
+            "omitted_hosts": omitted,
             "stragglers": self.stragglers(),
             "sources": sources,
             "digests": digests,
             "store_bytes": self.store.memory_bytes(),
         }
+        if jobs:
+            doc["jobs"] = jobs
         if self.slo is not None:
             doc["slo"] = self.slo.status()
+        return doc
+
+    def _job_snapshot(self, job: str) -> Dict[str, Any]:
+        with self._lock:
+            view = self._jobs.get(job)
+            if view is None:
+                hosts: List[Dict[str, Any]] = []
+                omitted = 0
+                counters: Dict[str, int] = {}
+                sources = 0
+                digests = 0
+            else:
+                counters = dict(view.counters)
+                hosts, omitted = _capped_hosts(view.hosts)
+                sources = len(view.sources)
+                digests = view.digests
+        series: Dict[str, Any] = {}
+        if view is not None:
+            for name in view.store.series_names():
+                sk = view.store.current(name)
+                if sk is not None:
+                    series[name] = _series_summary(sk)
+        doc = {
+            "job": job,
+            "series": series,
+            "counters": counters,
+            "hosts": hosts,
+            "omitted_hosts": omitted,
+            "stragglers": self.stragglers(job=job),
+            "sources": sources,
+            "digests": digests,
+            "store_bytes": (
+                view.store.memory_bytes() if view is not None else 0
+            ),
+        }
+        if self.slo is not None:
+            doc["slo"] = self.slo.status(job=job)
         return doc
 
 
@@ -635,7 +796,14 @@ class SLOEvaluator:
     state machine: crossing into violation journals ``slo.violated``
     (once) with the attributed cause; crossing back journals
     ``slo.recovered`` with the violation's duration. ``min_count``
-    gates quantile objectives so a 3-sample blip cannot page anyone."""
+    gates quantile objectives so a 3-sample blip cannot page anyone.
+
+    Objective state is keyed per ``(job, objective)`` since ISSUE 19:
+    ``evaluate(agg)`` drives the fleet-wide machines exactly as before,
+    ``evaluate(agg, job="a")`` drives job "a"'s own machines against
+    its :class:`_JobView` store — one job's violation never masks or
+    clears another's. Signals registered with a ``job``-accepting
+    callable serve both scopes; zero-arg signals stay fleet-only."""
 
     def __init__(self, spec: Optional[str] = None, min_count: int = 20):
         if spec is None:
@@ -643,39 +811,72 @@ class SLOEvaluator:
         self.objectives = _parse_objectives(spec)
         self._min_count = min_count
         self._lock = threading.Lock()
-        self._signals: Dict[str, Callable[[], Optional[float]]] = {}
+        self._signals: Dict[str, Callable[..., Optional[float]]] = {}
         self._attribution: Dict[
-            str, Callable[[], Dict[str, Any]]
+            str, Callable[..., Dict[str, Any]]
         ] = {}
-        #: objective -> violated_since_ts (absent = healthy)
+        #: signal/attribution callables that accept a ``job`` kwarg
+        self._job_aware: Dict[str, bool] = {}
+        #: (job-scoped) objective key -> violated_since_ts
+        #: (absent = healthy)
         self._violated: Dict[str, float] = {}
         self._last_values: Dict[str, float] = {}
 
+    @staticmethod
+    def _accepts_job(fn) -> bool:
+        import inspect
+
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            return False
+        for p in sig.parameters.values():
+            if p.kind is inspect.Parameter.VAR_KEYWORD:
+                return True
+            if p.name == "job":
+                return True
+        return False
+
+    @staticmethod
+    def _key(name: str, job: Optional[str]) -> str:
+        return name if not job else f"{job}:{name}"
+
     def register_signal(self, name: str,
                         fn: Optional[
-                            Callable[[], Optional[float]]
+                            Callable[..., Optional[float]]
                         ] = None,
                         attribution: Optional[
-                            Callable[[], Dict[str, Any]]
+                            Callable[..., Dict[str, Any]]
                         ] = None):
         """``fn=None`` keeps the built-in quantile value and attaches
         only the attribution provider (e.g. ``step_p99_ms`` reads the
-        store but blames the goodput ledger)."""
+        store but blames the goodput ledger). A callable accepting a
+        ``job`` keyword serves per-job evaluation too."""
         with self._lock:
             if fn is not None:
                 self._signals[name] = fn
+                self._job_aware[f"s:{name}"] = self._accepts_job(fn)
             if attribution is not None:
                 self._attribution[name] = attribution
+                self._job_aware[f"a:{name}"] = self._accepts_job(
+                    attribution
+                )
 
     # ---------------------------------------------------------- evaluate
 
-    def _value_of(self, name: str,
-                  aggregator: "FleetAggregator") -> Optional[float]:
+    def _value_of(self, name: str, aggregator: "FleetAggregator",
+                  job: Optional[str] = None) -> Optional[float]:
         with self._lock:
             fn = self._signals.get(name)
+            job_aware = self._job_aware.get(f"s:{name}", False)
         if fn is not None:
+            if job and not job_aware:
+                # fleet-only signal: this objective has no per-job
+                # meaning — skip it in job scope rather than evaluate
+                # the fleet value under a job's name
+                return None
             try:
-                return fn()
+                return fn(job=job) if job_aware else fn()
             except Exception:
                 return None
         # built-in: <series>_p99_ms / _p50_ms / _mean_ms over the
@@ -683,65 +884,79 @@ class SLOEvaluator:
         for suffix, q in (("_p99_ms", 0.99), ("_p90_ms", 0.9),
                           ("_p50_ms", 0.5)):
             if name.endswith(suffix):
-                sk = aggregator.store.current(name[: -len(suffix)])
+                store = (
+                    aggregator.store_for(job) if job
+                    else aggregator.store
+                )
+                sk = store.current(name[: -len(suffix)])
                 if sk is None or sk.count < self._min_count:
                     return None
                 return sk.quantile(q) * 1e3
         return None
 
-    def _attribute(self, name: str) -> Dict[str, Any]:
+    def _attribute(self, name: str,
+                   job: Optional[str] = None) -> Dict[str, Any]:
         with self._lock:
             fn = self._attribution.get(name)
+            job_aware = self._job_aware.get(f"a:{name}", False)
         if fn is None:
             return {}
         try:
-            out = fn()
+            out = fn(job=job) if (job and job_aware) else fn()
             return out if isinstance(out, dict) else {}
         except Exception:
             return {}
 
-    def evaluate(self, aggregator: "FleetAggregator"):
+    def evaluate(self, aggregator: "FleetAggregator",
+                 job: Optional[str] = None):
         now = time.time()
         for name, op, target in self.objectives:
-            value = self._value_of(name, aggregator)
+            value = self._value_of(name, aggregator, job=job)
             if value is None:
                 continue
             violated = (
                 value > target if op == "<=" else value < target
             )
+            key = self._key(name, job)
             with self._lock:
-                self._last_values[name] = value
-                was_since = self._violated.get(name)
+                self._last_values[key] = value
+                was_since = self._violated.get(key)
                 if violated and was_since is None:
-                    self._violated[name] = now
+                    self._violated[key] = now
                 elif not violated and was_since is not None:
-                    del self._violated[name]
+                    del self._violated[key]
+            scope = {"job": job} if job else {}
             if violated and was_since is None:
                 record(
                     "slo.violated", objective=name, op=op,
                     target=target, value=round(value, 3),
-                    **self._attribute(name),
+                    **scope, **self._attribute(name, job=job),
                 )
             elif not violated and was_since is not None:
                 record(
                     "slo.recovered", objective=name, target=target,
                     value=round(value, 3),
                     violated_s=round(now - was_since, 3),
+                    **scope,
                 )
 
-    def violated(self, name: str) -> bool:
+    def violated(self, name: str, job: Optional[str] = None) -> bool:
         with self._lock:
-            return name in self._violated
+            return self._key(name, job) in self._violated
 
-    def status(self) -> Dict[str, Any]:
+    def status(self, job: Optional[str] = None) -> Dict[str, Any]:
         with self._lock:
             return {
                 name: {
                     "op": op,
                     "target": target,
-                    "value": self._last_values.get(name),
-                    "violated": name in self._violated,
-                    "violated_since": self._violated.get(name),
+                    "value": self._last_values.get(
+                        self._key(name, job)
+                    ),
+                    "violated": self._key(name, job) in self._violated,
+                    "violated_since": self._violated.get(
+                        self._key(name, job)
+                    ),
                 }
                 for name, op, target in self.objectives
             }

@@ -428,20 +428,28 @@ class GoodputAggregator:
                        elapsed_s: float, phases: Dict[str, float],
                        phase: str = "", host: str = "",
                        final: bool = False,
-                       ts: Optional[float] = None) -> None:
+                       ts: Optional[float] = None,
+                       job: str = "default") -> None:
         """One process snapshot off the wire. Never raises."""
         try:
             self._observe(node_id, pid, start_ts, elapsed_s, phases,
-                          phase, host, final, ts)
+                          phase, host, final, ts, job)
         except Exception as e:
             logger.warning("goodput report dropped: %s", e)
 
     def _observe(self, node_id, pid, start_ts, elapsed_s, phases,
-                 phase, host, final, ts):
+                 phase, host, final, ts, job="default"):
         if not phases or start_ts <= 0:
             return
         ts = time.time() if ts is None else float(ts)
-        key = f"{int(node_id)}:{int(pid)}"
+        job = job or "default"
+        # default-job keys keep the pre-job shape so existing state
+        # journals restore verbatim; other jobs prefix theirs so two
+        # jobs reusing (node_id, pid) can never collide
+        key = (
+            f"{int(node_id)}:{int(pid)}" if job == "default"
+            else f"{job}/{int(node_id)}:{int(pid)}"
+        )
         with self._lock:
             if self._job_start is None or start_ts < self._job_start:
                 self._job_start = float(start_ts)
@@ -450,6 +458,7 @@ class GoodputAggregator:
                 open_prior = [
                     (k, e) for k, e in self._procs.items()
                     if e["node_id"] == int(node_id)
+                    and (e.get("job") or "default") == job
                     and not e.get("final_seen")
                 ]
                 if open_prior:
@@ -472,6 +481,7 @@ class GoodputAggregator:
             self._procs[key] = {
                 "node_id": int(node_id),
                 "pid": int(pid),
+                "job": job,
                 "host": host or "",
                 "start_ts": float(start_ts),
                 "elapsed_s": float(elapsed_s),
@@ -514,10 +524,27 @@ class GoodputAggregator:
 
     # ------------------------------------------------------------ summary
 
-    def summary(self) -> Dict[str, Any]:
+    def jobs(self) -> List[str]:
+        """Job namespaces with at least one observed process."""
         with self._lock:
-            procs = {k: dict(v) for k, v in self._procs.items()}
-            faults = [dict(f) for f in self._faults]
+            return sorted({
+                e.get("job") or "default"
+                for e in self._procs.values()
+            })
+
+    def summary(self, job: Optional[str] = None) -> Dict[str, Any]:
+        """The whole account, or one job's slice of it. Job-filtered
+        summaries keep un-attributed fault windows (a master restart
+        is every job's downtime) alongside the job's own."""
+        with self._lock:
+            procs = {
+                k: dict(v) for k, v in self._procs.items()
+                if job is None or (v.get("job") or "default") == job
+            }
+            faults = [
+                dict(f) for f in self._faults
+                if job is None or f.get("job") in (None, job)
+            ]
         return summarize(procs, faults)
 
     # -------------------------------------------------------- persistence
@@ -579,7 +606,14 @@ def summarize(procs: Dict[str, Dict[str, Any]],
     nodes: Dict[Any, Dict[str, Any]] = {}
     for p in procs.values():
         end = p["start_ts"] + p["elapsed_s"]
-        node = nodes.setdefault(p["node_id"], {
+        # two jobs may reuse the same node_id space; namespace the
+        # node key for non-default jobs so their accounts never merge
+        job = p.get("job") or "default"
+        node_key = (
+            p["node_id"] if job == "default"
+            else f"{job}/{p['node_id']}"
+        )
+        node = nodes.setdefault(node_key, {
             "first_start": p["start_ts"], "last_end": end,
             "covered_s": 0.0,
             "phases": {ph: 0.0 for ph in PHASES},
@@ -672,24 +706,35 @@ def summarize(procs: Dict[str, Dict[str, Any]],
 # ------------------------------------------------------------ HTTP surface
 
 
-def set_job_provider(fn: Optional[Callable[[], Dict]]) -> None:
+def set_job_provider(fn: Optional[Callable[..., Dict]]) -> None:
     """The master installs its aggregator's ``summary`` here so
-    ``/goodput`` serves the job view; None clears (tests, stop)."""
+    ``/goodput`` serves the job view; None clears (tests, stop). A
+    provider accepting a ``job`` keyword serves ``/goodput?job=``."""
     global _job_provider
     with _state_lock:
         _job_provider = fn
 
 
-def http_payload() -> Dict[str, Any]:
+def http_payload(job: Optional[str] = None) -> Dict[str, Any]:
     """What ``GET /goodput`` returns: the job account where a provider
-    is installed (the master), always the local process ledger."""
+    is installed (the master), always the local process ledger.
+    ``job`` scopes the provider's account to one job namespace."""
     out: Dict[str, Any] = {"local": local_snapshot()}
     fn = _job_provider
     if fn is not None:
         try:
-            out.update(fn())
+            out.update(fn(job=job) if job else fn())
+        except TypeError:
+            # pre-job provider: serve its fleet-wide account rather
+            # than erroring a scoped query
+            try:
+                out.update(fn())
+            except Exception as e:
+                out["error"] = str(e)
         except Exception as e:
             out["error"] = str(e)
+    if job:
+        out["job_id"] = job
     return out
 
 
@@ -714,7 +759,8 @@ def _node_of(events: List[Dict[str, Any]]) -> int:
     return int(events[0].get("pid", 0) or 0) if events else 0
 
 
-def reconstruct(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+def reconstruct(events: List[Dict[str, Any]],
+                job: Optional[str] = None) -> Dict[str, Any]:
     """Rebuild the goodput account from a journal's event list.
 
     Processes that journaled ``goodput.*`` breadcrumbs replay exactly
@@ -723,7 +769,15 @@ def reconstruct(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     :data:`EVENT_RULES`. Fault windows come from the events themselves
     (``fault.injected``/``fault.reported`` opened, next step /
     ``master.restored`` closure heuristics), so MTTR/MTBF exist even
-    for runs that never ran the live aggregator."""
+    for runs that never ran the live aggregator. ``job`` filters to
+    one job's envelope namespace (an envelope without a ``job`` field
+    is the default job), so a shared journal splits back into per-job
+    accounts."""
+    if job is not None:
+        events = [
+            e for e in events
+            if (e.get("job") or "default") == job
+        ]
     by_proc: Dict[Tuple[str, int], List[Dict]] = {}
     for e in events:
         by_proc.setdefault(_proc_key(e), []).append(e)
@@ -743,6 +797,9 @@ def reconstruct(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         procs[f"{host}:{pid}"] = {
             "node_id": _node_of(evts),
             "pid": pid,
+            "job": next(
+                (e["job"] for e in evts if e.get("job")), "default"
+            ),
             "host": host,
             "start_ts": snap["start_ts"],
             "elapsed_s": snap["elapsed_s"],
@@ -990,8 +1047,9 @@ def render_report(report: Dict[str, Any]) -> str:
 
 
 def dump_goodput(events: List[Dict[str, Any]],
-                 as_json: bool = False) -> str:
-    report = reconstruct(events)
+                 as_json: bool = False,
+                 job: Optional[str] = None) -> str:
+    report = reconstruct(events, job=job)
     if as_json:
         return json.dumps(report, default=str, sort_keys=True)
     return render_report(report)

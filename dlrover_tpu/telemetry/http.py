@@ -144,9 +144,11 @@ _fleet_provider = None  # () -> dict (FleetAggregator.snapshot document)
 
 def set_fleet_provider(fn) -> None:
     """Install the process-wide fleet snapshot provider backing
-    ``/fleet``: a zero-arg callable returning the snapshot document
+    ``/fleet``: a callable returning the snapshot document
     (:meth:`~dlrover_tpu.telemetry.fleet.FleetAggregator.snapshot`).
-    None clears it."""
+    A provider accepting a ``job`` keyword serves ``/fleet?job=``
+    per-job views (ISSUE 19); a zero-arg provider keeps working and
+    answers every query fleet-wide. None clears it."""
     global _fleet_provider
     with _fleet_lock:
         _fleet_provider = fn
@@ -330,10 +332,11 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/goodput":
             from dlrover_tpu.telemetry import goodput
 
+            job = (parse_qs(url.query).get("job") or [None])[0]
             self._send(
                 200,
                 json.dumps(
-                    goodput.http_payload(), default=str
+                    goodput.http_payload(job=job), default=str
                 ).encode(),
                 "application/json",
             )
@@ -345,8 +348,17 @@ class _Handler(BaseHTTPRequestHandler):
                     "application/json",
                 )
             else:
+                job = (parse_qs(url.query).get("job") or [None])[0]
                 try:
-                    doc = provider() or {}
+                    if job:
+                        try:
+                            doc = provider(job=job) or {}
+                        except TypeError:
+                            # pre-job provider: fleet-wide answer
+                            # beats a 500 on a scoped query
+                            doc = provider() or {}
+                    else:
+                        doc = provider() or {}
                 except Exception as e:
                     logger.warning("fleet snapshot failed: %s", e)
                     doc = {"error": str(e)}

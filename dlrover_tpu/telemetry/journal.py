@@ -42,6 +42,13 @@ from dlrover_tpu.common.log import default_logger as logger
 
 ENV_JOURNAL = "DLROVER_TPU_JOURNAL"
 
+#: job namespace (ISSUE 19): processes launched for a named job stamp
+#: a ``job`` field into every envelope so one shared journal file can
+#: be split back into per-job timelines (``dump --job``). Unset or
+#: ``"default"`` keeps the envelope byte-identical to the pre-job
+#: format.
+ENV_JOB_ID = "DLROVER_TPU_JOB_ID"
+
 #: size cap (MB) on the backing JSONL file; past it the file is
 #: atomically renamed to ``<path>.1`` (replacing the previous ``.1``)
 #: and a fresh file begins with a ``journal.rotated`` event, so a
@@ -59,7 +66,9 @@ _RESYNC_EVERY = 128
 __all__ = [
     "ENV_JOURNAL",
     "ENV_JOURNAL_MAX_MB",
+    "ENV_JOB_ID",
     "EventJournal",
+    "current_job_id",
     "default_journal",
     "set_default_journal",
     "configure",
@@ -97,6 +106,12 @@ def remove_tap(fn) -> None:
             _taps.remove(fn)
 
 
+def current_job_id() -> str:
+    """This process's job namespace (``DLROVER_TPU_JOB_ID``), or
+    ``"default"`` — the identity every job-scoped consumer keys on."""
+    return os.getenv(ENV_JOB_ID, "") or "default"
+
+
 def _notify_taps(event: Dict[str, Any]) -> None:
     with _taps_lock:
         taps = list(_taps)
@@ -118,6 +133,8 @@ class EventJournal:
         self._ring: deque = deque(maxlen=capacity)
         self._fd: Optional[int] = None
         self._host = socket.gethostname()
+        job = os.getenv(ENV_JOB_ID, "") or ""
+        self._job = job if job != "default" else ""
         if max_bytes is None:
             try:
                 max_mb = float(
@@ -160,6 +177,8 @@ class EventJournal:
                 "kind": kind,
                 "data": dict(fields),
             }
+            if self._job:
+                event["job"] = self._job
             self._ring.append(event)
             if self._fd is not None:
                 try:
@@ -315,22 +334,33 @@ def record(kind: str, **fields: Any) -> Dict[str, Any]:
     return default_journal().record(kind, **fields)
 
 
-def read_journal(path: str) -> List[Dict[str, Any]]:
-    """Parse a JSONL journal file; unparseable lines (a torn write from
-    a crashed process) are skipped, not fatal. Ordered by ``(ts, pid,
-    seq)`` so multi-process appends interleave into one timeline. A
-    rotated predecessor (``<path>.1``, see ``ENV_JOURNAL_MAX_MB``) is
-    stitched in front, so consumers read across the rotation boundary
-    without knowing it exists."""
-    events = []
+def _open_for_read(p: str):
+    # indirection point: the rotation-race regression test swaps this
+    # to rotate the file between the two opens of a stitching pass
+    return open(p, "r")
+
+
+def _read_stitched_once(path: str):
+    """One stitching pass over ``<path>.1`` + ``<path>``. Returns
+    ``(events, opened, ino_of_dot1)`` where ``ino_of_dot1`` is the
+    inode of the rotated predecessor actually read (None if absent) —
+    the caller compares it against a post-pass stat to detect a
+    rotation that happened between the two opens."""
+    events: List[Dict[str, Any]] = []
     opened = False
+    dot1_ino = None
     for p in (path + ".1", path):
         try:
-            f = open(p, "r")
+            f = _open_for_read(p)
         except OSError:
             continue
         opened = True
         with f:
+            if p.endswith(".1"):
+                try:
+                    dot1_ino = os.fstat(f.fileno()).st_ino
+                except OSError:
+                    pass
             for line in f:
                 line = line.strip()
                 if not line:
@@ -339,6 +369,32 @@ def read_journal(path: str) -> List[Dict[str, Any]]:
                     events.append(json.loads(line))
                 except json.JSONDecodeError:
                     continue
+    return events, opened, dot1_ino
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL journal file; unparseable lines (a torn write from
+    a crashed process) are skipped, not fatal. Ordered by ``(ts, pid,
+    seq)`` so multi-process appends interleave into one timeline. A
+    rotated predecessor (``<path>.1``, see ``ENV_JOURNAL_MAX_MB``) is
+    stitched in front, so consumers read across the rotation boundary
+    without knowing it exists.
+
+    A rotation can also land BETWEEN the two opens of one stitching
+    pass: the pass then reads the pre-rotation ``.1`` (or none) plus
+    the fresh post-rotation file, silently dropping the rotated tail.
+    Detected by re-statting ``.1`` after the pass — a changed inode
+    means the pass straddled a rotation, and the read retries once
+    (ISSUE 19 satellite bugfix)."""
+    events, opened, read_ino = _read_stitched_once(path)
+    try:
+        now_ino = os.stat(path + ".1").st_ino
+    except OSError:
+        now_ino = None
+    if now_ino is not None and now_ino != read_ino:
+        retry_events, retry_opened, _ = _read_stitched_once(path)
+        if retry_opened:
+            events, opened = retry_events, True
     if not opened:
         # neither the file nor a rotated predecessor: keep the
         # pre-rotation contract (callers report the missing path)

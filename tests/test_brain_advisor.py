@@ -1,0 +1,331 @@
+"""The explainable resource advisor (ISSUE 19, brain/advisor.py).
+
+Each rule is tested where its contract lives: the metric values it
+reads, the proposal it emits, and — the point of the module — the
+journaled evidence chain that lets ``dump --kind brain`` replay
+exactly why. Advise-mode actuation must route through the scaler's
+guarded path and leave a complete adopted/rejected audit trail.
+"""
+
+import time
+
+from dlrover_tpu.brain import advisor as advisor_mod
+from dlrover_tpu.brain.advisor import (
+    MODE_ADVISE,
+    MODE_OBSERVE,
+    MODE_OFF,
+    ResourceAdvisor,
+    advisor_mode,
+)
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.telemetry.fleet import FleetAggregator, TimeSeriesStore
+from dlrover_tpu.telemetry.goodput import Phase
+from dlrover_tpu.telemetry.journal import (
+    EventJournal,
+    default_journal,
+    set_default_journal,
+)
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_journal():
+    set_default_journal(EventJournal())
+    yield
+    set_default_journal(EventJournal())
+
+
+def _events(kind):
+    return default_journal().events(kind)
+
+
+def _summary(goodput_percent=50.0, wall_s=100.0, procs=2, nodes=2,
+             badput=None, faults=0):
+    return {"job": {
+        "wall_s": wall_s, "procs": procs, "nodes": nodes,
+        "goodput_percent": goodput_percent,
+        "badput_s": badput or {}, "faults": faults,
+    }}
+
+
+class FakeGoodput:
+    def __init__(self, per_job):
+        self.per_job = per_job
+
+    def jobs(self):
+        return sorted(self.per_job)
+
+    def summary(self, job=None):
+        return self.per_job.get(job or "default", {"job": {}})
+
+
+class FakeMonitor:
+    def __init__(self, workers=4, speed=8.0):
+        self.running_workers = {("worker", i) for i in range(workers)}
+        self._target_worker_num = workers
+        self._speed = speed
+
+    def running_speed(self):
+        return self._speed
+
+
+class FakeQuarantine:
+    def __init__(self, hosts):
+        self._hosts = list(hosts)
+
+    def quarantined_hosts(self):
+        return list(self._hosts)
+
+
+# ------------------------------------------------------------------- rules
+
+
+def test_shrink_rule_fires_with_evidence_chain():
+    """A job burning >threshold% of wall in ckpt_stall + rendezvous
+    proposes a shrink; the journaled event carries the full evidence
+    chain (window, metric values, rule, expected delta)."""
+    gp = FakeGoodput({"a": _summary(
+        goodput_percent=55.0,
+        badput={Phase.CKPT_STALL: 30.0, Phase.RENDEZVOUS: 10.0},
+    )})
+    adv = ResourceAdvisor(
+        goodput=gp, speed_monitors_fn=lambda: {"a": FakeMonitor(4)},
+        local_job="a", node_unit=2, mode=MODE_OBSERVE, interval=0,
+    )
+    plans = adv.step(now=1000.0)
+    assert [p["action"] for p in plans] == ["shrink"]
+    p = plans[0]
+    assert p["rule"] == "shrink_badput" and p["job"] == "a"
+    assert p["target_nodes"] == 2  # 4 workers - node_unit
+    assert p["expected_goodput_delta"] == pytest.approx(40.0)
+    ev = _events("brain.plan_proposed")
+    assert len(ev) == 1
+    d = ev[0]["data"]
+    assert d["rule"] == "shrink_badput" and d["action"] == "shrink"
+    assert d["evidence_stall_pct"] == pytest.approx(40.0)
+    assert d["evidence_ckpt_stall_s"] == 30.0
+    assert d["evidence_rendezvous_s"] == 10.0
+    assert d["evidence_window_s"] == 100.0
+    assert d["evidence_threshold_pct"] == 25.0
+    assert d["mode"] == MODE_OBSERVE
+
+
+def test_shrink_rule_quiet_below_threshold():
+    gp = FakeGoodput({"a": _summary(
+        goodput_percent=85.0, badput={Phase.CKPT_STALL: 10.0},
+    )})
+    adv = ResourceAdvisor(goodput=gp, local_job="a",
+                          mode=MODE_OBSERVE, interval=0)
+    assert adv.step(now=1000.0) == []
+    assert _events("brain.plan_proposed") == []
+
+
+def test_grow_rule_requires_scaling_curve_and_no_stragglers():
+    """Grow fires only for a straggler-free job at high goodput whose
+    per-worker step rate held up — the advisor needs two speed
+    observations before it will extrapolate."""
+    gp = FakeGoodput({"a": _summary(goodput_percent=95.0)})
+    fleet_agg = FleetAggregator(store=TimeSeriesStore(max_mb=4))
+    mon = FakeMonitor(workers=4, speed=8.0)
+    adv = ResourceAdvisor(
+        fleet=fleet_agg, goodput=gp,
+        speed_monitors_fn=lambda: {"a": mon},
+        local_job="a", node_unit=1, mode=MODE_OBSERVE, interval=0,
+    )
+    # first pass only seeds the curve: no proposal yet
+    assert adv.step(now=1000.0) == []
+    plans = adv.step(now=1200.0)
+    assert [p["rule"] for p in plans] == ["grow_scaling"]
+    p = plans[0]
+    assert p["action"] == "grow" and p["target_nodes"] == 5
+    assert p["expected_goodput_delta"] > 0
+    d = _events("brain.plan_proposed")[0]["data"]
+    assert d["evidence_scaling_retention"] == pytest.approx(1.0)
+    assert d["evidence_workers"] == 4
+    # a degraded curve (per-worker rate fell 20%) stops proposing
+    mon2 = FakeMonitor(workers=4, speed=8.0)
+    adv2 = ResourceAdvisor(
+        fleet=fleet_agg, goodput=gp,
+        speed_monitors_fn=lambda: {"a": mon2},
+        local_job="a", mode=MODE_OBSERVE, interval=0,
+    )
+    adv2.step(now=1000.0)
+    mon2._speed = 6.0
+    assert adv2.step(now=1200.0) == []
+    # a straggler parks the grow even with a healthy curve
+    fleet_agg.observe_report(comm.NodeStatusReport(
+        node_id=0, node_type=NodeType.WORKER, timestamp=time.time(),
+        host="host-0", has_step=True, step=10, step_ts=time.time(),
+        job_id="a",
+    ))
+    fleet_agg.observe_report(comm.NodeStatusReport(
+        node_id=1, node_type=NodeType.WORKER, timestamp=time.time(),
+        host="host-1", has_step=True, step=90, step_ts=time.time(),
+        job_id="a",
+    ))
+    adv3 = ResourceAdvisor(
+        fleet=fleet_agg, goodput=gp,
+        speed_monitors_fn=lambda: {"a": FakeMonitor(4, 8.0)},
+        local_job="a", mode=MODE_OBSERVE, interval=0,
+    )
+    adv3.step(now=1000.0)
+    assert adv3.step(now=1200.0) == []
+
+
+def test_reclaim_rule_flags_quarantined_host_still_reporting():
+    fleet_agg = FleetAggregator(store=TimeSeriesStore(max_mb=4))
+    fleet_agg.observe_report(comm.NodeStatusReport(
+        node_id=7, node_type=NodeType.WORKER, timestamp=time.time(),
+        host="host-7", has_step=True, step=50, step_ts=time.time(),
+    ))
+    gp = FakeGoodput({"default": _summary(
+        badput={Phase.RESTART: 20.0}, faults=3,
+    )})
+    adv = ResourceAdvisor(
+        fleet=fleet_agg, goodput=gp,
+        quarantine=FakeQuarantine(["host-7"]),
+        mode=MODE_OBSERVE, interval=0,
+    )
+    plans = adv.step(now=1000.0)
+    assert [p["rule"] for p in plans] == ["reclaim_quarantine"]
+    p = plans[0]
+    assert p["action"] == "reclaim" and p["host"] == "host-7"
+    assert p["expected_goodput_delta"] == pytest.approx(20.0)
+    d = _events("brain.plan_proposed")[0]["data"]
+    assert d["host"] == "host-7"
+    assert d["evidence_quarantined"] and d["evidence_still_reporting"]
+    assert d["evidence_restart_badput_s"] == 20.0
+    # an evicted (no longer reporting) host stops proposing
+    fleet_agg.observe_report(comm.NodeStatusReport(
+        node_id=7, node_type=NodeType.WORKER, timestamp=time.time(),
+        host="host-7", final=True,
+    ))
+    adv2 = ResourceAdvisor(
+        fleet=fleet_agg, goodput=gp,
+        quarantine=FakeQuarantine(["host-7"]),
+        mode=MODE_OBSERVE, interval=0,
+    )
+    assert adv2.step(now=2000.0) == []
+
+
+# --------------------------------------------------------- cadence/cooldown
+
+
+def test_proposal_cooldown_and_step_rate_limit():
+    gp = FakeGoodput({"a": _summary(
+        badput={Phase.CKPT_STALL: 40.0},
+    )})
+    adv = ResourceAdvisor(goodput=gp, local_job="a",
+                          mode=MODE_OBSERVE, interval=30)
+    adv.maybe_step(now=1000.0)
+    # within the interval: the beat is a no-op
+    adv.maybe_step(now=1010.0)
+    assert len(_events("brain.plan_proposed")) == 1
+    # past the interval but inside the per-(job, action) cooldown
+    # (default 120s): the persistent condition does not re-journal
+    adv.maybe_step(now=1040.0)
+    assert len(_events("brain.plan_proposed")) == 1
+    adv.maybe_step(now=1200.0)
+    assert len(_events("brain.plan_proposed")) == 2
+
+
+def test_off_mode_disables_everything():
+    gp = FakeGoodput({"a": _summary(
+        badput={Phase.CKPT_STALL: 40.0},
+    )})
+    adv = ResourceAdvisor(goodput=gp, local_job="a", mode=MODE_OFF,
+                          interval=0)
+    adv.start()
+    adv.maybe_step(now=1000.0)
+    assert _events("brain.advisor_started") == []
+    assert _events("brain.plan_proposed") == []
+
+
+def test_advisor_mode_env_parsing(monkeypatch):
+    for raw, want in (
+        ("", MODE_OBSERVE), ("observe", MODE_OBSERVE),
+        ("shadow", MODE_OBSERVE), ("advise", MODE_ADVISE),
+        ("ADVISE", MODE_ADVISE), ("off", MODE_OFF),
+        ("0", MODE_OFF), ("nonsense", MODE_OFF),
+    ):
+        monkeypatch.setenv(advisor_mod.ENV_BRAIN, raw)
+        assert advisor_mode() == want, raw
+    monkeypatch.delenv(advisor_mod.ENV_BRAIN)
+    assert advisor_mode() == MODE_OBSERVE
+
+
+# ---------------------------------------------------------------- actuation
+
+
+def test_advise_mode_routes_local_job_through_scaler():
+    gp = FakeGoodput({"a": _summary(
+        badput={Phase.CKPT_STALL: 40.0},
+    )})
+    scaled = []
+    adv = ResourceAdvisor(
+        goodput=gp, speed_monitors_fn=lambda: {"a": FakeMonitor(4)},
+        scale_fn=lambda n: (scaled.append(n), True)[1],
+        local_job="a", node_unit=1, mode=MODE_ADVISE, interval=0,
+    )
+    adv.start()
+    assert _events("brain.advisor_started")[0]["data"]["mode"] == \
+        MODE_ADVISE
+    adv.step(now=1000.0)
+    assert scaled == [3]  # 4 workers - 1 unit, via manual_scale guards
+    adopted = _events("brain.plan_adopted")
+    assert len(adopted) == 1
+    assert adopted[0]["data"]["target_nodes"] == 3
+    assert _events("brain.plan_rejected") == []
+
+
+def test_advise_mode_rejects_nonlocal_and_failed_scales():
+    """A sibling job's plan and a declined/crashed scale are journaled
+    as rejected with the reason — the audit trail is complete."""
+    gp = FakeGoodput({
+        "a": _summary(badput={Phase.CKPT_STALL: 40.0}),
+        "b": _summary(badput={Phase.CKPT_STALL: 60.0}),
+    })
+    adv = ResourceAdvisor(
+        goodput=gp, speed_monitors_fn=lambda: {},
+        scale_fn=lambda n: (_ for _ in ()).throw(RuntimeError("no")),
+        local_job="a", mode=MODE_ADVISE, interval=0,
+    )
+    adv.step(now=1000.0)
+    rejected = {
+        e["data"]["job"]: e["data"]["reason"]
+        for e in _events("brain.plan_rejected")
+    }
+    assert rejected["a"] == "scaler_declined"  # scale_fn raised
+    assert rejected["b"] == "job_not_local"
+    assert _events("brain.plan_adopted") == []
+
+
+def test_observe_mode_never_touches_the_scaler():
+    gp = FakeGoodput({"a": _summary(
+        badput={Phase.CKPT_STALL: 40.0},
+    )})
+    scaled = []
+    adv = ResourceAdvisor(
+        goodput=gp, scale_fn=lambda n: (scaled.append(n), True)[1],
+        local_job="a", mode=MODE_OBSERVE, interval=0,
+    )
+    adv.step(now=1000.0)
+    assert len(_events("brain.plan_proposed")) == 1
+    assert scaled == []
+    assert _events("brain.plan_adopted") == []
+
+
+def test_rule_crash_never_escapes_maybe_step():
+    class ExplodingGoodput:
+        def jobs(self):
+            return ["a"]
+
+        def summary(self, job=None):
+            raise RuntimeError("ledger on fire")
+
+    adv = ResourceAdvisor(goodput=ExplodingGoodput(), local_job="a",
+                          mode=MODE_OBSERVE, interval=0)
+    adv.maybe_step(now=1000.0)  # must not raise
+    assert _events("brain.plan_proposed") == []

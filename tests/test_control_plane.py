@@ -442,3 +442,14 @@ def test_swarm_bench_smoke():
     assert 0 < result["fleet_sources"] <= 2
     assert result["fleet_digests"] > 0
     assert result["fleet_digest_ratio"] <= 2.0
+    # the job axis (ISSUE 19, --smoke forces --jobs 2): every job
+    # namespace materializes ITS OWN quantiles from the same relay
+    # pre-merge, still with zero per-agent scrapes
+    assert result["fleet_jobs"] == 2
+    assert set(result["fleet_job_step_counts"]) == {"job-0", "job-1"}
+    assert all(
+        c > 0 for c in result["fleet_job_step_counts"].values()
+    )
+    assert all(
+        p > 0.0 for p in result["fleet_job_step_p99_ms"].values()
+    )

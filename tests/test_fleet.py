@@ -284,6 +284,103 @@ def test_aggregator_host_breakdown_and_stragglers():
                for h in agg.snapshot()["hosts"])
 
 
+def _host_report(node_id, step, job_id="default", ts=None):
+    return comm.NodeStatusReport(
+        node_id=node_id, node_type=NodeType.WORKER,
+        timestamp=ts or time.time(), host=f"host-{node_id}",
+        has_step=True, step=step, step_ts=ts or time.time(),
+        job_id=job_id,
+    )
+
+
+def test_fleet_host_breakdown_capped_at_topk(monkeypatch):
+    """ISSUE 19 satellite: /fleet's per-host breakdown is bounded. A
+    10k-host fleet serves the top-k hosts by the straggler sort metric
+    (furthest behind the lead step) plus an ``omitted_hosts`` count —
+    never an unbounded multi-MB document."""
+    monkeypatch.setenv(fleet.ENV_FLEET_TOPK, "4")
+    agg = FleetAggregator(store=TimeSeriesStore(max_mb=4))
+    for node_id in range(10):
+        # host-0 leads at step 100, host-9 is furthest behind
+        agg.observe_report(_host_report(node_id, 100 - node_id * 10))
+    snap = agg.snapshot()
+    assert len(snap["hosts"]) == 4
+    assert snap["omitted_hosts"] == 6
+    # the kept entries are the operators' hosts-of-interest: the ones
+    # furthest behind the fleet-max step
+    kept = {h["host"] for h in snap["hosts"]}
+    assert kept == {"host-6", "host-7", "host-8", "host-9"}
+    # output stays host-sorted for stable diffing
+    assert [h["host"] for h in snap["hosts"]] == sorted(kept)
+    # raising the cap above the fleet size disables omission
+    monkeypatch.setenv(fleet.ENV_FLEET_TOPK, "64")
+    snap = agg.snapshot()
+    assert len(snap["hosts"]) == 10 and snap["omitted_hosts"] == 0
+
+
+def test_aggregator_job_views_never_cross_contaminate():
+    """ISSUE 19 tentpole: digests and reports stamped with a job land
+    in that job's view AND the fleet-wide merge — never in a sibling
+    job's. The fleet-wide snapshot keeps pre-job semantics."""
+    agg = FleetAggregator(store=TimeSeriesStore(max_mb=4))
+    ca, cb = DigestCollector(), DigestCollector()
+    for _ in range(30):
+        ca.observe("step", 0.1)
+        ca.incr("steps")
+        cb.observe("step", 0.4)
+        cb.incr("steps")
+    agg.observe_digest(ca.compose(), source="relay-0", job="a")
+    agg.observe_digest(cb.compose(), source="relay-0", job="b")
+    agg.observe_report(_host_report(0, 50, job_id="a"))
+    agg.observe_report(_host_report(1, 90, job_id="b"))
+    assert agg.jobs() == ["a", "b"]
+    sa, sb = agg.snapshot(job="a"), agg.snapshot(job="b")
+    assert sa["counters"] == {"steps": 30}
+    assert sb["counters"] == {"steps": 30}
+    assert sa["series"]["step"]["count"] == 30
+    # job a's quantiles come from ITS samples only (0.1s ≈ 100ms)
+    assert sa["series"]["step"]["p99_ms"] < 150.0
+    assert sb["series"]["step"]["p99_ms"] > 300.0
+    assert [h["host"] for h in sa["hosts"]] == ["host-0"]
+    assert [h["host"] for h in sb["hosts"]] == ["host-1"]
+    # per-job straggler lead is per job: host-0 IS job a's lead, so it
+    # is not behind anyone
+    assert agg.stragglers(job="a")[0]["behind"] == 0
+    # the fleet-wide view is the merge across jobs
+    snap = agg.snapshot()
+    assert snap["counters"] == {"steps": 60}
+    assert snap["series"]["step"]["count"] == 60
+    assert {h["host"] for h in snap["hosts"]} == {"host-0", "host-1"}
+    assert snap["jobs"] == ["a", "b"]
+    # an unknown job reads as empty, not an error
+    empty = agg.snapshot(job="ghost")
+    assert empty["hosts"] == [] and empty["series"] == {}
+
+
+def test_slo_state_is_job_scoped():
+    """Per-job SLO machines (ISSUE 19): job a's violation neither
+    fires nor clears job b's, and the fleet-wide machine is
+    independent of both."""
+    slo = SLOEvaluator(spec="step_p99_ms<=50")
+    agg = FleetAggregator(store=TimeSeriesStore(max_mb=4), slo=slo)
+    t0 = 6_000_000
+    ca = DigestCollector()
+    for _ in range(30):
+        ca.observe("step", 0.2)  # 200ms: violates
+    agg.observe_digest(ca.compose(), source="r", ts=t0, job="a")
+    cb = DigestCollector()
+    for _ in range(30):
+        cb.observe("step", 0.01)  # 10ms: healthy
+    agg.observe_digest(cb.compose(), source="r", ts=t0, job="b")
+    assert slo.violated("step_p99_ms", job="a")
+    assert not slo.violated("step_p99_ms", job="b")
+    violated = [e["data"] for e in _events("slo.violated")]
+    assert {v.get("job") for v in violated} >= {"a"}
+    assert all(v.get("job") != "b" for v in violated)
+    assert slo.status(job="a")["step_p99_ms"]["violated"]
+    assert not slo.status(job="b")["step_p99_ms"]["violated"]
+
+
 # --------------------------------------------------------------------- SLO
 
 
@@ -408,7 +505,7 @@ def test_relay_premerges_digests_and_master_consumes():
         )
         relay._forward_once()
         assert agg.snapshot()["digests"] == 0
-        assert relay._inflight_digest  # parked, not dropped
+        assert relay._inflight_digests  # parked, not dropped
         # interval 2: upstream back — ONE batch carries the merged
         # digest of both agents
         batches = []
@@ -422,7 +519,7 @@ def test_relay_premerges_digests_and_master_consumes():
         assert snap["digests"] == 1 and snap["counters"] == {"steps": 50}
         assert snap["series"]["step"]["count"] == 50
         assert snap["sources"] == 1  # ONE relay source, not 2 agents
-        assert not relay._inflight_digest  # acked: cleared
+        assert not relay._inflight_digests  # acked: cleared
         # interval 3: nothing new — no digest travels
         relay._forward_once()
         assert len(batches) == 1 or not batches[-1].digest

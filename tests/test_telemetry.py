@@ -229,6 +229,66 @@ def test_journal_resync_follows_sibling_rotation(tmp_path):
     assert -1 not in live_is  # pre-rotation events stayed in the .1
 
 
+def test_read_journal_survives_rotation_mid_stitch(tmp_path,
+                                                   monkeypatch):
+    """ISSUE 19 satellite bugfix: a rotation landing BETWEEN the two
+    opens of one stitching pass used to silently drop the rotated
+    tail — the pass saw no ``.1`` yet, then opened the already-rotated
+    (fresh, near-empty) live file. read_journal now re-stats ``.1``
+    after the pass and retries once on an inode change."""
+    import os
+
+    from dlrover_tpu.telemetry import journal as journal_mod
+
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "w") as f:
+        for i in range(10):
+            f.write(json.dumps(
+                {"seq": i + 1, "ts": float(i), "kind": "checkpoint.save",
+                 "data": {"i": i}}
+            ) + "\n")
+
+    real_open = journal_mod._open_for_read
+    raced = {"done": False}
+
+    def racing_open(p):
+        if p == path and not raced["done"]:
+            # the sibling writer rotates at the worst moment: after
+            # this pass found no ".1", before it opens the live file
+            raced["done"] = True
+            os.replace(path, path + ".1")
+            with open(path, "w") as f:
+                f.write(json.dumps(
+                    {"seq": 11, "ts": 10.0, "kind": "checkpoint.save",
+                     "data": {"i": 10}}
+                ) + "\n")
+        return real_open(p)
+
+    monkeypatch.setattr(journal_mod, "_open_for_read", racing_open)
+    evts = read_journal(path)
+    # nothing dropped: the pre-rotation tail AND the post-rotation
+    # event both survive, in timeline order
+    assert [e["data"]["i"] for e in evts] == list(range(11))
+
+
+def test_journal_envelope_stamps_job_id(monkeypatch):
+    """ISSUE 19: with DLROVER_TPU_JOB_ID set to a non-default job, the
+    envelope gains a ``job`` field; the default job's envelopes stay
+    byte-identical to the pre-job shape (no key at all)."""
+    from dlrover_tpu.telemetry import journal as journal_mod
+
+    monkeypatch.setenv(journal_mod.ENV_JOB_ID, "tenant-a")
+    assert journal_mod.current_job_id() == "tenant-a"
+    j = EventJournal(None)
+    assert j.record("checkpoint.save", step=1)["job"] == "tenant-a"
+    # "default" (explicit or unset) never stamps the key
+    for raw in ("default", ""):
+        monkeypatch.setenv(journal_mod.ENV_JOB_ID, raw)
+        assert journal_mod.current_job_id() == "default"
+        j = EventJournal(None)
+        assert "job" not in j.record("checkpoint.save", step=1)
+
+
 def test_default_journal_env_configured(tmp_path, monkeypatch):
     path = str(tmp_path / "env.jsonl")
     monkeypatch.setenv("DLROVER_TPU_JOURNAL", path)

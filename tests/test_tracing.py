@@ -820,6 +820,14 @@ def test_lockwatch_event_names_are_the_canonical_set():
     _assert_vocabulary_clean("lockwatch")
 
 
+def test_brain_event_names_are_the_canonical_set():
+    """The brain.* vocabulary is closed (VOCABULARY['brain'], new in
+    ISSUE 19 with the explainable resource advisor): plan_proposed /
+    plan_adopted / plan_rejected / advisor_started, each with a live
+    emitter in brain/advisor.py."""
+    _assert_vocabulary_clean("brain")
+
+
 def test_span_names_are_canonical():
     """ISSUE 8 companion to the event-name lint: every tracing span
     name is a lowercase snake-case (optionally dotted) constant —

@@ -1,0 +1,295 @@
+"""Two-job observability drill (ISSUE 19 acceptance).
+
+Two master-attached agent groups with distinct job ids report through
+ONE shared relay into ONE real master service. The per-job telemetry
+pipeline must keep them apart at every layer:
+
+* the relay pre-merges digests PER JOB and the batch wire carries the
+  per-job ``digests`` dict (never the legacy single-job field);
+* ``/fleet?job=a`` vs ``?job=b`` never cross-contaminate — counters,
+  quantiles, hosts, stragglers are each job's own;
+* the SLO state machine fires independently per job;
+* one shared journal file splits back into per-job goodput accounts
+  via ``dump --goodput --job``;
+* the Brain advisor reads the per-job accounts and journals a
+  ``brain.plan_proposed`` whose evidence chain replays end-to-end
+  from the journal file.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.telemetry import fleet as fleet_mod
+from dlrover_tpu.telemetry import goodput as goodput_mod
+from dlrover_tpu.telemetry.fleet import (
+    DigestCollector,
+    FleetAggregator,
+    SLOEvaluator,
+    TimeSeriesStore,
+)
+from dlrover_tpu.telemetry.goodput import Phase, PhaseLedger
+from dlrover_tpu.telemetry.journal import (
+    ENV_JOB_ID,
+    EventJournal,
+    read_journal,
+    set_default_journal,
+)
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    set_default_journal(EventJournal())
+    fleet_mod.set_default_collector(DigestCollector())
+    yield
+    set_default_journal(EventJournal())
+    fleet_mod.set_default_collector(None)
+    goodput_mod.set_job_provider(None)
+
+
+def _goodput_fields(phases, start_ts):
+    return {
+        "goodput_phases": dict(phases),
+        "goodput_elapsed_s": float(sum(phases.values())),
+        "goodput_start_ts": start_ts,
+        "goodput_phase": Phase.TRAINING,
+    }
+
+
+def test_two_jobs_through_one_relay_never_cross_contaminate(tmp_path):
+    """The full wire: 2 agents of job "a" + 2 of job "b" through one
+    AggregatorRelay into one master. Job a runs slow steps and burns
+    42% of its wall in ckpt_stall/rendezvous; job b is healthy. Every
+    consumer — fleet views, SLO, goodput accounts, the HTTP endpoints,
+    the Brain — must attribute each signal to exactly one job."""
+    from dlrover_tpu.agent.relay import AggregatorRelay
+    from dlrover_tpu.agent.status_reporter import DeltaTracker
+    from dlrover_tpu.brain.advisor import MODE_OBSERVE, ResourceAdvisor
+    from dlrover_tpu.master.servicer import create_master_service
+    from dlrover_tpu.telemetry.http import MetricsServer, set_fleet_provider
+    from tests.test_ingest import _job_manager
+
+    journal_path = str(tmp_path / "drill.jsonl")
+    set_default_journal(EventJournal(journal_path))
+
+    agg = FleetAggregator(
+        store=TimeSeriesStore(max_mb=4),
+        slo=SLOEvaluator(spec="step_p99_ms<=50"),
+    )
+    gp = goodput_mod.GoodputAggregator()
+    jm, speed = _job_manager(4)
+    server, servicer = create_master_service(
+        0, job_manager=jm, speed_monitor=speed, fleet_aggregator=agg,
+        goodput_aggregator=gp,
+    )
+    server.start()
+    relay = AggregatorRelay(
+        f"localhost:{server.port}", relay_id=0, interval=30.0,
+    )
+    srv = None
+    try:
+        now = time.time()
+        # job "a": nodes 0-1, 200ms steps (violates the SLO), heavy
+        # ckpt_stall + rendezvous badput
+        # job "b": nodes 2-3, 10ms steps, clean account
+        groups = {
+            "a": ((0, 1), 0.2, 100,
+                  {Phase.INIT: 8.0, Phase.TRAINING: 50.0,
+                   Phase.CKPT_STALL: 30.0, Phase.RENDEZVOUS: 12.0}),
+            "b": ((2, 3), 0.01, 200,
+                  {Phase.INIT: 2.0, Phase.TRAINING: 98.0}),
+        }
+        for job, (node_ids, step_s, step, phases) in groups.items():
+            for node_id in node_ids:
+                tracker = DeltaTracker(incarnation=0, job_id=job)
+                c = DigestCollector()
+                for _ in range(30):
+                    c.observe("step", step_s)
+                    c.incr("steps")
+                rep = tracker.compose(
+                    now, step=step, pid=100 + node_id,
+                    goodput_fields=_goodput_fields(phases, now - 100.0),
+                    host=f"host-{node_id}",
+                )
+                rep.node_type, rep.node_id = NodeType.WORKER, node_id
+                rep.has_metrics, rep.metrics = True, c.compose()
+                assert relay.handle("report_node_status", rep).accepted
+
+        # ------------------------------------------------ wire format
+        batches = []
+        orig = relay._upstream.report_relay_batch
+        relay._upstream.report_relay_batch = (
+            lambda b: (batches.append(b), orig(b))[1]
+        )
+        try:
+            relay._forward_once()
+        finally:
+            relay._upstream.report_relay_batch = orig
+        assert len(batches) == 1  # still ONE batch for both jobs
+        assert set(batches[0].digests) == {"a", "b"}
+        assert not batches[0].digest  # legacy field stays empty
+
+        # --------------------------------------------- fleet views
+        assert agg.jobs() == ["a", "b"]
+        sa, sb = agg.snapshot(job="a"), agg.snapshot(job="b")
+        assert sa["counters"] == {"steps": 60}
+        assert sb["counters"] == {"steps": 60}
+        assert sa["series"]["step"]["count"] == 60
+        assert sa["series"]["step"]["p99_ms"] > 150.0
+        assert sb["series"]["step"]["p99_ms"] < 50.0
+        assert [h["host"] for h in sa["hosts"]] == ["host-0", "host-1"]
+        assert [h["host"] for h in sb["hosts"]] == ["host-2", "host-3"]
+        # per-job straggler lead: each job measures against ITS OWN
+        # fastest host, not the other job's
+        assert all(s["behind"] == 0 for s in agg.stragglers(job="b"))
+        # fleet-wide view is the merge
+        snap = agg.snapshot()
+        assert snap["counters"] == {"steps": 120}
+        assert {h["host"] for h in snap["hosts"]} == {
+            "host-0", "host-1", "host-2", "host-3",
+        }
+
+        # ------------------------------------------------- SLO per job
+        assert agg.slo.violated("step_p99_ms", job="a")
+        assert not agg.slo.violated("step_p99_ms", job="b")
+        assert sa["slo"]["step_p99_ms"]["violated"] is True
+        assert sb["slo"]["step_p99_ms"]["violated"] is False
+
+        # ------------------------------------------ goodput accounts
+        ga = gp.summary(job="a")["job"]
+        gb = gp.summary(job="b")["job"]
+        assert ga["procs"] == 2 and gb["procs"] == 2
+        assert ga["badput_s"][Phase.CKPT_STALL] == pytest.approx(60.0)
+        assert ga["badput_s"][Phase.RENDEZVOUS] == pytest.approx(24.0)
+        assert gb["badput_s"][Phase.CKPT_STALL] == 0.0
+        assert gb["goodput_percent"] == pytest.approx(98.0)
+        assert gp.jobs() == ["a", "b"]
+
+        # ------------------------------------------- HTTP endpoints
+        srv = MetricsServer(host="127.0.0.1").start()
+        set_fleet_provider(agg.snapshot)
+        goodput_mod.set_job_provider(gp.summary)
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return json.loads(r.read().decode())
+
+        doc_a = get("/fleet.json?job=a")
+        doc_b = get("/fleet.json?job=b")
+        assert doc_a["job"] == "a" and doc_b["job"] == "b"
+        assert [h["host"] for h in doc_a["hosts"]] == [
+            "host-0", "host-1",
+        ]
+        assert [h["host"] for h in doc_b["hosts"]] == [
+            "host-2", "host-3",
+        ]
+        assert doc_a["slo"]["step_p99_ms"]["violated"] is True
+        assert doc_b["slo"]["step_p99_ms"]["violated"] is False
+        gdoc = get("/goodput?job=a")
+        assert gdoc["job"]["procs"] == 2
+        assert gdoc["job"]["badput_s"][Phase.CKPT_STALL] == \
+            pytest.approx(60.0)
+
+        # ------------------------------- Brain: evidence from journal
+        adv = ResourceAdvisor(
+            fleet=agg, goodput=gp,
+            speed_monitors_fn=servicer.job_speed_monitors,
+            mode=MODE_OBSERVE, interval=0,
+        )
+        plans = adv.step(now=now)
+        assert [
+            (p["job"], p["action"]) for p in plans
+        ] == [("a", "shrink")]
+
+        # replay the journal FILE: the proposal and its full evidence
+        # chain must reconstruct from disk, not from live state
+        events = read_journal(journal_path)
+        proposed = [
+            e for e in events if e["kind"] == "brain.plan_proposed"
+        ]
+        assert len(proposed) == 1
+        d = proposed[0]["data"]
+        assert d["job"] == "a" and d["action"] == "shrink"
+        assert d["rule"] == "shrink_badput"
+        assert d["mode"] == MODE_OBSERVE
+        assert d["evidence_ckpt_stall_s"] == pytest.approx(60.0)
+        assert d["evidence_rendezvous_s"] == pytest.approx(24.0)
+        assert d["evidence_stall_pct"] == pytest.approx(42.0)
+        assert d["evidence_threshold_pct"] == 25.0
+        assert d["evidence_window_s"] == pytest.approx(200.0)
+        assert d["evidence_workers"] == 2
+        assert d["target_nodes"] == 1
+        assert d["expected_goodput_delta"] == pytest.approx(42.0)
+        # the SLO violation that fired for job a is on disk too, and
+        # never for job b
+        violated = [
+            e["data"] for e in events if e["kind"] == "slo.violated"
+        ]
+        assert "a" in {v.get("job") for v in violated}
+        assert all(v.get("job") != "b" for v in violated)
+    finally:
+        goodput_mod.set_job_provider(None)
+        set_fleet_provider(None)
+        if srv is not None:
+            srv.stop()
+        relay.stop(flush=False, grace=0.0)
+        server.stop(grace=0.2)
+        servicer.close()
+
+
+def test_shared_journal_splits_into_per_job_goodput_accounts(
+        tmp_path, monkeypatch, capsys):
+    """Two jobs' ledgers write breadcrumbs into ONE journal file (the
+    launcher-shared layout); ``dump --goodput --job`` rebuilds each
+    job's account with zero bleed from the sibling."""
+    from dlrover_tpu.telemetry import dump
+
+    path = str(tmp_path / "shared.jsonl")
+
+    # job "a": 10s init, then training with 40s re-labeled ckpt_stall
+    monkeypatch.setenv(ENV_JOB_ID, "a")
+    set_default_journal(EventJournal(path))
+    led_a = PhaseLedger(start_ts=T0, phase=Phase.INIT)
+    led_a.transition(Phase.TRAINING, ts=T0 + 10)
+    led_a.credit(Phase.CKPT_STALL, 40.0, ts=T0 + 90)
+    led_a.close(ts=T0 + 100)
+
+    # job "b": 5s init, training straight through — same host, same
+    # pid, same file: only the envelope job field keeps them apart
+    monkeypatch.setenv(ENV_JOB_ID, "b")
+    set_default_journal(EventJournal(path))
+    led_b = PhaseLedger(start_ts=T0, phase=Phase.INIT)
+    led_b.transition(Phase.TRAINING, ts=T0 + 5)
+    led_b.close(ts=T0 + 100)
+
+    monkeypatch.delenv(ENV_JOB_ID)
+    set_default_journal(EventJournal())
+
+    events = read_journal(path)
+    assert {e.get("job") for e in events} == {"a", "b"}
+
+    # library path: reconstruct() splits on the envelope namespace
+    ra = goodput_mod.reconstruct(events, job="a")["job"]
+    rb = goodput_mod.reconstruct(events, job="b")["job"]
+    assert ra["badput_s"][Phase.CKPT_STALL] == pytest.approx(40.0)
+    assert ra["goodput_percent"] == pytest.approx(50.0)
+    assert rb["badput_s"][Phase.CKPT_STALL] == 0.0
+    assert rb["goodput_percent"] == pytest.approx(95.0)
+
+    # CLI path: dump --goodput --json --job
+    assert dump.main([path, "--goodput", "--json", "--job", "a"]) == 0
+    doc_a = json.loads(capsys.readouterr().out)
+    assert doc_a["job"]["goodput_percent"] == pytest.approx(50.0)
+    assert doc_a["job"]["badput_s"][Phase.CKPT_STALL] == \
+        pytest.approx(40.0)
+    assert dump.main([path, "--goodput", "--json", "--job", "b"]) == 0
+    doc_b = json.loads(capsys.readouterr().out)
+    assert doc_b["job"]["goodput_percent"] == pytest.approx(95.0)
+    assert doc_b["job"]["badput_s"][Phase.CKPT_STALL] == 0.0

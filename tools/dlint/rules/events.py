@@ -144,6 +144,15 @@ VOCABULARY = {
     "journal_file": (("journal",), frozenset({
         "journal.rotated",
     })),
+    # ISSUE 19: the explainable resource advisor (brain/advisor.py) —
+    # plan_proposed carries the full evidence chain; adopted/rejected
+    # are the advise-mode actuation audit trail
+    "brain": (("brain",), frozenset({
+        "brain.advisor_started",
+        "brain.plan_proposed",
+        "brain.plan_adopted",
+        "brain.plan_rejected",
+    })),
     # ISSUE 15: the runtime lock-order watchdog
     # (telemetry/lockwatch.py) — cycle = potential deadlock in the
     # acquisition-order graph, long_hold = critical section over the
