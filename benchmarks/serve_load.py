@@ -15,9 +15,15 @@ Prints ONE JSON line (BENCH conventions, docs/SERVING.md):
   serve_p99_ms     router-measured submit-to-response p99
   exactly_once     every request answered exactly once
   workers/batch/requests  run shape
+  routers          router shard count (ISSUE 20, --routers)
+  per_shard_req_s  completions/s per router shard
+  tenants          distinct tenants offered equal load (--tenants)
+  fairness_spread  max/min of per-tenant mean latency (1.0 = perfectly
+                   fair; DRR should keep it near 1 under equal load)
 
 Run:  JAX_PLATFORMS=cpu python benchmarks/serve_load.py \
-          [--workers 2] [--batch 8] [--requests 512] [--model_ms 0]
+          [--workers 2] [--batch 8] [--requests 512] [--model_ms 0] \
+          [--routers 1] [--tenants 1]
       --smoke shrinks the run for the tier-1 suite.
 """
 
@@ -33,7 +39,7 @@ sys.path.insert(0, REPO)
 
 
 def _run(num_requests: int, workers: int, batch: int,
-         model_ms: float) -> dict:
+         model_ms: float, tenants: int = 1) -> dict:
     from dlrover_tpu.agent.master_client import MasterClient
     from dlrover_tpu.master.local_master import LocalJobMaster
     from dlrover_tpu.serving.worker import ServingWorker
@@ -64,26 +70,46 @@ def _run(num_requests: int, workers: int, batch: int,
     for t in threads:
         t.start()
     req_ids = []
+    tenant_of = {}
     for i in range(num_requests):
-        ok, rid, reason = lb.serve_submit(b"p%d" % i)
+        tenant = "t%d" % (i % tenants) if tenants > 1 else ""
+        ok, rid, reason = lb.serve_submit(b"p%d" % i, tenant=tenant)
         if not ok and reason == "backpressure":
             # bounded queue doing its job: wait out the burst
             while not ok:
                 time.sleep(0.002)
-                ok, rid, reason = lb.serve_submit(b"p%d" % i)
+                ok, rid, reason = lb.serve_submit(
+                    b"p%d" % i, tenant=tenant
+                )
         req_ids.append(rid)
+        tenant_of[rid] = tenant
     lb.serve_seal()
 
     responses = {}
+    latencies = {}
     for rid in req_ids:
         deadline = time.time() + 120.0
         while time.time() < deadline:
-            done, payload, worker_id, _ = lb.serve_poll(rid)
+            done, payload, worker_id, latency_s = lb.serve_poll(rid)
             if done:
                 responses[rid] = (payload, worker_id)
+                latencies[rid] = latency_s
                 break
             time.sleep(0.001)
     elapsed = time.perf_counter() - t0
+
+    # per-tenant mean latency under EQUAL offered load: DRR fairness
+    # shows up as a max/min ratio near 1
+    fairness_spread = 1.0
+    if tenants > 1:
+        by_tenant = {}
+        for rid, lat in latencies.items():
+            by_tenant.setdefault(tenant_of[rid], []).append(lat)
+        means = [
+            sum(v) / len(v) for v in by_tenant.values() if v
+        ]
+        if means and min(means) > 0:
+            fairness_spread = max(means) / min(means)
 
     for t in threads:
         t.join(timeout=30.0)
@@ -96,6 +122,10 @@ def _run(num_requests: int, workers: int, batch: int,
         1 for i, rid in enumerate(req_ids)
         if responses.get(rid, (b"",))[0] == (b"p%d" % i).upper()
     )
+    per_shard_req_s = {
+        shard: round(doc.get("completed", 0) / elapsed, 1)
+        for shard, doc in (stats.get("per_shard") or {}).items()
+    } if elapsed > 0 else {}
     return {
         "requests_per_s": (
             num_requests / elapsed if elapsed > 0 else 0.0
@@ -103,6 +133,8 @@ def _run(num_requests: int, workers: int, batch: int,
         "elapsed_s": elapsed,
         "answered": answered,
         "served_by": sorted({w for _, w in responses.values()}),
+        "per_shard_req_s": per_shard_req_s,
+        "fairness_spread": round(fairness_spread, 3),
         "stats": stats,
     }
 
@@ -114,6 +146,11 @@ def main() -> int:
     p.add_argument("--requests", type=int, default=512)
     p.add_argument("--model_ms", type=float, default=0.0,
                    help="simulated model time per micro-batch")
+    p.add_argument("--routers", type=int, default=1,
+                   help="router shard count "
+                        "(DLROVER_TPU_SERVE_ROUTER_SHARDS)")
+    p.add_argument("--tenants", type=int, default=1,
+                   help="distinct tenants offered equal load")
     p.add_argument("--smoke", action="store_true",
                    help="tiny run for the tier-1 suite")
     args = p.parse_args()
@@ -124,8 +161,12 @@ def main() -> int:
         args.batch = min(args.batch, 4)
 
     os.environ.setdefault("DLROVER_TPU_METRICS_PORT", "off")
+    os.environ["DLROVER_TPU_SERVE_ROUTER_SHARDS"] = str(
+        max(1, args.routers)
+    )
 
-    run = _run(args.requests, args.workers, args.batch, args.model_ms)
+    run = _run(args.requests, args.workers, args.batch, args.model_ms,
+               tenants=max(1, args.tenants))
     stats = run["stats"]
     ok = (
         run["answered"] == args.requests
@@ -144,6 +185,10 @@ def main() -> int:
         "workers": args.workers,
         "batch": args.batch,
         "requests": args.requests,
+        "routers": max(1, args.routers),
+        "per_shard_req_s": run["per_shard_req_s"],
+        "tenants": max(1, args.tenants),
+        "fairness_spread": run["fairness_spread"],
         "smoke": bool(args.smoke),
         "exactly_once": ok,
     }

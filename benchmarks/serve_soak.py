@@ -1,0 +1,711 @@
+"""Serving router-plane soak: a million requests through chaos.
+
+ISSUE 20 acceptance evidence, three phases:
+
+1. **baseline** — a faithful re-creation of the pre-shard (PR 11)
+   single-lock router (`_LegacySingleRouter` below) driven with the
+   offline-batch client shape every batch-inference job uses: submit
+   the whole corpus, seal, then collect every response. The legacy
+   plane's ``finished()`` walks the ENTIRE done-store (which nothing
+   ever evicts) under the one global lock, and ``_maybe_drained``
+   calls it from every post-seal poll — the drain is O(M^2) in corpus
+   size. This is the measured cost the sharded plane removes.
+2. **sharded** — the hash-partitioned :class:`RequestRouter` at
+   ``--shards`` (4) on the *same driver and corpus*: per-shard
+   ``_undelivered`` counters make ``finished()`` O(shards) and the
+   done-store TTL GC keeps memory flat, so the drain is O(M).
+   ``speedup_vs_single_router`` = phase2/phase1 must clear 4x.
+3. **chaos soak** — ``--requests`` (1M) pipelined through real
+   :class:`ServingWorker` replicas while the schedule rotates
+   replicas (SIGTERM-style drain + relaunch at a higher incarnation),
+   SIGKILL-kills them mid-lease (completions die with the process,
+   the watchdog redelivers), resizes the router plane 2 -> 4 shards
+   live, and runs a real :class:`ServingAutoScaler` whose scale_fn
+   grows/shrinks the pool. Two engineered windows assert the SLO
+   attribution: a slow-model window where the autoscaler must HOLD
+   (journaled ``serve.autoscale_held``, model time dominates — more
+   replicas cannot help) and a queue-burst window where it must SCALE
+   (``serve.autoscale`` reason ``queue_depth``). Exactly-once is
+   asserted request-by-request: every admitted id answered once with
+   the right payload, zero dropped, and the sampled p99 stays under
+   ``--p99-limit-ms`` through every kill, resize, and scale.
+
+Prints ONE JSON line (BENCH conventions, docs/SERVING.md); the full
+run also writes the artifact ``SERVE_r09.json``.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/serve_soak.py \
+          [--requests 1000000] [--shards 4] [--workers 4] [--batch 32]
+      --smoke shrinks to 10k requests / 2 shards / one kill for the
+      tier-1 suite (no baseline phase, no autoscale windows).
+"""
+
+import argparse
+import collections
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# --------------------------------------------------------------- baseline
+class _LegacySingleRouter:
+    """The PR 11 router's data path, re-created faithfully for the
+    baseline phase: ONE lock around one FIFO + pending map + done map,
+    nothing ever evicted from the done-store, and ``finished()``
+    scanning ``all(done.delivered)`` from every successful poll and
+    complete (via ``_maybe_drained``) once the stream seals. Kept to
+    the exact surface the phase driver exercises."""
+
+    def __init__(self, max_queue: int = 1024,
+                 lease_timeout: float = 5.0):
+        self._lock = threading.Lock()
+        self._max_queue = max_queue
+        self._lease_timeout = lease_timeout
+        self._queue = collections.deque()
+        self._pending = {}  # req_id -> [payload, worker, lease_ts, submit_ts]
+        self._done = {}     # req_id -> [payload, worker_id, latency, delivered]
+        self._latencies = collections.deque(maxlen=4096)
+        self._sealed = False
+        self._drained_recorded = False
+        self._ids = itertools.count(1)
+
+    def submit(self, payload, req_id=""):
+        with self._lock:
+            if not req_id:
+                req_id = "req-%d" % next(self._ids)
+            if self._sealed:
+                return False, req_id, "sealed"
+            if req_id in self._pending or req_id in self._done:
+                return False, req_id, "duplicate"
+            if len(self._queue) >= self._max_queue:
+                return False, req_id, "backpressure"
+            self._pending[req_id] = [payload, None, 0.0, time.time()]
+            self._queue.append(req_id)
+            return True, req_id, ""
+
+    def lease(self, node_type, node_id, max_requests=1, incarnation=0):
+        now = time.time()
+        batch = []
+        with self._lock:
+            while self._queue and len(batch) < max(1, max_requests):
+                rid = self._queue.popleft()
+                pending = self._pending.get(rid)
+                if pending is None:
+                    continue
+                pending[1] = (node_type, node_id)
+                pending[2] = now
+                batch.append((rid, pending[0]))
+            return batch, self._sealed
+
+    def complete(self, node_type, node_id, req_id, payload):
+        with self._lock:
+            if req_id in self._done:
+                return False
+            pending = self._pending.pop(req_id, None)
+            if pending is None:
+                return False
+            latency = max(0.0, time.time() - pending[3])
+            self._done[req_id] = [payload, node_id, latency, False]
+            self._latencies.append(latency)
+        self._maybe_drained()
+        return True
+
+    def poll(self, req_id):
+        with self._lock:
+            done = self._done.get(req_id)
+            if done is None:
+                return False, b"", -1, 0.0
+            done[3] = True
+            out = (True, done[0], done[1], done[2])
+        self._maybe_drained()
+        return out
+
+    def seal(self):
+        with self._lock:
+            self._sealed = True
+
+    def finished(self):
+        with self._lock:
+            return (
+                self._sealed
+                and not self._queue
+                and not self._pending
+                and all(d[3] for d in self._done.values())
+            )
+
+    def _maybe_drained(self):
+        if self._drained_recorded or not self.finished():
+            return
+        self._drained_recorded = True
+
+
+# ----------------------------------------------- phase driver (1 and 2)
+def _drive_offline_batch(router, n_req, workers=4, batch=32):
+    """Offline batch inference against ``router``: submit the corpus,
+    seal, collect every response in submit order. Identical driver for
+    the legacy and sharded phases — only the router differs."""
+    stop = threading.Event()
+
+    def run_worker(i):
+        while not stop.is_set():
+            leased, sealed = router.lease(
+                "worker", i, max_requests=batch, incarnation=0
+            )
+            if not leased:
+                if sealed:
+                    return
+                time.sleep(0.0005)
+                continue
+            for rid, payload in leased:
+                router.complete("worker", i, rid, b"R" + payload)
+
+    threads = [
+        threading.Thread(target=run_worker, args=(i,), daemon=True)
+        for i in range(workers)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    ids = []
+    for i in range(n_req):
+        payload = b"p%d" % i
+        ok, rid, _reason = router.submit(payload, req_id="b-%d" % i)
+        while not ok:
+            time.sleep(0.0005)
+            ok, rid, _reason = router.submit(payload, req_id="b-%d" % i)
+        ids.append(rid)
+    router.seal()
+    for rid in ids:
+        while True:
+            done, _payload, _worker, _lat = router.poll(rid)
+            if done:
+                break
+            time.sleep(0.0002)
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    return n_req / elapsed if elapsed > 0 else 0.0
+
+
+# ------------------------------------------------------------ soak plane
+class _PlaneClient:
+    """In-process master-client adapter for :class:`ServingWorker`
+    against a raw :class:`RequestRouter`. ``killed`` is the SIGKILL
+    analog: the replica stops pulling AND its completions never reach
+    the router (the process died), so its outstanding leases strand
+    until the watchdog redelivers them."""
+
+    def __init__(self, plane, node_id):
+        self._plane = plane
+        self._node_id = node_id
+        self.killed = False
+
+    def serve_lease(self, max_requests=1, incarnation=0):
+        if self.killed:
+            return [], True  # looks sealed: the loop winds down
+        return self._plane.lease(
+            "worker", self._node_id, max_requests, incarnation
+        )
+
+    def serve_complete(self, req_id, response):
+        if self.killed:
+            return False  # the response died with the process
+        return self._plane.complete(
+            "worker", self._node_id, req_id, response
+        )
+
+    def serve_relinquish(self):
+        if self.killed:
+            return 0
+        return self._plane.relinquish("worker", self._node_id)
+
+
+class _ReplicaPool:
+    """Thread-hosted ServingWorker replicas over the router plane.
+    Rotation relaunches the SAME node id at incarnation+1 (the plane's
+    incarnation-reclaim path); kills strand leases for the watchdog."""
+
+    def __init__(self, plane, model_fn, batch):
+        from dlrover_tpu.serving.worker import ServingWorker
+
+        self._worker_cls = ServingWorker
+        self._plane = plane
+        self._model_fn = model_fn
+        self._batch = batch
+        self._lock = threading.Lock()
+        self._slots = {}  # node_id -> (worker, client, thread)
+        self._next_inc = {}  # node_id -> next incarnation
+        self._next_id = itertools.count()
+        self.rotations = 0
+        self.kills = 0
+        self.peak = 0
+
+    def _spawn_locked(self, node_id):
+        incarnation = self._next_inc.get(node_id, 0)
+        self._next_inc[node_id] = incarnation + 1
+        client = _PlaneClient(self._plane, node_id)
+        worker = self._worker_cls(
+            client, self._model_fn, node_id=node_id,
+            batch_size=self._batch, poll_interval=0.005,
+            incarnation=incarnation, exit_fn=lambda rc: None,
+        )
+        thread = threading.Thread(
+            target=worker.serve, name="replica-%d" % node_id,
+            daemon=True,
+        )
+        self._slots[node_id] = (worker, client, thread)
+        thread.start()
+        self.peak = max(self.peak, len(self._slots))
+
+    def spawn(self):
+        with self._lock:
+            self._spawn_locked(next(self._next_id))
+
+    def count(self):
+        with self._lock:
+            return len(self._slots)
+
+    def rotate_one(self, relaunch=True):
+        """SIGTERM-style drain: finish the in-flight batch, relinquish
+        the buffered leases, exit — then (optionally) relaunch the
+        same node id one incarnation up."""
+        with self._lock:
+            if not self._slots:
+                return
+            node_id = min(self._slots)
+            worker, _client, thread = self._slots.pop(node_id)
+            worker.rotation.trigger("rotation")
+            thread.join(timeout=10.0)
+            self.rotations += 1
+            if relaunch:
+                self._spawn_locked(node_id)
+
+    def kill_one(self):
+        """SIGKILL analog: leases strand, completions vanish; the
+        replacement comes back at a higher incarnation."""
+        with self._lock:
+            if not self._slots:
+                return
+            node_id = max(self._slots)
+            _worker, client, thread = self._slots.pop(node_id)
+            client.killed = True
+            thread.join(timeout=10.0)
+            self.kills += 1
+            self._spawn_locked(node_id)
+
+    def scale_to(self, target):
+        target = max(0, int(target))
+        while self.count() < target:
+            self.spawn()
+        while self.count() > target:
+            self.rotate_one(relaunch=False)
+
+    def stop_all(self):
+        with self._lock:
+            slots, self._slots = list(self._slots.values()), {}
+        for worker, _client, _thread in slots:
+            worker.rotation.trigger("shutdown")
+        for _worker, _client, thread in slots:
+            thread.join(timeout=10.0)
+
+
+def _run_soak(args, journal):
+    """Phase 3: the chaos soak. Returns the result fields + checks."""
+    from dlrover_tpu.serving.autoscaler import ServingAutoScaler
+    from dlrover_tpu.serving.router import RequestRouter
+
+    n_req = args.requests
+    deadline = time.monotonic() + args.soak_timeout_s
+    plane = RequestRouter(
+        max_queue=4096,
+        lease_timeout=0.6 if args.smoke else 1.5,
+        shards=args.start_shards,
+        done_ttl=3.0,
+    )
+    plane.start()  # watchdog: lease redelivery + done-store TTL GC
+
+    slow_ms = [0.0]     # flat per-BATCH model cost injected by the
+    throttle = [0.0]    # slow-model window; submit pacing alongside
+
+    def model_fn(payloads, _state):
+        if slow_ms[0] > 0.0:
+            # flat per-batch: model time dominates even when the
+            # throttled arrival rate keeps lease batches small
+            time.sleep(slow_ms[0] / 1000.0)
+        return [b"R" + p for p in payloads]
+
+    pool = _ReplicaPool(plane, model_fn, args.batch)
+    pool.scale_to(args.workers)
+
+    # ------------------------------------------------- load generators
+    n_gen = 2
+    per_gen = n_req // n_gen
+    counts = [n_req - per_gen * (n_gen - 1)] + [per_gen] * (n_gen - 1)
+    answered = [0] * n_gen
+    mismatches = [0] * n_gen
+    injected_dups = [0]
+    gen_queues = [collections.deque() for _ in range(n_gen)]
+    submit_done = [threading.Event() for _ in range(n_gen)]
+    abort = threading.Event()
+
+    def submitter(g):
+        for i in range(counts[g]):
+            if abort.is_set():
+                return
+            rid = "s%d-%d" % (g, i)
+            payload = b"p" + rid.encode()
+            ok, _rid, reason = plane.submit(
+                payload, req_id=rid, tenant="gen-%d" % g
+            )
+            while not ok and reason in ("backpressure", "detached"):
+                if abort.is_set():
+                    return
+                time.sleep(0.001)
+                ok, _rid, reason = plane.submit(
+                    payload, req_id=rid, tenant="gen-%d" % g
+                )
+            if not ok:
+                abort.set()
+                return
+            gen_queues[g].append((rid, payload))
+            if i and i % 25000 == 0:
+                # exactly-once at the front door: a duplicate submit
+                # of a pending id must bounce with reason "duplicate"
+                dup_ok, _r, dup_reason = plane.submit(
+                    payload, req_id=rid, tenant="gen-%d" % g
+                )
+                if not dup_ok and dup_reason == "duplicate":
+                    injected_dups[0] += 1
+            if throttle[0] > 0.0:
+                time.sleep(throttle[0])
+        submit_done[g].set()
+
+    def poller(g):
+        queue = gen_queues[g]
+        while answered[g] < counts[g]:
+            if abort.is_set():
+                return
+            if not queue:
+                time.sleep(0.0005)
+                continue
+            rid, payload = queue[0]
+            done, response, _worker, _lat = plane.poll(rid)
+            if done:
+                queue.popleft()
+                answered[g] += 1
+                if response != b"R" + payload:
+                    mismatches[g] += 1
+            else:
+                time.sleep(0.0002)
+
+    # --------------------------------------------------- p99 sampler
+    max_p99 = [0.0]
+    samples = [0]
+    sampler_stop = threading.Event()
+
+    def sampler():
+        while not sampler_stop.wait(0.25):
+            doc = plane.stats()
+            max_p99[0] = max(max_p99[0], float(doc.get("p99_ms", 0.0)))
+            samples[0] += 1
+
+    threads = [
+        threading.Thread(target=submitter, args=(g,), daemon=True)
+        for g in range(n_gen)
+    ] + [
+        threading.Thread(target=poller, args=(g,), daemon=True)
+        for g in range(n_gen)
+    ] + [threading.Thread(target=sampler, daemon=True)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+
+    total_answered = lambda: sum(answered)  # noqa: E731
+
+    def wait_progress(frac, label):
+        target = int(n_req * frac)
+        while total_answered() < target:
+            if abort.is_set() or time.monotonic() > deadline:
+                raise RuntimeError(
+                    "soak stalled waiting for %s (%d/%d answered)"
+                    % (label, total_answered(), target)
+                )
+            time.sleep(0.05)
+
+    checks = {}
+    resizes = 0
+    held_delta = 0
+    scale_up_queue = 0
+
+    if args.smoke:
+        # one kill: a replica leases a batch and dies with it — the
+        # watchdog must redeliver every stranded request. Pause the
+        # pool first so the doomed lease deterministically has work.
+        wait_progress(0.05, "smoke kill point")
+        pool.scale_to(0)
+        phantom_batch = []
+        while not phantom_batch:
+            phantom_batch, _sealed = plane.lease(
+                "worker", 7777, 8, incarnation=0
+            )
+            if not phantom_batch:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("smoke kill found no work")
+                time.sleep(0.002)
+        pool.scale_to(args.workers)
+        kills = 1
+        min_redelivered = len(phantom_batch)
+    else:
+        autoscaler = ServingAutoScaler(
+            stats_fn=plane.stats, scale_fn=pool.scale_to,
+            replicas_fn=pool.count, min_replicas=2, max_replicas=8,
+            queue_high=64, p99_high_ms=150.0, interval=0.3,
+            cooldown=0.8,
+        )
+        autoscaler.start()
+        # rolling rotation + kill storm across the first half
+        wait_progress(0.05, "chaos start")
+        for step in range(6):
+            pool.rotate_one()
+            if step % 2 == 1:
+                pool.kill_one()
+            wait_progress(0.05 + 0.04 * (step + 1), "chaos storm")
+        # live re-partition mid-soak: 2 -> 4 shards with leases in
+        # flight and the full generator load still running
+        plane.resize_shards(args.shards)
+        resizes += 1
+        checks["resize_applied"] = (
+            plane.stats().get("shards") == args.shards
+        )
+        wait_progress(0.5, "post-resize")
+
+        # -------- slow-model window: the autoscaler must HOLD -------
+        autoscaler.stop()  # stage the window without a racing tick
+        throttle[0] = 0.02  # ~100 req/s offered: below pool capacity,
+        # and the queue stays well under queue_high during the window
+        drain_deadline = time.monotonic() + 30.0
+        while (plane.stats().get("queue_depth", 0) > 32
+               and time.monotonic() < drain_deadline):
+            time.sleep(0.05)
+        pool.scale_to(3)   # below max: the p99 branch stays reachable
+        slow_ms[0] = 400.0  # every batch takes 400ms: model dominates
+        # fill the rolling attribution window with enough model-bound
+        # completions to own its p99 before the autoscaler looks again
+        # (the windows hold 4096 entries/shard — a thin slow era would
+        # leave the stale queue-wait tail in charge)
+        time.sleep(4.0)
+        held_before = len(journal.events("serve.autoscale_held"))
+        scale_before = len(journal.events("serve.autoscale"))
+        autoscaler.start()
+        time.sleep(4.0)
+        held_after = journal.events("serve.autoscale_held")
+        held_delta = len(held_after) - held_before
+        checks["autoscale_held_on_model_time"] = held_delta >= 1 and all(
+            e["data"].get("cause") == "model_time"
+            for e in held_after[-1:]
+        )
+        # while the model itself is the bottleneck, adding replicas is
+        # exactly what the SLO feed must NOT do
+        checks["no_scale_up_during_hold"] = not any(
+            e["data"].get("target", 0) > e["data"].get("replicas", 0)
+            for e in journal.events("serve.autoscale")[scale_before:]
+        )
+        # -------- queue-burst window: the autoscaler must SCALE ------
+        slow_ms[0] = 0.0
+        throttle[0] = 0.0
+        burst_before = len(journal.events("serve.autoscale"))
+        burst_deadline = time.monotonic() + 15.0
+        while time.monotonic() < burst_deadline:
+            new = [
+                e for e in journal.events("serve.autoscale")[burst_before:]
+                if e["data"].get("reason") == "queue_depth"
+                and e["data"].get("target", 0) > e["data"].get("replicas", 0)
+            ]
+            if new:
+                scale_up_queue = len(new)
+                break
+            time.sleep(0.2)
+        checks["autoscale_on_queue_depth"] = scale_up_queue >= 1
+        kills = pool.kills
+        min_redelivered = 1
+
+    for evt in submit_done:
+        while not evt.wait(0.5):
+            if abort.is_set() or time.monotonic() > deadline:
+                raise RuntimeError("soak stalled before seal")
+    # seal only once every admitted request has a stored response:
+    # a seal racing an outstanding redelivery would let every replica
+    # exit (sealed + momentarily empty queue) with work still owed
+    while plane.stats().get("completed", 0) < n_req:
+        if abort.is_set() or time.monotonic() > deadline:
+            raise RuntimeError("soak stalled before seal")
+        time.sleep(0.05)
+    plane.seal()
+    for t in threads[:-1]:
+        remaining = max(1.0, deadline - time.monotonic())
+        t.join(timeout=remaining)
+        if t.is_alive():
+            abort.set()
+            raise RuntimeError("soak stalled draining responses")
+    elapsed = time.perf_counter() - t0
+    sampler_stop.set()
+    if not args.smoke:
+        autoscaler.stop()
+    pool.stop_all()
+    stats = plane.stats()
+    plane.stop()
+
+    dropped = n_req - total_answered()
+    checks["every_request_answered_once"] = (
+        total_answered() == n_req
+        and stats.get("completed") == n_req
+        and sum(mismatches) == 0
+        and dropped == 0
+    )
+    checks["duplicates_rejected"] = (
+        injected_dups[0] >= (0 if args.smoke else 1)
+        and stats.get("duplicates", 0) >= injected_dups[0]
+    )
+    checks["chaos_redelivered"] = (
+        stats.get("redelivered", 0) >= min_redelivered
+    )
+    checks["done_store_gc_ran"] = (
+        args.smoke or stats.get("done_evicted", 0) > 0
+    )
+    checks["p99_bounded"] = (
+        samples[0] > 0 and 0.0 < max_p99[0] <= args.p99_limit_ms
+    )
+    return {
+        "soak_requests": n_req,
+        "soak_req_s": round(n_req / elapsed, 1) if elapsed else 0.0,
+        "soak_elapsed_s": round(elapsed, 3),
+        "answered": total_answered(),
+        "dropped": dropped,
+        "payload_mismatches": sum(mismatches),
+        "injected_duplicates": injected_dups[0],
+        "duplicates": stats.get("duplicates", 0),
+        "redelivered": stats.get("redelivered", 0),
+        "done_evicted": stats.get("done_evicted", 0),
+        "rotations": pool.rotations,
+        "kills": kills,
+        "resizes": resizes,
+        "shards_start": args.start_shards,
+        "shards_final": stats.get("shards", 0),
+        "workers_peak": pool.peak,
+        "autoscale_events": len(journal.events("serve.autoscale")),
+        "autoscale_held_events": len(
+            journal.events("serve.autoscale_held")
+        ),
+        "max_p99_ms": round(max_p99[0], 3),
+        "p99_samples": samples[0],
+        "p99_limit_ms": args.p99_limit_ms,
+    }, checks
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=1_000_000)
+    p.add_argument("--baseline-requests", type=int, default=20_000,
+                   help="corpus for the legacy-vs-sharded phases")
+    p.add_argument("--shards", type=int, default=4)
+    p.add_argument("--start-shards", type=int, default=2,
+                   help="soak starts here, resizes to --shards live")
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--speedup-floor", type=float, default=4.0)
+    p.add_argument("--p99-limit-ms", type=float, default=20_000.0)
+    p.add_argument("--soak-timeout-s", type=float, default=900.0)
+    p.add_argument("--out", default="SERVE_r09.json",
+                   help="artifact path for the full run ('' disables)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-1 tier: 10k requests, 2 shards, one kill")
+    args = p.parse_args()
+
+    if args.smoke:
+        args.requests = min(args.requests, 10_000)
+        args.shards = 2
+        args.start_shards = 2
+        args.workers = 2
+
+    os.environ.setdefault("DLROVER_TPU_METRICS_PORT", "off")
+    from dlrover_tpu.serving.router import RequestRouter
+    from dlrover_tpu.telemetry.journal import (
+        EventJournal,
+        set_default_journal,
+    )
+
+    journal = EventJournal()
+    set_default_journal(journal)
+
+    checks = {}
+    baseline_req_s = sharded_req_s = speedup = None
+    if not args.smoke:
+        baseline_req_s = _drive_offline_batch(
+            _LegacySingleRouter(max_queue=1024, lease_timeout=5.0),
+            args.baseline_requests, workers=args.workers,
+            batch=args.batch,
+        )
+        sharded_req_s = _drive_offline_batch(
+            RequestRouter(
+                max_queue=1024, lease_timeout=5.0, shards=args.shards
+            ),
+            args.baseline_requests, workers=args.workers,
+            batch=args.batch,
+        )
+        speedup = (
+            sharded_req_s / baseline_req_s if baseline_req_s else 0.0
+        )
+        checks["speedup_vs_single_router"] = (
+            speedup >= args.speedup_floor
+        )
+
+    try:
+        soak, soak_checks = _run_soak(args, journal)
+    except RuntimeError as e:
+        print(json.dumps({"metric": "serve_soak", "error": str(e)}))
+        return 1
+    checks.update(soak_checks)
+
+    ok = all(checks.values())
+    result = {
+        "metric": "serve_soak",
+        "value": soak["soak_req_s"],
+        "unit": "requests/s",
+        "requests": args.requests,
+        "exactly_once": bool(
+            checks["every_request_answered_once"]
+            and checks["duplicates_rejected"]
+        ),
+        "baseline_requests": args.baseline_requests,
+        "baseline_req_s": (
+            round(baseline_req_s, 1) if baseline_req_s else None
+        ),
+        "sharded_req_s": (
+            round(sharded_req_s, 1) if sharded_req_s else None
+        ),
+        "speedup_vs_single_router": (
+            round(speedup, 2) if speedup else None
+        ),
+        "speedup_floor": args.speedup_floor,
+        "shards": args.shards,
+        "checks": checks,
+        "smoke": bool(args.smoke),
+        "ok": ok,
+    }
+    result.update(soak)
+    print(json.dumps(result))
+    if not args.smoke and args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

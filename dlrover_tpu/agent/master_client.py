@@ -711,11 +711,17 @@ class MasterClient:
     # -------------------------------------------------------------- serving
 
     @supervised_rpc
-    def serve_submit(self, payload: bytes, req_id: str = ""):
+    def serve_submit(self, payload: bytes, req_id: str = "",
+                     tenant: str = "", priority: int = 0):
         """Admit one inference request; returns (accepted, req_id,
         reason). Reasons are explicit backpressure — the caller owns
-        the retry policy."""
-        req = self._fill(comm.ServeSubmit(req_id=req_id, payload=payload))
+        the retry policy. ``tenant``/``priority`` buy fair queuing on
+        the sharded router plane (ISSUE 20); the defaults keep the old
+        wire byte-identical."""
+        req = self._fill(comm.ServeSubmit(
+            req_id=req_id, payload=payload,
+            tenant=tenant, priority=priority,
+        ))
         res = self._call("serve_submit", req)
         return bool(res.accepted), res.req_id, res.reason
 
@@ -791,19 +797,13 @@ class MasterClient:
             record("serve.rpc_fallback", rpc="serve_stats",
                    error=str(e)[:200])
             return None
+        # mirror every wire field (the router's stats() and ServeStats
+        # are kept key-identical by test_router_stats_match_serve_stats
+        # _wire_fields) so new stats — shard/tenant/GC counters —
+        # propagate without touching this client
         return {
-            "queue_depth": res.queue_depth,
-            "in_flight": res.in_flight,
-            "submitted": res.submitted,
-            "completed": res.completed,
-            "rejected": res.rejected,
-            "duplicates": res.duplicates,
-            "redelivered": res.redelivered,
-            "workers": res.workers,
-            "p50_ms": res.p50_ms,
-            "p99_ms": res.p99_ms,
-            "sealed": res.sealed,
-            "drained": res.drained,
+            name: getattr(res, name, field.default)
+            for name, field in comm.ServeStats.__dataclass_fields__.items()
         }
 
     # -------------------------------------------------------------- metrics
@@ -1035,8 +1035,11 @@ class LocalMasterClient:
             self._router.start()
         return self._router
 
-    def serve_submit(self, payload: bytes, req_id: str = ""):
-        return self._serve_router().submit(payload, req_id=req_id)
+    def serve_submit(self, payload: bytes, req_id: str = "",
+                     tenant: str = "", priority: int = 0):
+        return self._serve_router().submit(
+            payload, req_id=req_id, tenant=tenant, priority=priority
+        )
 
     def serve_poll(self, req_id: str):
         return self._serve_router().poll(req_id)

@@ -73,8 +73,10 @@ class DeltaTracker:
         self._acked_phase = ""
         self._acked_cpu: Optional[float] = None
         self._acked_mem: Optional[int] = None
+        self._acked_served: Optional[int] = None
         self._skipped_goodput = 0
         self._skipped_resource = 0
+        self._skipped_serve = 0
 
     def request_full(self):
         self._full_next = True
@@ -96,7 +98,9 @@ class DeltaTracker:
                 goodput_fields: Optional[Dict] = None,
                 resource: Optional[Tuple[float, int]] = None,
                 host: str = "",
-                final: bool = False) -> comm.NodeStatusReport:
+                final: bool = False,
+                serve_fields: Optional[Dict] = None
+                ) -> comm.NodeStatusReport:
         """Build the next report; bumps ``seq``. Retries of a shed
         report reuse the returned object — only an acked seq advances
         the baseline (see :meth:`commit`)."""
@@ -153,6 +157,26 @@ class DeltaTracker:
                 report.has_resource = True
                 report.cpu_percent = cpu
                 report.memory_mb = mem
+        if serve_fields:
+            # serving-replica stats (ISSUE 20): 1k-replica pools would
+            # melt the master with per-replica serve_stats polling —
+            # the counters ride this delta lane instead. Changed =
+            # the served count moved (the replica did work).
+            self._skipped_serve += 1
+            served = int(serve_fields.get("served", 0))
+            if (full or final or served != self._acked_served
+                    or self._skipped_serve >= self._max_skip):
+                report.has_serve = True
+                report.serve_served = served
+                report.serve_rejected = int(
+                    serve_fields.get("rejected", 0)
+                )
+                report.serve_model_ms = float(
+                    serve_fields.get("model_ms", 0.0)
+                )
+                report.serve_batch_fill = float(
+                    serve_fields.get("batch_fill", 0.0)
+                )
         return report
 
     def commit(self, report: comm.NodeStatusReport):
@@ -168,6 +192,9 @@ class DeltaTracker:
             self._acked_cpu = report.cpu_percent
             self._acked_mem = report.memory_mb
             self._skipped_resource = 0
+        if report.has_serve:
+            self._acked_served = report.serve_served
+            self._skipped_serve = 0
 
 
 class StatusReporter:
@@ -184,7 +211,8 @@ class StatusReporter:
                      Callable[[], Optional[Tuple[float, int]]]] = None,
                  step_fn: Optional[Callable[[], Optional[int]]] = None,
                  jitter: Optional[float] = None,
-                 pid: int = 0):
+                 pid: int = 0,
+                 serve_fn: Optional[Callable[[], Optional[Dict]]] = None):
         import os
 
         self._client = client
@@ -192,6 +220,7 @@ class StatusReporter:
         self._on_action = on_action
         self._resource_fn = resource_fn
         self._step_fn = step_fn
+        self._serve_fn = serve_fn
         self._pid = pid or os.getpid()
         if jitter is None:
             try:
@@ -263,6 +292,7 @@ class StatusReporter:
             pid=self._pid,
             goodput_fields=goodput_mod.report_fields(),
             resource=self._resource_fn() if self._resource_fn else None,
+            serve_fields=self._serve_fn() if self._serve_fn else None,
         )
         # fleet roll-up (ISSUE 17): the metric digest rides the same
         # delta contract — compose drains into in-flight, a shed retry

@@ -580,6 +580,14 @@ class NodeStatusReport(BaseRequest):
     #: the process produced no samples this interval.
     has_metrics: bool = False
     metrics: Dict = field(default_factory=dict)
+    #: serving-replica stats section (ISSUE 20): ServingWorker counters
+    #: ride the same delta lane as goodput/resource, so 1k-replica
+    #: pools stop unary-polling serve_stats at the master
+    has_serve: bool = False
+    serve_served: int = 0
+    serve_rejected: int = 0
+    serve_model_ms: float = 0.0
+    serve_batch_fill: float = 0.0
     #: job namespace (ISSUE 19): which job this reporter belongs to.
     #: Sparse encoding omits the default, so single-job wires (and old
     #: peers) are byte-identical to the pre-job format.
@@ -724,10 +732,15 @@ class ElasticRunConfig(BaseMessage):
 @dataclass
 class ServeSubmit(BaseRequest):
     """Admit one inference request. Empty ``req_id`` lets the router
-    assign one; a client-chosen id makes retries idempotent."""
+    assign one; a client-chosen id makes retries idempotent.
+    ``tenant`` buys deficit-round-robin fairness against the other
+    tenants of its ``priority`` class (ISSUE 20); the defaults keep
+    the old global-FIFO wire byte-identical (sparse encoding)."""
 
     req_id: str = ""
     payload: bytes = b""
+    tenant: str = ""
+    priority: int = 0
 
 
 @dataclass
@@ -824,3 +837,13 @@ class ServeStats(BaseMessage):
     model_time_p99_ms: float = 0.0
     sealed: bool = False
     drained: bool = False
+    # ISSUE 20: the sharded router plane
+    shards: int = 1
+    tenants: int = 0
+    #: delivered done-store entries GC'd after DLROVER_TPU_SERVE_DONE_TTL
+    done_evicted: int = 0
+    #: replica-reported serve sections alive on the delta-report plane
+    replicas_reporting: int = 0
+    replica_served: int = 0
+    #: per-shard {queue_depth, in_flight, completed}, keyed by shard index
+    per_shard: Dict = field(default_factory=dict)

@@ -232,6 +232,7 @@ class DistributedJobMaster:
             self.serve_autoscaler = ServingAutoScaler(
                 stats_fn=self.request_router.stats,
                 scale_fn=self.auto_scaler.manual_scale,
+                goodput_fn=self._serving_share,
                 min_replicas=getattr(job_args, "min_node_num", 0) or 1,
                 max_replicas=max(
                     getattr(job_args, "max_node_num", 0) or 0,
@@ -605,6 +606,17 @@ class DistributedJobMaster:
             "cause": cause,
             "badput_s": round(float(badput.get(cause, 0.0)), 3),
         }
+
+    def _serving_share(self):
+        """The goodput ledger's serving-phase share of pool wall time
+        (0..1) — the SLO-autoscaler feed (ISSUE 20). None until the
+        first replica ledger lands."""
+        doc = self.goodput_aggregator.summary()
+        job_doc = doc.get("job") or {}
+        wall = float(job_doc.get("wall_s") or 0.0)
+        if not job_doc.get("procs") or wall <= 0.0:
+            return None
+        return float(job_doc.get("serving_s") or 0.0) / wall
 
     def _slo_serve_p99(self):
         stats = self.request_router.stats()

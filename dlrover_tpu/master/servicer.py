@@ -815,6 +815,15 @@ class MasterServicer:
                 req.node_type, req.node_id, req.cpu_percent,
                 req.memory_mb, [],
             )
+        if req.has_serve and self._request_router is not None:
+            self._request_router.note_replica_stats(
+                req.node_type, req.node_id, req.incarnation, {
+                    "served": req.serve_served,
+                    "rejected": req.serve_rejected,
+                    "model_ms": req.serve_model_ms,
+                    "batch_fill": req.serve_batch_fill,
+                },
+            )
         if self._fleet is not None:
             self._fleet.observe_report(req)
             if req.has_metrics and req.metrics:
@@ -1022,7 +1031,8 @@ class MasterServicer:
 
     def rpc_serve_submit(self, req: comm.ServeSubmit) -> comm.ServeSubmitResult:
         accepted, req_id, reason = self._router().submit(
-            req.payload, req_id=req.req_id
+            req.payload, req_id=req.req_id,
+            tenant=req.tenant, priority=req.priority,
         )
         return comm.ServeSubmitResult(
             accepted=accepted, req_id=req_id, reason=reason

@@ -34,6 +34,12 @@ DEFAULT_P99_HIGH_MS = 2000.0
 ENV_COOLDOWN = "DLROVER_TPU_SERVE_SCALE_COOLDOWN"
 DEFAULT_COOLDOWN = 5.0
 
+#: goodput-ledger serving-phase share below which the pool counts as
+#: idle for scale-down (the p99 window is sticky: a burst an hour ago
+#: must not pin an idle pool at max size)
+ENV_IDLE_SHARE = "DLROVER_TPU_SERVE_IDLE_SHARE"
+DEFAULT_IDLE_SHARE = 0.1
+
 
 class ServingAutoScaler:
     """Scales a serving pool on router stats.
@@ -56,10 +62,18 @@ class ServingAutoScaler:
         p99_high_ms: Optional[float] = None,
         interval: float = 1.0,
         cooldown: Optional[float] = None,
+        goodput_fn: Optional[Callable[[], Optional[float]]] = None,
     ):
         self._stats_fn = stats_fn
         self._scale_fn = scale_fn
         self._replicas_fn = replicas_fn
+        #: ISSUE 20: the goodput ledger's serving-phase share (0..1) —
+        #: how much of the pool's wall time was spent answering. None
+        #: (no ledger wired) keeps the pre-SLO behavior exactly.
+        self._goodput_fn = goodput_fn
+        self._idle_share = float(
+            os.getenv(ENV_IDLE_SHARE, "") or DEFAULT_IDLE_SHARE
+        )
         self._min = max(0, min_replicas)
         self._max = max(self._min, max_replicas)
         self._queue_high = int(
@@ -131,11 +145,28 @@ class ServingAutoScaler:
         # lack the keys and read 0.0, keeping the legacy behavior.
         queue_wait_ms = float(stats.get("queue_wait_p99_ms", 0.0))
         model_ms = float(stats.get("model_time_p99_ms", 0.0))
+        # SLO feed (ISSUE 20): the goodput ledger's serving-phase share
+        serving_share = None
+        if self._goodput_fn is not None:
+            try:
+                serving_share = self._goodput_fn()
+            except Exception:  # pragma: no cover - defensive
+                serving_share = None
         target = current
         reason = ""
         if stats.get("sealed") and not queue_depth:
             return None  # stream ending: let workers drain out
-        if queue_depth > self._queue_high and current < self._max:
+        # the goodput ledger overrides a stale latency window: nothing
+        # queued, nothing in flight, and the pool's wall time shows no
+        # serving — the p99 breach is history, not load
+        pool_idle = (
+            queue_depth == 0 and not stats.get("in_flight")
+            and serving_share is not None
+            and serving_share < self._idle_share
+        )
+        if pool_idle and current > self._min:
+            target, reason = current - 1, "idle"
+        elif queue_depth > self._queue_high and current < self._max:
             target, reason = current + 1, "queue_depth"
         elif p99_ms > self._p99_high_ms and current < self._max:
             if model_ms > self._p99_high_ms and model_ms > queue_wait_ms:
@@ -149,11 +180,19 @@ class ServingAutoScaler:
                     model_time_p99_ms=round(model_ms, 3),
                     queue_wait_p99_ms=round(queue_wait_ms, 3),
                     replicas=current,
+                    serving_share=-1.0 if serving_share is None
+                    else round(serving_share, 4),
                 )
                 return None
             target, reason = current + 1, "p99_latency"
-        elif (queue_depth == 0 and p99_ms < self._p99_high_ms / 4
-              and current > self._min and not stats.get("in_flight")):
+        elif (queue_depth == 0 and current > self._min
+              and not stats.get("in_flight")
+              and (p99_ms < self._p99_high_ms / 4
+                   or (serving_share is not None
+                       and serving_share < self._idle_share))):
+            # the latency window is sticky — a burst long past must not
+            # pin an idle pool at max size, so a near-zero serving
+            # share from the goodput ledger also opens the down path
             target, reason = current - 1, "idle"
         if target == current:
             return None
@@ -163,6 +202,8 @@ class ServingAutoScaler:
             p99_ms=round(p99_ms, 3),
             queue_wait_p99_ms=round(queue_wait_ms, 3),
             model_time_p99_ms=round(model_ms, 3),
+            serving_share=-1.0 if serving_share is None
+            else round(serving_share, 4),
         )
         counter(
             "dlrover_serve_autoscale_total",

@@ -135,6 +135,7 @@ class ServingWorker:
         injector=None,
         rotation: Optional[ReplicaRotation] = None,
         exit_fn: Callable[[int], None] = os._exit,
+        status_interval: float = 0.0,
     ):
         self._client = master_client
         self._model_fn = model_fn
@@ -153,11 +154,22 @@ class ServingWorker:
         self.step: Optional[int] = None
         self.served = 0
         self.rejected = 0
+        #: EWMA of model_fn wall time per request (ms) and lease-batch
+        #: fill ratio — the replica-side halves of the serve_stats
+        #: split, shipped to the master on the delta-report lane
+        #: (serve_fields) instead of being polled per replica
+        self.model_ms = 0.0
+        self.batch_fill = 0.0
         #: one-deep lookahead: the lease thread buffers exactly the
         #: NEXT micro-batch while model_fn runs the current one
         self._buffer: "queue.Queue" = queue.Queue(maxsize=1)
         self._sealed_evt = threading.Event()
         self._stop_evt = threading.Event()
+        #: >0 starts a delta StatusReporter carrying serve_fields() to
+        #: the master each interval (ISSUE 20) — replica stats ride the
+        #: report lane instead of per-replica serve_stats polls
+        self._status_interval = max(0.0, status_interval)
+        self._reporter = None
 
     # ------------------------------------------------------------- weights
 
@@ -218,9 +230,28 @@ class ServingWorker:
             else:
                 time.sleep(self._poll)
 
+    def serve_fields(self) -> dict:
+        """The replica's serve section for the delta-report plane
+        (agent/status_reporter.py ``serve_fn``)."""
+        return {
+            "served": self.served,
+            "rejected": self.rejected,
+            "model_ms": round(self.model_ms, 3),
+            "batch_fill": round(self.batch_fill, 4),
+        }
+
     def _process(self, batch) -> None:
         payloads = [payload for _, payload in batch]
+        t0 = time.perf_counter()
         responses = self._model_fn(payloads, self.state)
+        per_req_ms = (
+            (time.perf_counter() - t0) * 1000.0 / max(1, len(batch))
+        )
+        alpha = 0.2  # EWMA: recent batches dominate, spikes decay
+        self.model_ms += alpha * (per_req_ms - self.model_ms)
+        self.batch_fill += alpha * (
+            len(batch) / self._batch_size - self.batch_fill
+        )
         for (req_id, _), response in zip(batch, responses):
             accepted = self._client.serve_complete(req_id, response)
             if accepted:
@@ -238,6 +269,17 @@ class ServingWorker:
         rotation drains this replica (calls ``exit_fn(21)``)."""
         self.rotation.arm()
         self.load_weights()
+        if self._status_interval > 0 and hasattr(
+            self._client, "report_node_status"
+        ):
+            from dlrover_tpu.agent.status_reporter import StatusReporter
+
+            self._reporter = StatusReporter(
+                self._client, self._status_interval,
+                incarnation=self._incarnation,
+                serve_fn=self.serve_fields,
+            )
+            self._reporter.start()
         leaser = threading.Thread(
             target=self._lease_loop, name="serve-lease", daemon=True,
         )
@@ -257,6 +299,8 @@ class ServingWorker:
                     return self._drain_exit()
         finally:
             self._stop_evt.set()
+            if self._reporter is not None:
+                self._reporter.stop()
         record(
             "serve.worker_exit", node_id=self._node_id, reason="sealed",
             served=self.served, rejected=self.rejected, requeued=0,
@@ -268,6 +312,8 @@ class ServingWorker:
         """Rotation: in-flight batch already completed — hand the
         remaining leases back, close the ledger, exit rc 21."""
         self._stop_evt.set()
+        if self._reporter is not None:
+            self._reporter.stop()
         requeued = -1
         try:
             requeued = self._client.serve_relinquish()

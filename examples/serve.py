@@ -68,6 +68,7 @@ def run_replica(args) -> int:
         client, _model_fn, node_id=args.node_id,
         checkpointer=_make_checkpointer(args.ckpt_dir, args.ram_dir),
         init_state_fn=_init_state, batch_size=args.batch,
+        status_interval=2.0,
     )
     served = worker.serve()
     print(f"replica {args.node_id}: served {served} requests")

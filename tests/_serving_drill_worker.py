@@ -100,7 +100,7 @@ def main() -> int:
         client, model_fn, node_id=args.node_id,
         checkpointer=ckpt, init_state_fn=init_state,
         batch_size=args.batch_size, poll_interval=0.02,
-        injector=injector,
+        injector=injector, status_interval=1.0,
     )
     served = worker.serve()  # rotation exits inside with rc 21
     emit(f"SERVED {served}")

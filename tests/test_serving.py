@@ -559,3 +559,8 @@ def test_serve_load_smoke():
     assert result["requests_per_s"] > 0
     assert result["serve_p99_ms"] >= result["serve_p50_ms"] >= 0
     assert result["duplicates"] == 0
+    # the ISSUE 20 axes always report, even at their defaults
+    assert result["routers"] == 1
+    assert result["tenants"] == 1
+    assert result["fairness_spread"] == 1.0
+    assert set(result["per_shard_req_s"]) == {"0"}

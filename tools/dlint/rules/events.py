@@ -64,7 +64,8 @@ VOCABULARY = {
         "rollback.budget_exhausted",
         "quarantine.imposed",
     })),
-    # ISSUE 11: the serving request plane
+    # ISSUE 11: the serving request plane (+ ISSUE 20: live shard
+    # re-partition)
     "serve": (("serve",), frozenset({
         "serve.sealed",
         "serve.drained",
@@ -75,6 +76,7 @@ VOCABULARY = {
         "serve.worker_ready",
         "serve.worker_exit",
         "serve.rpc_fallback",
+        "serve.shards_resized",
     })),
     # ISSUE 14: the reshard-in-place transition plane. Deliberately no
     # reshard.rpc_fallback — report_reshard degrades through
